@@ -1,0 +1,167 @@
+"""AutoDist entry point.
+
+Analog of reference ``autodist/autodist.py``: the user-facing object tying
+capture -> strategy build/load -> compile -> lowering -> execution together,
+with the chief-vs-worker role split driven by the ``ADT_WORKER`` env var
+(reference ``autodist.py:40-41``) and a one-instance-per-process registry
+(reference ``autodist.py:43-57``).
+
+Usage (the 3-line-change pattern of ``examples/linear_regression.py``):
+
+    ad = AutoDist(resource_spec_file="spec.yml",
+                  strategy_builder=strategy.PSLoadBalancing())
+    train_step = ad.function(loss_fn, optimizer=opt, params=params,
+                             example_batch=batch)
+    for batch in data:
+        metrics = train_step(batch)
+"""
+import contextlib
+from typing import Callable, Optional
+
+from autodist_tpu import const, patch
+from autodist_tpu.kernel.graph_transformer import GraphTransformer
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.parallel import mesh as mesh_lib
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.runtime.runner import Runner, WrappedSession
+from autodist_tpu.strategy.base import Strategy, StrategyCompiler
+from autodist_tpu.utils import logging
+
+_DEFAULT_AUTODIST = {}
+
+
+def set_default_autodist(obj):
+    """One AutoDist instance per process (reference ``autodist.py:43-57``)."""
+    if _DEFAULT_AUTODIST:
+        raise NotImplementedError("Only one AutoDist instance per process is "
+                                  "supported; call autodist_tpu.reset() in tests")
+    _DEFAULT_AUTODIST[0] = obj
+
+
+def get_default_autodist():
+    return _DEFAULT_AUTODIST.get(0)
+
+
+def reset():
+    """Clear process-global state (for tests; the reference isolates with
+    fresh subprocesses instead, ``tests/integration/test_all.py:53-69``)."""
+    _DEFAULT_AUTODIST.clear()
+
+
+class AutoDist:
+    def __init__(self, resource_spec_file: Optional[str] = None,
+                 strategy_builder=None, resource_spec: Optional[ResourceSpec] = None,
+                 backend: Optional[str] = None, tracing: bool = False):
+        set_default_autodist(self)
+        const.makedirs()
+        # Worker processes join the JAX distributed runtime from the env the
+        # Coordinator set — must happen before any device query.
+        from autodist_tpu.runtime import server_starter
+        server_starter.maybe_init_distributed()
+        if resource_spec is not None:
+            self._resource_spec = resource_spec
+        elif resource_spec_file is not None:
+            self._resource_spec = ResourceSpec(resource_spec_file)
+        else:
+            self._resource_spec = ResourceSpec.from_local()
+        if strategy_builder is None:
+            from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing
+            strategy_builder = PSLoadBalancing()  # default, as in reference autodist.py:70
+        self._strategy_builder = strategy_builder
+        self._backend = backend
+        self._tracing = tracing
+        self._runner: Optional[Runner] = None
+        self._coordinator = None
+        patch.patch_optax() if const.ENV.ADT_PATCH_OPTAX.val else None
+
+    @property
+    def resource_spec(self) -> ResourceSpec:
+        return self._resource_spec
+
+    @property
+    def is_chief(self) -> bool:
+        return const.is_chief()
+
+    @contextlib.contextmanager
+    def scope(self):
+        """Capture scope (reference ``autodist.py:309-322``). In JAX capture
+        is explicit (functions passed to ``build``), so the scope's job is
+        optimizer-construction recording."""
+        patch.patch_optax()
+        yield self
+
+    # ------------------------------------------------------------- build path
+
+    def _build_or_load_strategy(self, model_item: ModelItem) -> Strategy:
+        """Chief builds+serializes; workers load by id
+        (reference ``autodist.py:100-109``)."""
+        if const.is_chief():
+            strategy = self._strategy_builder.build(model_item, self._resource_spec)
+            path = strategy.serialize()
+            logging.info("built strategy %s -> %s", strategy.id, path)
+            return strategy
+        strategy_id = const.ENV.ADT_STRATEGY_ID.val
+        if not strategy_id:
+            raise RuntimeError("worker process missing ADT_STRATEGY_ID")
+        return Strategy.deserialize(strategy_id)
+
+    def _setup(self, strategy: Strategy):
+        """Chief-only: bring up the cluster + launch worker clients
+        (reference ``autodist.py:120-128``). Single-node runs skip this."""
+        if self._resource_spec.is_single_node() or not const.is_chief():
+            return
+        from autodist_tpu.runtime.coordinator import Coordinator
+        from autodist_tpu.runtime.cluster import SSHCluster
+        cluster = SSHCluster(self._resource_spec)
+        self._coordinator = Coordinator(strategy, cluster)
+        cluster.start()
+        self._coordinator.launch_clients()
+
+    def build(self, loss_fn: Callable, optimizer, params, example_batch,
+              has_aux: bool = False, apply_fn: Optional[Callable] = None) -> Runner:
+        """Capture + compile + lower; returns a Runner (uninitialized)."""
+        item = ModelItem(loss_fn=loss_fn, optimizer=optimizer, params=params,
+                         example_batch=example_batch, has_aux=has_aux,
+                         apply_fn=apply_fn).prepare()
+        strategy = self._build_or_load_strategy(item)
+        compiled = StrategyCompiler(item, self._resource_spec).compile(strategy)
+        logging.info("compiled %r", compiled)
+        logging.debug("compiled strategy:\n%s", compiled)
+        self._setup(compiled)
+        mesh = mesh_lib.mesh_from_strategy(compiled, self._resource_spec,
+                                           backend=self._backend)
+        dstep = GraphTransformer(compiled, mesh, item).transform()
+        self._runner = Runner(dstep, tracing=self._tracing)
+        return self._runner
+
+    def function(self, loss_fn: Callable, *, optimizer, params, example_batch=None,
+                 has_aux: bool = False) -> Callable:
+        """TF2-style stepping function (reference ``autodist.py:269-289``):
+        lazily builds on first call (using that call's batch as the example),
+        then every call runs one distributed step and returns host metrics."""
+        box = {}
+
+        def stepper(batch):
+            if "runner" not in box:
+                ex = example_batch if example_batch is not None else batch
+                runner = self.build(loss_fn, optimizer, params, ex, has_aux)
+                runner.init(params)
+                box["runner"] = runner
+            return box["runner"].run(batch)
+
+        stepper.get_runner = lambda: box.get("runner")
+        return stepper
+
+    def create_distributed_session(self, loss_fn=None, optimizer=None, params=None,
+                                   example_batch=None, has_aux: bool = False) -> WrappedSession:
+        """Session facade (reference ``autodist.py:191-198``)."""
+        if self._runner is None:
+            if loss_fn is None:
+                raise ValueError("no model built; pass loss_fn/optimizer/params")
+            runner = self.build(loss_fn, optimizer, params, example_batch, has_aux)
+            runner.init(params)
+        return WrappedSession(self._runner)
+
+    @property
+    def runner(self) -> Optional[Runner]:
+        return self._runner

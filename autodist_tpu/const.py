@@ -1,0 +1,87 @@
+"""Constants and environment-variable configuration.
+
+TPU-native analog of the reference's ``autodist/const.py`` (see
+reference ``autodist/const.py:32-89``): working directories, default port
+range for the coordination service, replica naming prefixes, group-leader
+identity, and a typed ``ENV`` enum of environment variables.
+"""
+import os
+from enum import Enum
+
+DEFAULT_WORKING_DIR = os.environ.get("ADT_WORKING_DIR", "/tmp/autodist_tpu")
+DEFAULT_SERIALIZATION_DIR = os.path.join(DEFAULT_WORKING_DIR, "strategies")
+DEFAULT_LOG_DIR = os.path.join(DEFAULT_WORKING_DIR, "logs")
+DEFAULT_TRACE_DIR = os.path.join(DEFAULT_WORKING_DIR, "traces")
+DEFAULT_SNAPSHOT_DIR = os.path.join(DEFAULT_WORKING_DIR, "snapshots")
+DEFAULT_CHECKPOINT_DIR = os.path.join(DEFAULT_WORKING_DIR, "checkpoints")
+
+# Port range for the coordination service (analog of the reference's TF
+# server ports 15000-16000, reference autodist/const.py:36-38).
+DEFAULT_PORT_RANGE = iter(range(15000, 16000))
+DEFAULT_COORDINATOR_PORT = 15999
+
+# Naming prefixes (analog of replica name-scope prefixes,
+# reference autodist/const.py:40-44).
+REPLICA_PREFIX = "adt-replica-{}"
+SHARD_SUFFIX = "/part_{}"
+GROUP_LEADER = "/job:worker/replica:0/task:0"
+
+# Mesh axis names used throughout the framework.
+DATA_AXIS = "data"           # data-parallel axis (replicas)
+MODEL_AXIS = "model"         # tensor/model-parallel axis
+PIPELINE_AXIS = "pipe"       # pipeline-parallel axis
+SEQUENCE_AXIS = "seq"        # sequence/context-parallel axis
+EXPERT_AXIS = "expert"       # expert-parallel axis
+
+MAX_INT32 = 2 ** 31 - 1
+MAX_INT64 = 2 ** 63 - 1
+
+
+class ENV(Enum):
+    """Typed environment variables (analog of reference autodist/const.py:55-89).
+
+    Each member's value is a lambda producing the parsed value; access via
+    ``ENV.NAME.val``.
+    """
+
+    ADT_WORKER = ("ADT_WORKER", str, "")                  # non-empty => this process is a worker, value = its address
+    ADT_STRATEGY_ID = ("ADT_STRATEGY_ID", str, "")        # strategy id assigned by chief
+    ADT_MIN_LOG_LEVEL = ("ADT_MIN_LOG_LEVEL", str, "INFO")
+    ADT_IS_TESTING = ("ADT_IS_TESTING", bool, False)      # enables extra invariant checks
+    ADT_DEBUG_REMOTE = ("ADT_DEBUG_REMOTE", bool, False)  # suppress real SSH exec (dry-run)
+    ADT_PATCH_OPTAX = ("ADT_PATCH_OPTAX", bool, True)     # record optimizer construction info
+    ADT_INTERNAL_BACKEND = ("ADT_INTERNAL_BACKEND", str, "")
+    SYS_DATA_PATH = ("SYS_DATA_PATH", str, "")
+    SYS_RESOURCE_PATH = ("SYS_RESOURCE_PATH", str, "")
+    ADT_COORDINATOR_ADDR = ("ADT_COORDINATOR_ADDR", str, "")  # host:port of chief coordination service
+    ADT_NUM_PROCESSES = ("ADT_NUM_PROCESSES", int, 1)
+    ADT_PROCESS_ID = ("ADT_PROCESS_ID", int, 0)
+
+    @property
+    def val(self):
+        name, typ, default = self.value
+        raw = os.environ.get(name)
+        if raw is None:
+            return default
+        if typ is bool:
+            return raw not in ("", "0", "False", "false")
+        return typ(raw)
+
+    @property
+    def name_str(self):
+        return self.value[0]
+
+
+def is_worker() -> bool:
+    """True when this process was launched by the coordinator as a worker."""
+    return bool(ENV.ADT_WORKER.val)
+
+
+def is_chief() -> bool:
+    return not is_worker()
+
+
+def makedirs():
+    for d in (DEFAULT_WORKING_DIR, DEFAULT_SERIALIZATION_DIR, DEFAULT_LOG_DIR,
+              DEFAULT_TRACE_DIR, DEFAULT_SNAPSHOT_DIR, DEFAULT_CHECKPOINT_DIR):
+        os.makedirs(d, exist_ok=True)

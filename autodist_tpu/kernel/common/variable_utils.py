@@ -1,0 +1,67 @@
+"""Pytree/variable naming utilities.
+
+Analog of reference ``autodist/kernel/common/variable_utils.py`` and parts of
+``common/utils.py:24-99`` (name parsing). The reference's problem — finding
+read/update ops for Ref vs Resource variables — doesn't exist in JAX; the
+equivalent bookkeeping is deterministic flattening of params/optimizer-state
+pytrees to named leaves and matching optimizer-state leaves to the variable
+they track.
+"""
+from typing import Any, Dict, List, Tuple
+
+import jax
+from jax.tree_util import tree_flatten_with_path, tree_unflatten
+
+from autodist_tpu.model_item import _normalize_path
+
+
+def flatten_named(tree) -> Tuple[List[str], List[Any], Any]:
+    """Flatten to (names, leaves, treedef) in deterministic path order."""
+    flat, treedef = tree_flatten_with_path(tree)
+    names = [_normalize_path(path) for path, _ in flat]
+    leaves = [leaf for _, leaf in flat]
+    return names, leaves, treedef
+
+
+def unflatten_named(treedef, leaves):
+    return tree_unflatten(treedef, leaves)
+
+
+def match_state_to_var(state_name: str, state_shape, var_infos) -> str:
+    """Map an optimizer-state leaf to the variable it tracks.
+
+    A state leaf (e.g. ``0/mu/dense/kernel`` for adam's first moment of
+    ``dense/kernel``) matches a variable when the variable's name is a
+    path-suffix of the state leaf's name and the shapes agree. Returns the
+    variable name or '' when the leaf is variable-independent (step counts,
+    scalars). This replaces the reference's deletion/rebuild of entire
+    optimizer name scopes (``kernel/partitioner.py:376-426``)."""
+    best = ""
+    for var_name, info in var_infos.items():
+        if tuple(state_shape) != tuple(info.shape):
+            continue
+        if state_name == var_name or state_name.endswith("/" + var_name):
+            if len(var_name) > len(best):
+                best = var_name
+    return best
+
+
+def map_state_layouts(state_tree, var_infos, var_layouts: Dict[str, Any], default):
+    """Build a pytree (same structure as ``state_tree``) whose leaves are the
+    layout of the matched variable, or ``default`` for unmatched leaves."""
+    flat, treedef = tree_flatten_with_path(state_tree)
+    out = []
+    for path, leaf in flat:
+        name = _normalize_path(path)
+        shape = getattr(leaf, "shape", ())
+        var = match_state_to_var(name, shape, var_infos)
+        out.append(var_layouts.get(var, default) if var else default)
+    return tree_unflatten(treedef, out)
+
+
+def is_scalar_leaf(leaf) -> bool:
+    return getattr(leaf, "shape", ()) == ()
+
+
+def tree_zeros_like(tree):
+    return jax.tree_util.tree_map(lambda x: jax.numpy.zeros_like(x), tree)

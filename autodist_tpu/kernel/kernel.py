@@ -1,0 +1,26 @@
+"""Kernel abstract base.
+
+Analog of reference ``autodist/kernel/kernel.py:19-35``: a graph-transforming
+kernel exposes a classmethod ``apply`` and keeps its constructor private.
+Here kernels don't mutate a graph — they contribute pieces of the lowered
+SPMD step function (layouts, gradient-sync transforms) — but the pipeline
+shape (Partitioner -> Replicator -> Synchronizers, orchestrated by the
+GraphTransformer) is preserved.
+"""
+from abc import ABC, abstractmethod
+
+
+class Kernel(ABC):
+    _key = object()
+
+    def __init__(self, key, *args, **kwargs):
+        if key is not self._key:
+            raise ValueError("Kernels must be constructed via .apply()")
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        return cls(cls._key, *args, **kwargs)._apply()
+
+    @abstractmethod
+    def _apply(self):
+        ...

@@ -1,0 +1,51 @@
+"""Replicator — data-parallel replication.
+
+Analog of reference ``autodist/kernel/replicator.py:60-156``, which re-imports
+the GraphDef once per local device under ``AutoDist-Replica-i/`` name scopes
+and rewires savers/variables/feeds per replica. Under SPMD there is nothing
+to copy: the mesh's data axis *is* the replica set — one traced program runs
+on every device with the batch sharded along that axis, and XLA's SPMD
+partitioner performs the replication the reference did with
+``import_graph_def`` × N. What remains of the Replicator is the bookkeeping:
+replica count/devices and the batch-sharding spec it contributes to the
+lowering (in-graph replication ≡ local mesh devices; between-graph
+replication ≡ the same axis spanning processes — reference
+``docs/design/architecture.rst:43-47``).
+"""
+from typing import List
+
+from jax.sharding import PartitionSpec as P
+
+from autodist_tpu import const
+from autodist_tpu.kernel.kernel import Kernel
+
+
+class Replicator(Kernel):
+    def __init__(self, key, replica_devices: List[str], mesh,
+                 mesh_axis: str = const.DATA_AXIS):
+        super().__init__(key)
+        self._replica_devices = replica_devices
+        self._mesh = mesh
+        self._axis = mesh_axis
+
+    def _apply(self):
+        return ReplicaInfo(self._replica_devices, self._mesh, self._axis)
+
+
+class ReplicaInfo:
+    def __init__(self, replica_devices, mesh, mesh_axis):
+        self.replica_devices = list(replica_devices)
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
+
+    @property
+    def num_replicas(self) -> int:
+        return len(self.replica_devices)
+
+    @property
+    def batch_spec(self) -> P:
+        """Shard the leading (batch) dim across replicas."""
+        return P(self.mesh_axis)
+
+    def replica_name(self, i: int) -> str:
+        return const.REPLICA_PREFIX.format(i)

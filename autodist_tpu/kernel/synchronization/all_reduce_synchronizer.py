@@ -1,0 +1,47 @@
+"""AllReduce synchronizer kernel.
+
+Analog of reference
+``autodist/kernel/synchronization/all_reduce_synchronizer.py:102-130``: the
+reference replaces each replica's gradient with a CollectiveReduce (mean via
+merge=Add, final=Div) keyed so all workers agree. Here the collective is
+``jax.lax.psum`` over the mesh's data axis — XLA lowers it onto ICI
+(intra-slice) or DCN (cross-slice) per the mesh; the ``spec`` hint is kept
+as metadata. Compression wraps the collective
+(``kernel/synchronization/compressor.py``); partitioned variables take the
+reduce-scatter path (each device receives only its shard of the summed
+gradient — the ICI-native realization of "partition then all-reduce each
+shard", reference ``partitioned_all_reduce_strategy.py:71-117``).
+
+Sparse gradients: the reference all-gathers indices+values
+(``all_reduce_synchronizer.py:132-173``). JAX gradients arrive dense; the
+sparse fast path lives in ``ops/embedding.py`` (row-gathered updates) and is
+routed by the lowering when a variable is marked sparse.
+"""
+from autodist_tpu.kernel.synchronization import compressor as compressor_lib
+from autodist_tpu.kernel.synchronization.synchronizer import Synchronizer
+from autodist_tpu.utils import logging
+
+
+class AllReduceSynchronizer(Synchronizer):
+    def __init__(self, var_name, config, num_replicas, mesh_axis="data", layout=None):
+        super().__init__(var_name, config, num_replicas, mesh_axis, layout)
+        self.compressor = compressor_lib.create(
+            getattr(config, "compressor", None), var_name)
+        self.group = getattr(config, "group", 0)
+        self.spec = getattr(config, "spec", "AUTO")
+        if (layout is not None and layout.partitioned
+                and self.compressor.name != "NoneCompressor"):
+            logging.warning("var %s: compressor %s is ignored on the "
+                            "partitioned (reduce-scatter) path", var_name,
+                            self.compressor.name)
+
+    def state_init(self, grad_shape, dtype):
+        return self.compressor.state_init(grad_shape, dtype)
+
+    def sync(self, grad, state):
+        if self.layout is not None and self.layout.partitioned:
+            # reduce-scatter: summed shard, then normalize to mean
+            local = self.layout.reduce_scatter_grad(grad)
+            return local / self.num_replicas, state
+        reduced, new_state = self.compressor.reduce(grad, state, self.psum)
+        return reduced / self.num_replicas, new_state

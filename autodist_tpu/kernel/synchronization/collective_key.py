@@ -1,0 +1,47 @@
+"""Deterministic collective keys.
+
+Analog of reference ``autodist/kernel/synchronization/collective_key.py:43-70``:
+the reference generates group keys sequentially per device-set and instance
+keys as md5(var_name) mod INT32 so that all workers, building their graphs
+independently, agree on collective identities without communicating.
+
+Under XLA SPMD the compiler assigns channel ids itself, so these keys are
+not fed to the runtime; they remain the deterministic *ordering* authority —
+gradient buckets are concatenated in instance-key order, which must be
+identical on every process for the bytes on the wire to line up.
+"""
+import hashlib
+
+from autodist_tpu.const import MAX_INT32
+
+
+class CollectiveKey:
+    _instance = None
+
+    def __init__(self, group_leader: str = ""):
+        self._group_keys = {}
+        self._next_group = 1
+        self.group_leader = group_leader
+
+    @classmethod
+    def get(cls) -> "CollectiveKey":
+        if cls._instance is None:
+            cls._instance = CollectiveKey()
+        return cls._instance
+
+    @classmethod
+    def reset(cls):
+        cls._instance = None
+
+    def group_key(self, device_set) -> int:
+        """Sequential key per canonical device set."""
+        canon = ",".join(sorted(str(d) for d in device_set))
+        if canon not in self._group_keys:
+            self._group_keys[canon] = self._next_group
+            self._next_group += 1
+        return self._group_keys[canon]
+
+    @staticmethod
+    def instance_key(var_name: str) -> int:
+        digest = hashlib.md5(var_name.encode()).hexdigest()
+        return int(digest, 16) % MAX_INT32

@@ -1,0 +1,153 @@
+"""Gradient compressors for all-reduce.
+
+Analog of reference ``autodist/kernel/synchronization/compressor.py:85-206``,
+a strategy-pattern wrapper around the collective: ``NoneCompressor``
+(passthrough), ``HorovodCompressor`` (reduced-precision transfer — the
+reference casts to fp32; on TPU the payload-halving cast is bf16),
+``HorovodCompressorEF`` (reduced precision + error feedback residual), and
+``PowerSGDCompressor`` — present but fully commented-out in the reference
+(``compressor.py:208-284``); implemented for real here (rank-r power
+iteration, arXiv 1905.13727), one of the places this framework goes beyond
+the reference.
+
+A compressor transforms the payload *around* the all-reduce:
+``compress -> psum -> decompress``. Stateful compressors (error feedback,
+PowerSGD's warm-started Q) carry their state in the train state's
+``sync_state`` pytree, updated functionally each step.
+"""
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Base: stateless passthrough. ``state_spec(grad)`` returns a pytree of
+    zeros-like state carried across steps (None when stateless)."""
+
+    name = "NoneCompressor"
+
+    def __init__(self, var_name: str = ""):
+        self.var_name = var_name
+
+    def state_init(self, grad_shape, dtype):
+        return None
+
+    def reduce(self, grad: jax.Array, state, psum: Callable) -> Tuple[jax.Array, object]:
+        """Return (sum-reduced gradient, new state). ``psum`` is the
+        axis-bound sum-reduction supplied by the synchronizer, which
+        normalizes to a mean afterwards."""
+        return psum(grad), state
+
+
+class NoneCompressor(Compressor):
+    pass
+
+
+class HorovodCompressor(Compressor):
+    """Cast payload to a smaller dtype for the wire, cast back after.
+
+    The reference compresses fp64->fp32 (``compressor.py:169-201``); TPU
+    gradients are fp32, so the halving cast is bf16."""
+
+    name = "HorovodCompressor"
+    wire_dtype = jnp.bfloat16
+
+    def reduce(self, grad, state, psum):
+        orig = grad.dtype
+        if grad.dtype in (jnp.float32, jnp.float64):
+            reduced = psum(grad.astype(self.wire_dtype)).astype(orig)
+        else:
+            reduced = psum(grad)
+        return reduced, state
+
+
+class HorovodCompressorEF(Compressor):
+    """Reduced-precision all-reduce with error feedback
+    (reference ``compressor.py:120-143``): the quantization error from this
+    step is added back into the next step's gradient, preserving the sum of
+    updates over time."""
+
+    name = "HorovodCompressorEF"
+    wire_dtype = jnp.bfloat16
+
+    def state_init(self, grad_shape, dtype):
+        return jnp.zeros(grad_shape, dtype)
+
+    def reduce(self, grad, state, psum):
+        orig = grad.dtype
+        compensated = grad + state
+        wire = compensated.astype(self.wire_dtype)
+        new_state = compensated - wire.astype(orig)  # local quantization error
+        reduced = psum(wire).astype(orig)
+        return reduced, new_state
+
+
+class PowerSGDCompressor(Compressor):
+    """Rank-r PowerSGD (arXiv 1905.13727) with error feedback and a
+    warm-started Q factor. Communicates P (n x r) + Q (m x r) instead of the
+    full n x m gradient. Matrices only; lower-rank tensors pass through.
+
+    The reference carries this as dead commented-out code
+    (``compressor.py:208-284``); here it is live and tested."""
+
+    name = "PowerSGDCompressor"
+
+    def __init__(self, var_name: str = "", rank: int = 1):
+        super().__init__(var_name)
+        self.rank = rank
+
+    def _matrix_shape(self, shape):
+        if len(shape) < 2:
+            return None
+        n = shape[0]
+        m = 1
+        for d in shape[1:]:
+            m *= d
+        return n, m
+
+    def state_init(self, grad_shape, dtype):
+        nm = self._matrix_shape(grad_shape)
+        if nm is None:
+            return None
+        n, m = nm
+        # md5-derived seed: every process must build the identical Q
+        # (builtin hash() is randomized per process — see collective_key.py)
+        from autodist_tpu.kernel.synchronization.collective_key import CollectiveKey
+        key = jax.random.PRNGKey(CollectiveKey.instance_key(self.var_name))
+        q = jax.random.normal(key, (m, self.rank), dtype)
+        return {"error": jnp.zeros(grad_shape, dtype), "q": q}
+
+    def reduce(self, grad, state, psum):
+        nm = self._matrix_shape(grad.shape)
+        if nm is None or state is None:
+            return psum(grad), state
+        n, m = nm
+        mat = (grad + state["error"]).reshape(n, m)
+        q = state["q"]
+        # power iteration: P = M Q (all-reduced), orthonormalize, Q = M^T P
+        p = psum(mat @ q)
+        p, _ = jnp.linalg.qr(p)
+        q_new = psum(mat.T @ p)
+        approx = (p @ q_new.T).reshape(grad.shape)
+        # the all-reduced approx is a sum over workers already; error is local
+        new_error = (grad + state["error"]) - (p @ (mat.T @ p).T).reshape(grad.shape)
+        return approx, {"error": new_error, "q": q_new}
+
+
+_REGISTRY: Dict[str, type] = {
+    c.name: c for c in
+    (NoneCompressor, HorovodCompressor, HorovodCompressorEF, PowerSGDCompressor)
+}
+# TPU-flavored aliases
+_REGISTRY["BF16Compressor"] = HorovodCompressor
+_REGISTRY["BF16CompressorEF"] = HorovodCompressorEF
+
+
+def create(name: Optional[str], var_name: str = "") -> Compressor:
+    """Factory by class name (reference ``Compressor.create``)."""
+    if not name:
+        return NoneCompressor(var_name)
+    if name not in _REGISTRY:
+        raise ValueError("unknown compressor %r (have %s)" % (name, sorted(_REGISTRY)))
+    return _REGISTRY[name](var_name)

@@ -1,0 +1,50 @@
+"""PS synchronizer kernel.
+
+Analog of reference
+``autodist/kernel/synchronization/ps_synchronizer.py`` (761 LoC of graph
+surgery). The reference's machinery maps onto TPU as follows:
+
+- *In-graph apply* (share replica-0 variable, aggregate local grads on the
+  worker CPU, ``ps_synchronizer.py:105-152,460-535``): under SPMD all local
+  replicas already share one logical variable; the local aggregation is the
+  first hop of the single ``psum``.
+- *Between-graph apply* (place var+update on the PS device, per-worker
+  accumulators, token-queue barriers, ``:171-176,335-458,556-633``): the
+  synchronous dance — "push grads, owner averages over num_workers, applies,
+  workers wait for the token" — is exactly the semantics of one mean
+  ``psum`` followed by a (redundantly computed, hence communication-free)
+  update: every device leaves the step with the identical post-update value,
+  which is what the token queue guaranteed. The owner assignment
+  (``reduction_destination``) is kept as metadata: it drives the
+  load-balancing accounting and the host-offload placement in
+  ``parallel/ps.py``.
+- *Proxy variables* (``common/proxy_variable.py``): worker-local caches —
+  see ``kernel/common/proxy_variable.py``.
+- *Staleness / async* (``:388-458``): bounded-staleness execution is a
+  runtime-scheduling property, not a graph property, on TPU; it belongs to
+  the runner's dispatch layer coordinated by the host coordination service.
+  NOT IMPLEMENTED YET — requesting it logs a warning and trains
+  synchronously.
+"""
+from autodist_tpu.kernel.synchronization.synchronizer import Synchronizer
+
+
+class PSSynchronizer(Synchronizer):
+    def __init__(self, var_name, config, num_replicas, mesh_axis="data", layout=None):
+        super().__init__(var_name, config, num_replicas, mesh_axis, layout)
+        self.reduction_destination = getattr(config, "reduction_destination", "")
+        self.local_replication = getattr(config, "local_replication", False)
+        self.sync_mode = getattr(config, "sync", True)
+        self.staleness = getattr(config, "staleness", 0)
+        if not self.sync_mode or self.staleness > 0:
+            from autodist_tpu.utils import logging
+            logging.warning(
+                "var %s: async/bounded-staleness PS (sync=%s, staleness=%d) "
+                "is not implemented yet; executing fully synchronously",
+                var_name, self.sync_mode, self.staleness)
+
+    def sync(self, grad, state):
+        if self.layout is not None and self.layout.partitioned:
+            local = self.layout.reduce_scatter_grad(grad)
+            return local / self.num_replicas, state
+        return self.psum(grad) / self.num_replicas, state

@@ -1,0 +1,77 @@
+"""Deterministic device-mesh construction.
+
+The TPU-native replacement for the reference's TF ClusterSpec + deterministic
+ip:port ordering (reference ``autodist/cluster.py:70-82``): every process must
+independently build the *same* mesh so that independently-lowered programs
+agree on collective participants — the analog of the reference's
+deterministic collective key generation
+(``kernel/synchronization/collective_key.py:43-70``).
+
+Devices are ordered by (process_index, device id), which is stable across
+all processes of one jax.distributed job.
+"""
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from autodist_tpu import const
+from autodist_tpu.utils import logging
+
+
+def ordered_devices(n: Optional[int] = None, backend: Optional[str] = None) -> List:
+    devs = sorted(jax.devices(backend) if backend else jax.devices(),
+                  key=lambda d: (d.process_index, d.id))
+    if n is not None:
+        if len(devs) < n:
+            raise ValueError("need %d devices, runtime has %d" % (n, len(devs)))
+        devs = devs[:n]
+    return devs
+
+
+def build_mesh(num_devices: Optional[int] = None,
+               axes: Optional[Dict[str, int]] = None,
+               devices: Optional[Sequence] = None,
+               backend: Optional[str] = None) -> Mesh:
+    """Build a Mesh with named axes.
+
+    ``axes`` maps axis name -> size, in major-to-minor order; sizes must
+    multiply to the device count. Default: a 1-D data-parallel mesh over all
+    devices. Axis order convention (outer->inner): pipe, data, expert, seq,
+    model — inner axes get the fastest ICI links (nearest-neighbor), which is
+    where tensor-parallel collectives belong.
+    """
+    if devices is None:
+        devices = ordered_devices(num_devices, backend)
+    devices = list(devices)
+    if not axes:
+        axes = {const.DATA_AXIS: len(devices)}
+    sizes = list(axes.values())
+    total = int(np.prod(sizes))
+    if total != len(devices):
+        raise ValueError("mesh axes %s don't cover %d devices" % (axes, len(devices)))
+    arr = np.array(devices, dtype=object).reshape(sizes)
+    mesh = Mesh(arr, tuple(axes.keys()))
+    logging.debug("built mesh %s over %d devices", dict(axes), len(devices))
+    return mesh
+
+
+def host_to_mesh(mesh: Mesh, value, pspec) -> jax.Array:
+    """Place a host (numpy) value onto the mesh with the given PartitionSpec.
+    Works single- and multi-process (every process provides its addressable
+    shards from the same host-global value)."""
+    from jax.sharding import NamedSharding
+    arr = np.asarray(value)
+    sharding = NamedSharding(mesh, pspec)
+    return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
+
+
+def mesh_from_strategy(strategy, resource_spec=None, backend: Optional[str] = None) -> Mesh:
+    """Mesh for a compiled Strategy: replicas define the data axis; the
+    optional ``mesh_shape`` extension adds model/pipeline/sequence axes."""
+    n = len(strategy.graph_config.replicas)
+    shape = strategy.graph_config.mesh_shape
+    if shape:
+        return build_mesh(axes=dict(shape), backend=backend)
+    return build_mesh(num_devices=n or None, backend=backend)

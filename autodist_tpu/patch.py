@@ -1,0 +1,98 @@
+"""Optimizer-capture patches.
+
+Analog of reference ``autodist/patch.py:79-90`` (``PatchTensorFlow``): the
+reference wraps every TF optimizer's ``__init__``/``apply_gradients`` so the
+``GraphItem`` learns which optimizer the user built and with what arguments.
+Here the optimizer is an optax ``GradientTransformation`` — a pytree of pure
+functions with no identity of its own — so we wrap the public optax
+constructors and keep a side-table from the constructed object's id to
+``(name, kwargs)``. ``ModelItem`` consults the table at capture time.
+
+Applied automatically on package import when ``ADT_PATCH_OPTAX`` is set
+(mirroring reference ``autodist/__init__.py:50``).
+"""
+import collections
+import functools
+import inspect
+from typing import Any, Optional, Tuple
+
+# Keyed by id() with a strong reference to the optimizer object itself, so an
+# id can never be reused while its entry is live (optax transformations are
+# NamedTuples — not weakref-able). Bounded LRU so sweeps don't leak.
+_CAPTURED: "collections.OrderedDict[int, Tuple[Any, str, dict]]" = collections.OrderedDict()
+_CAPTURED_MAX = 128
+_PATCHED = False
+
+# The widely-used optax optimizer constructors (the analog of the
+# reference's "all OptimizerV1/V2 subclasses" sweep).
+_OPTAX_CTORS = [
+    "sgd", "adam", "adamw", "adamax", "adamaxw", "adagrad", "adadelta",
+    "rmsprop", "lamb", "lars", "lion", "nadam", "nadamw", "novograd",
+    "radam", "sm3", "yogi", "fromage", "adafactor", "noisy_sgd", "amsgrad",
+]
+
+
+def _record(name: str, fn, args, kwargs, result):
+    try:
+        bound = inspect.signature(fn).bind_partial(*args, **kwargs)
+        arg_dict = dict(bound.arguments)
+    except TypeError:
+        arg_dict = {"args": args, "kwargs": kwargs}
+    _CAPTURED[id(result)] = (result, name, arg_dict)
+    while len(_CAPTURED) > _CAPTURED_MAX:
+        _CAPTURED.popitem(last=False)
+
+
+def _wrap(name: str, fn):
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        result = fn(*args, **kwargs)
+        _record(name, fn, args, kwargs, result)
+        return result
+    wrapper.__adt_patched__ = True
+    return wrapper
+
+
+def patch_optax():
+    """Install the constructor wrappers (idempotent)."""
+    global _PATCHED
+    if _PATCHED:
+        return
+    try:
+        import optax
+    except ImportError:
+        return
+    for name in _OPTAX_CTORS:
+        fn = getattr(optax, name, None)
+        if fn is None or getattr(fn, "__adt_patched__", False):
+            continue
+        setattr(optax, name, _wrap(name, fn))
+    _PATCHED = True
+
+
+def unpatch_optax():
+    global _PATCHED
+    try:
+        import optax
+    except ImportError:
+        return
+    for name in _OPTAX_CTORS:
+        fn = getattr(optax, name, None)
+        if fn is not None and getattr(fn, "__adt_patched__", False):
+            setattr(optax, name, fn.__wrapped__)
+    _PATCHED = False
+
+
+def lookup_optimizer(opt) -> Tuple[Optional[str], dict]:
+    """Return recorded (name, kwargs) for an optax transformation, if known."""
+    entry = _CAPTURED.get(id(opt))
+    if entry is None or entry[0] is not opt:
+        return None, {}
+    return entry[1], entry[2]
+
+
+def register_optimizer(opt: Any, name: str, args: Optional[dict] = None):
+    """Explicit registration for optimizers built outside the patched ctors."""
+    _CAPTURED[id(opt)] = (opt, name, dict(args or {}))
+    while len(_CAPTURED) > _CAPTURED_MAX:
+        _CAPTURED.popitem(last=False)

@@ -1,0 +1,57 @@
+"""Remapper — feed/fetch adaptation between user values and the mesh.
+
+Analog of reference ``autodist/remapper.py:29-313``. The reference splits
+each fed batch along its first (polymorphic) dimension across replica
+placeholders and maps fetches back (train ops fetched on all replicas,
+tensors taken from the master replica or concatenated). Here:
+
+- **feed**: a host-global batch (numpy/pytree) is placed onto the mesh
+  sharded along the data axis (``Remapper.remap_feed``); values whose
+  leading dim can't shard (scalars) are replicated — the analog of
+  "duplicate when no polymorphic dim" (reference ``remapper.py:81-123``).
+- **fetch**: step outputs are device-global arrays; replicated metrics come
+  back as single host values (the "master replica" read,
+  ``remapper.py:125-185``), sharded outputs are gathered and concatenated.
+"""
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from autodist_tpu.utils import logging
+
+
+class Remapper:
+    def __init__(self, mesh, mesh_axis: str):
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
+        self.num_replicas = mesh.shape[mesh_axis]
+
+    # ------------------------------------------------------------------ feed
+
+    def _place(self, value, pspec):
+        from autodist_tpu.parallel.mesh import host_to_mesh
+        return host_to_mesh(self.mesh, value, pspec)
+
+    def remap_feed(self, batch) -> Any:
+        """Split the global batch across replicas along dim 0."""
+        def place(leaf):
+            arr = np.asarray(leaf)
+            if arr.ndim >= 1:
+                if arr.shape[0] % self.num_replicas != 0:
+                    raise ValueError(
+                        "global batch dim %d is not divisible by the %d "
+                        "replicas; pad or resize the batch (TPU programs "
+                        "need static, even shards)" % (arr.shape[0],
+                                                       self.num_replicas))
+                return self._place(arr, P(self.mesh_axis))
+            return self._place(arr, P())
+        return jax.tree_util.tree_map(place, batch)
+
+    # ----------------------------------------------------------------- fetch
+
+    def remap_fetch(self, fetched) -> Any:
+        """Bring step outputs to host: replicated values as scalars/arrays,
+        sharded values gathered (concatenated along their sharded dim)."""
+        return jax.device_get(fetched)

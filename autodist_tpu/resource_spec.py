@@ -1,0 +1,253 @@
+"""Cluster/topology description.
+
+TPU-native analog of reference ``autodist/resource_spec.py:45-331``: parses a
+``resource_spec.yml`` describing the machines (here: TPU hosts and their
+chips rather than GPU nodes), SSH access groups, chief designation, and
+network bandwidth. Adds TPU-specific notions the reference has no need for:
+slice topology (ICI-connected chip grid) vs. DCN-connected hosts.
+
+Device naming follows the reference's ``ip:TYPE:index`` convention
+(reference ``autodist/resource_spec.py:218-277``), with ``TPU`` as the
+accelerator type, e.g. ``10.0.0.1:TPU:0``.
+"""
+import os
+from enum import Enum
+from typing import Dict, List, Optional
+
+import yaml
+
+from autodist_tpu.utils import logging
+
+# Default inter-node bandwidth when unspecified: 1 GbE, in bytes/sec
+# (mirrors reference resource_spec.py:209-215).
+DEFAULT_NETWORK_BANDWIDTH_GBPS = 1
+# Default ICI link bandwidth per direction for a v4-like slice, bytes/sec.
+DEFAULT_ICI_BANDWIDTH_GBPS = 400
+
+
+class DeviceType(Enum):
+    CPU = "CPU"
+    TPU = "TPU"
+    # Accepted as a synonym for accelerator chips so reference-format yamls
+    # (which say ``gpus:``) parse unchanged.
+    GPU = "GPU"
+
+
+class DeviceSpec:
+    """One device: ``<host>:<TYPE>:<index>``."""
+
+    def __init__(self, host: str, device_type: DeviceType = DeviceType.TPU,
+                 device_index: int = 0):
+        self.host = host
+        self.device_type = device_type
+        self.device_index = int(device_index)
+
+    def name_string(self) -> str:
+        return "{}:{}:{}".format(self.host, self.device_type.value, self.device_index)
+
+    @classmethod
+    def from_string(cls, s: str) -> "DeviceSpec":
+        parts = s.split(":")
+        if len(parts) == 1:
+            return cls(parts[0], DeviceType.CPU, 0)
+        if len(parts) == 2:
+            # "host:0" => TPU index
+            return cls(parts[0], DeviceType.TPU, int(parts[1]))
+        host, typ, idx = parts[0], parts[1].upper(), parts[2]
+        if typ == "GPU":  # normalize reference-style names onto TPU
+            typ = "TPU"
+        return cls(host, DeviceType[typ], int(idx))
+
+    def __eq__(self, other):
+        return isinstance(other, DeviceSpec) and self.name_string() == other.name_string()
+
+    def __hash__(self):
+        return hash(self.name_string())
+
+    def __repr__(self):
+        return "DeviceSpec({})".format(self.name_string())
+
+
+class SSHConfig:
+    """One SSH access group (reference resource_spec.py:291-331)."""
+
+    def __init__(self, info: dict):
+        self.username = info.get("username", "")
+        self.port = int(info.get("port", 22))
+        self.python_venv = info.get("python_venv", "")
+        self.key_file = info.get("key_file", "")
+        self.pkey = None
+        self.env = dict(info.get("env", {}))
+        # Make sure remote processes see the TPU runtime.
+        self.env.setdefault("PYTHONNOUSERSITE", "True")
+
+
+class SSHConfigMap(dict):
+    def __init__(self, info: Optional[dict], node_groups: Dict[str, str]):
+        super().__init__()
+        info = info or {}
+        for group, conf in info.items():
+            self[group] = SSHConfig(conf)
+        self._node_groups = node_groups
+
+    def for_host(self, host: str) -> Optional[SSHConfig]:
+        group = self._node_groups.get(host)
+        return self.get(group) if group else None
+
+
+class _Node:
+    def __init__(self, entry: dict):
+        self.address = str(entry["address"])
+        # chips/tpus/gpus are synonyms; value may be a count or a list of indices
+        raw = entry.get("tpus", entry.get("chips", entry.get("gpus", 0)))
+        if isinstance(raw, int):
+            self.tpu_indices = list(range(raw))
+        else:
+            self.tpu_indices = sorted(int(i) for i in (raw or []))
+        raw_cpus = entry.get("cpus", [0])
+        if isinstance(raw_cpus, int):
+            self.cpu_indices = list(range(raw_cpus))
+        else:
+            self.cpu_indices = sorted(int(i) for i in (raw_cpus or []))
+        self.chief = bool(entry.get("chief", False))
+        self.ssh_config = entry.get("ssh_config")
+        self.network_bandwidth_gbps = float(
+            entry.get("network_bandwidth", DEFAULT_NETWORK_BANDWIDTH_GBPS))
+
+
+class ResourceSpec:
+    """Parsed cluster description.
+
+    Construct from a yaml file path (``ResourceSpec("spec.yml")``), a dict
+    (``ResourceSpec.from_dict``), or the local process's visible devices
+    (``ResourceSpec.from_local``).
+    """
+
+    def __init__(self, resource_file: Optional[str] = None):
+        self._nodes: "Dict[str, _Node]" = {}
+        self._ssh_config_map = SSHConfigMap({}, {})
+        self._chief_address: Optional[str] = None
+        self._slice_info: dict = {}
+        if resource_file is not None:
+            if not os.path.isfile(resource_file):
+                raise FileNotFoundError("resource spec file not found: %s" % resource_file)
+            with open(resource_file, "r") as f:
+                self._from_dict(yaml.safe_load(f) or {})
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ResourceSpec":
+        spec = cls()
+        spec._from_dict(d)
+        return spec
+
+    @classmethod
+    def from_local(cls) -> "ResourceSpec":
+        """Build a single-node spec from the local JAX runtime's devices."""
+        import jax
+        n = len(jax.local_devices())
+        kind = jax.local_devices()[0].platform.upper() if n else "CPU"
+        d = {"nodes": [{"address": "127.0.0.1", "chief": True,
+                        "tpus": n if kind != "CPU" else 0,
+                        "cpus": list(range(n if kind == "CPU" else 1))}]}
+        return cls.from_dict(d)
+
+    def _from_dict(self, d: dict):
+        nodes = d.get("nodes", [])
+        if not nodes:
+            raise ValueError("resource spec has no nodes")
+        node_groups = {}
+        for entry in nodes:
+            node = _Node(entry)
+            if node.address in self._nodes:
+                raise ValueError("duplicate node address: %s" % node.address)
+            self._nodes[node.address] = node
+            if node.ssh_config:
+                node_groups[node.address] = node.ssh_config
+            if node.chief:
+                if self._chief_address is not None:
+                    raise ValueError("multiple chief nodes")
+                self._chief_address = node.address
+        if self._chief_address is None:
+            # single-node clusters don't need an explicit chief
+            if len(self._nodes) == 1:
+                self._chief_address = next(iter(self._nodes))
+            else:
+                raise ValueError("multi-node resource spec must mark one node chief: true")
+        self._ssh_config_map = SSHConfigMap(d.get("ssh", {}), node_groups)
+        self._slice_info = dict(d.get("slice", {}))
+        logging.debug("ResourceSpec: %d nodes, chief=%s", len(self._nodes), self._chief_address)
+
+    # ------------------------------------------------------------------ props
+
+    @property
+    def chief(self) -> str:
+        return self._chief_address
+
+    @property
+    def node_addresses(self) -> List[str]:
+        return sorted(self._nodes.keys())
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self._nodes)
+
+    @property
+    def tpu_devices(self) -> List[DeviceSpec]:
+        out = []
+        for addr in self.node_addresses:
+            for idx in self._nodes[addr].tpu_indices:
+                out.append(DeviceSpec(addr, DeviceType.TPU, idx))
+        return out
+
+    @property
+    def cpu_devices(self) -> List[DeviceSpec]:
+        out = []
+        for addr in self.node_addresses:
+            for idx in self._nodes[addr].cpu_indices:
+                out.append(DeviceSpec(addr, DeviceType.CPU, idx))
+        return out
+
+    @property
+    def devices(self) -> List[DeviceSpec]:
+        """All compute devices: TPU chips where present, else CPUs (so
+        CPU-only specs still run the full strategy path, mirroring the
+        reference's r2/r5 CPU-only specs)."""
+        out = []
+        for addr in self.node_addresses:
+            node = self._nodes[addr]
+            if node.tpu_indices:
+                out.extend(DeviceSpec(addr, DeviceType.TPU, i) for i in node.tpu_indices)
+            else:
+                out.extend(DeviceSpec(addr, DeviceType.CPU, i) for i in node.cpu_indices)
+        return out
+
+    @property
+    def num_tpus(self) -> int:
+        return len(self.tpu_devices)
+
+    @property
+    def ssh_config_map(self) -> SSHConfigMap:
+        return self._ssh_config_map
+
+    @property
+    def slice_info(self) -> dict:
+        return self._slice_info
+
+    def network_bandwidth_gbps(self, address: str) -> float:
+        return self._nodes[address].network_bandwidth_gbps
+
+    def ici_bandwidth_gbps(self) -> float:
+        return float(self._slice_info.get("ici_bandwidth", DEFAULT_ICI_BANDWIDTH_GBPS))
+
+    def node_tpu_count(self, address: str) -> int:
+        return len(self._nodes[address].tpu_indices)
+
+    def node_cpu_count(self, address: str) -> int:
+        return len(self._nodes[address].cpu_indices)
+
+    def is_single_node(self) -> bool:
+        return len(self._nodes) == 1
+
+    def __repr__(self):
+        return "ResourceSpec(nodes=%s, chief=%s, tpus=%d)" % (
+            self.node_addresses, self.chief, self.num_tpus)

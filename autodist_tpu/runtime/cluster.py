@@ -1,0 +1,170 @@
+"""Cluster — multi-host process management.
+
+Analog of reference ``autodist/cluster.py:51-374`` (``Cluster``/``SSHCluster``).
+The reference builds a TF ClusterSpec with deterministic sorted port
+assignment, starts a ``tf.distribute.Server`` per node (local Popen for the
+chief, SSH for remotes), and SIGTERMs process groups at exit. On TPU there
+is no separate server process: each worker *client* process joins the JAX
+distributed runtime (``jax.distributed.initialize``) and the TPU runtime's
+coordination service (hosted by process 0) replaces the gRPC server mesh.
+What remains of the Cluster is:
+
+- the deterministic process layout: sorted node addresses -> process ids
+  (the determinism the reference gets from sorted ip:port ordering,
+  ``cluster.py:70-82``),
+- the deployment plane: SSH/SCP helpers to ship files and launch remote
+  commands (reference ``cluster.py:316-374``), honoring ``ADT_DEBUG_REMOTE``
+  for dry-runs exactly like ``AUTODIST_DEBUG_REMOTE``
+  (reference ``cluster.py:340-341``),
+- teardown: terminating launched remote processes at exit
+  (reference ``cluster.py:176,212-216``).
+"""
+import atexit
+import os
+import shlex
+import signal
+import subprocess
+from typing import Dict, List, Optional
+
+from autodist_tpu import const
+from autodist_tpu.resource_spec import ResourceSpec, SSHConfig
+from autodist_tpu.utils import logging
+
+
+class Cluster:
+    """Process layout + lifecycle for one training job."""
+
+    def __init__(self, resource_spec: ResourceSpec,
+                 coordinator_port: int = const.DEFAULT_COORDINATOR_PORT):
+        self._spec = resource_spec
+        self._port = coordinator_port
+        # deterministic: chief first, then remaining addresses sorted
+        others = [a for a in resource_spec.node_addresses if a != resource_spec.chief]
+        self._process_addresses: List[str] = [resource_spec.chief] + others
+        self._procs: List[subprocess.Popen] = []
+        self._started = False
+
+    # ------------------------------------------------------------- layout
+
+    @property
+    def num_processes(self) -> int:
+        return len(self._process_addresses)
+
+    @property
+    def coordinator_address(self) -> str:
+        return "%s:%d" % (self._spec.chief, self._port)
+
+    def process_id(self, address: str) -> int:
+        return self._process_addresses.index(address)
+
+    @property
+    def process_addresses(self) -> List[str]:
+        return list(self._process_addresses)
+
+    def is_chief(self, address: Optional[str] = None) -> bool:
+        if address is None:
+            return const.is_chief()
+        return address == self._spec.chief
+
+    def worker_env(self, address: str) -> Dict[str, str]:
+        """Env vars that turn a launched script into worker ``address``."""
+        return {
+            const.ENV.ADT_WORKER.name_str: address,
+            const.ENV.ADT_COORDINATOR_ADDR.name_str: self.coordinator_address,
+            const.ENV.ADT_NUM_PROCESSES.name_str: str(self.num_processes),
+            const.ENV.ADT_PROCESS_ID.name_str: str(self.process_id(address)),
+        }
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self):
+        """Initialize the distributed runtime on the chief. Workers join via
+        ``server_starter.maybe_init_distributed`` when their (relaunched)
+        script constructs AutoDist."""
+        if self._started:
+            return
+        from autodist_tpu.runtime import server_starter
+        server_starter.init_distributed(
+            coordinator_address=self.coordinator_address,
+            num_processes=self.num_processes,
+            process_id=self.process_id(
+                const.ENV.ADT_WORKER.val or self._spec.chief))
+        atexit.register(self.terminate)
+        self._started = True
+
+    def terminate(self):
+        """SIGTERM launched remote process groups (reference ``cluster.py:176``)."""
+        for p in self._procs:
+            if p.poll() is None:
+                try:
+                    os.killpg(os.getpgid(p.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError, OSError):
+                    p.terminate()
+        self._procs.clear()
+
+    # ------------------------------------------------------ remote helpers
+
+    def _ssh_base(self, address: str) -> List[str]:
+        conf: Optional[SSHConfig] = self._spec.ssh_config_map.for_host(address)
+        cmd = ["ssh", "-oStrictHostKeyChecking=no", "-oBatchMode=yes"]
+        if conf:
+            if conf.key_file:
+                cmd += ["-i", conf.key_file]
+            if conf.port != 22:
+                cmd += ["-p", str(conf.port)]
+            target = ("%s@%s" % (conf.username, address)) if conf.username else address
+        else:
+            target = address
+        return cmd + [target]
+
+    def remote_exec(self, command: str, address: str,
+                    env: Optional[Dict[str, str]] = None,
+                    wait: bool = False) -> Optional[subprocess.Popen]:
+        """Launch a shell command on a remote node. ``wait=False`` returns the
+        local ssh Popen (tracked for exit-time SIGTERM); ``wait=True`` blocks
+        until completion and tracks nothing. Dry-run under ADT_DEBUG_REMOTE."""
+        conf = self._spec.ssh_config_map.for_host(address)
+        env_prefix = ""
+        merged = dict(conf.env) if conf else {}
+        merged.update(env or {})
+        if merged:
+            env_prefix = " ".join("%s=%s" % (k, shlex.quote(str(v)))
+                                  for k, v in sorted(merged.items())) + " "
+        venv = ("source %s/bin/activate && " % conf.python_venv
+                if conf and conf.python_venv else "")
+        full = self._ssh_base(address) + [
+            "bash -c %s" % shlex.quote(venv + env_prefix + command)]
+        logging.info("remote_exec[%s]: %s", address, " ".join(full))
+        if const.ENV.ADT_DEBUG_REMOTE.val:
+            return None
+        if wait:
+            subprocess.run(full, check=False)
+            return None
+        proc = subprocess.Popen(full, preexec_fn=os.setsid)
+        self._procs.append(proc)
+        return proc
+
+    def remote_copy(self, local_path: str, remote_dir: str, address: str) -> bool:
+        """SCP a file to a remote node (reference ``remote_copy``)."""
+        conf = self._spec.ssh_config_map.for_host(address)
+        cmd = ["scp", "-oStrictHostKeyChecking=no", "-oBatchMode=yes"]
+        if conf:
+            if conf.key_file:
+                cmd += ["-i", conf.key_file]
+            if conf.port != 22:
+                cmd += ["-P", str(conf.port)]
+            target = ("%s@%s" % (conf.username, address)) if conf.username else address
+        else:
+            target = address
+        self.remote_exec("mkdir -p %s" % shlex.quote(remote_dir), address,
+                         wait=True)
+        cmd += [local_path, "%s:%s/" % (target, remote_dir)]
+        logging.info("remote_copy[%s]: %s", address, " ".join(cmd))
+        if const.ENV.ADT_DEBUG_REMOTE.val:
+            return True
+        return subprocess.run(cmd, check=False).returncode == 0
+
+
+class SSHCluster(Cluster):
+    """Named alias mirroring the reference's concrete class
+    (``cluster.py:271-374``); all SSH mechanics live in Cluster."""

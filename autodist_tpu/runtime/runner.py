@@ -1,0 +1,92 @@
+"""Runner / WrappedSession — steady-state execution.
+
+Analog of reference ``autodist/runner.py:78-132``. The reference's
+``WrappedSession`` targets the local gRPC TF server, auto-runs initializers,
+and routes ``run`` through the Remapper; here the "session" owns the
+TrainState, routes feeds/fetches through the Remapper, and invokes the
+jitted SPMD step (JAX dispatch to the TPU runtime replaces the gRPC session
+client). Step tracing (the reference's chrome-trace dump,
+``runner.py:66-75,123-131``) maps to ``jax.profiler`` traces written under
+``/tmp/autodist_tpu/traces``.
+"""
+import os
+import time
+from typing import Any, Optional
+
+import jax
+
+from autodist_tpu import const
+from autodist_tpu.remapper import Remapper
+from autodist_tpu.train_state import TrainState
+from autodist_tpu.utils import logging
+
+
+class Runner:
+    """Owns a DistributedStep + TrainState and runs steps."""
+
+    def __init__(self, distributed_step, tracing: bool = False):
+        self._dstep = distributed_step
+        self._remapper = Remapper(distributed_step.mesh, distributed_step.mesh_axis)
+        self._tracing = tracing
+        self._trace_started = False
+        self.state: Optional[TrainState] = None
+
+    @property
+    def distributed_step(self):
+        return self._dstep
+
+    @property
+    def remapper(self):
+        return self._remapper
+
+    def init(self, params, opt_state=None) -> TrainState:
+        """Initialize distributed state (the reference's auto-run of
+        initializers on session creation, ``runner.py:97-100``)."""
+        self.state = self._dstep.init_state(params, opt_state)
+        return self.state
+
+    def run(self, batch, state: Optional[TrainState] = None) -> Any:
+        """One training step on a host-global batch; returns host metrics."""
+        st = state if state is not None else self.state
+        if st is None:
+            raise RuntimeError("Runner.run before init()")
+        sharded_batch = self._remapper.remap_feed(batch)
+        if self._tracing and not self._trace_started:
+            os.makedirs(const.DEFAULT_TRACE_DIR, exist_ok=True)
+            jax.profiler.start_trace(os.path.join(
+                const.DEFAULT_TRACE_DIR, time.strftime("%Y%m%d-%H%M%S")))
+            self._trace_started = True
+        # donate only the Runner-owned state; an explicitly-passed state is a
+        # caller reference that must stay valid
+        new_state, metrics = self._dstep(st, sharded_batch, donate=state is None)
+        if state is None:
+            self.state = new_state
+        if self._tracing and self._trace_started:
+            jax.block_until_ready(metrics)
+            jax.profiler.stop_trace()
+            self._trace_started = False
+            self._tracing = False  # trace only the first step, like FULL_TRACE runs
+        host_metrics = self._remapper.remap_fetch(metrics)
+        return (new_state, host_metrics) if state is not None else host_metrics
+
+    def gather_params(self):
+        return self._dstep.gather_params(self.state)
+
+
+class WrappedSession:
+    """Thin session facade over Runner for reference-style ergonomics
+    (``session.run(feed)`` loops)."""
+
+    def __init__(self, runner: Runner):
+        self._runner = runner
+
+    def run(self, feed_dict=None, **kwargs):
+        batch = feed_dict if feed_dict is not None else kwargs
+        return self._runner.run(batch)
+
+    @property
+    def state(self):
+        return self._runner.state
+
+    def gather_params(self):
+        return self._runner.gather_params()

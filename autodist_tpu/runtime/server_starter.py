@@ -1,0 +1,78 @@
+"""Distributed-runtime bring-up for one process.
+
+Analog of reference ``autodist/utils/server_starter.py:50-76``: where the
+reference runs a standalone ``tf.distribute.Server`` per node (with NCCL
+collectives and a group leader), on TPU every worker process joins the JAX
+distributed runtime directly — process 0 hosts the coordination service
+(the group-leader role, reference ``const.py:52``), and XLA's ICI/DCN
+collectives replace the gRPC/NCCL data plane. Stale-server cleanup
+(reference ``:29-46``) maps to clearing a crashed coordination service's
+port before rebinding.
+"""
+import os
+import signal
+import subprocess
+
+from autodist_tpu import const
+from autodist_tpu.utils import logging
+
+_INITIALIZED = False
+
+
+def init_distributed(coordinator_address: str, num_processes: int,
+                     process_id: int):
+    """Join the JAX distributed runtime (idempotent)."""
+    global _INITIALIZED
+    if _INITIALIZED or num_processes <= 1:
+        return
+    import jax
+    logging.info("jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
+                 coordinator_address, num_processes, process_id)
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _INITIALIZED = True
+
+
+def maybe_init_distributed():
+    """Worker-side auto-join from the env the Coordinator set
+    (chief side passes explicit args via Cluster.start)."""
+    addr = const.ENV.ADT_COORDINATOR_ADDR.val
+    n = const.ENV.ADT_NUM_PROCESSES.val
+    if addr and n > 1:
+        init_distributed(addr, n, const.ENV.ADT_PROCESS_ID.val)
+
+
+def clean_stale_servers(script_name: str = "server_starter"):
+    """Kill leftover processes from a crashed previous run
+    (reference ``server_starter.py:29-46``)."""
+    me = os.getpid()
+    try:
+        out = subprocess.run(["pgrep", "-f", script_name], check=False,
+                             capture_output=True, text=True).stdout
+    except FileNotFoundError:
+        return
+    for line in out.split():
+        pid = int(line)
+        if pid != me:
+            try:
+                os.kill(pid, signal.SIGTERM)
+                logging.info("killed stale process %d", pid)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+
+def main():  # CLI parity with the reference's per-node starter
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--coordinator_address", required=True)
+    parser.add_argument("--num_processes", type=int, required=True)
+    parser.add_argument("--process_id", type=int, required=True)
+    args = parser.parse_args()
+    clean_stale_servers()
+    init_distributed(args.coordinator_address, args.num_processes, args.process_id)
+    signal.pause()  # join() forever, like the reference server
+
+
+if __name__ == "__main__":
+    main()

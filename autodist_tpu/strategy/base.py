@@ -1,0 +1,268 @@
+"""Strategy intermediate representation + builder/compiler base classes.
+
+Analog of reference ``autodist/strategy/base.py`` and the protobuf schemas
+``proto/strategy.proto:31-69`` / ``proto/synchronizers.proto``. The Strategy
+is the contract between the frontend (builders, pure functions of
+(ModelItem, ResourceSpec)) and the backend lowering
+(``autodist_tpu/parallel/lowering.py``): per-variable it says how to
+synchronize gradients (PS or AllReduce, with partitioning, staleness,
+compression, grouping), and per-graph which devices carry data-parallel
+replicas.
+
+Serialization is JSON on disk under ``/tmp/autodist_tpu/strategies/<id>``
+(the reference serializes protobuf under ``/tmp/autodist/strategies``,
+reference ``strategy/base.py:78-99``) so the chief can write a strategy and
+every worker can load the identical bytes — all processes then lower the same
+plan independently, exactly the reference's
+"every node transforms its own graph" architecture
+(reference ``docs/design/architecture.rst:43-47``).
+"""
+import dataclasses
+import datetime
+import json
+import os
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional, Union
+
+from autodist_tpu import const
+from autodist_tpu.utils import logging
+
+
+# ------------------------------------------------------------- synchronizers
+
+
+@dataclasses.dataclass
+class PSSynchronizer:
+    """Parameter-server sync config (reference ``synchronizers.proto:26-31``).
+
+    On TPU, ``reduction_destination`` names the device that *owns* the
+    variable's update computation; gradients are reduced to the owner and the
+    updated value is re-broadcast (or cached via proxy, see
+    ``parallel/ps.py``)."""
+    reduction_destination: str = ""
+    local_replication: bool = False
+    sync: bool = True
+    staleness: int = 0
+
+    kind = "PS"
+
+    def to_dict(self):
+        return {"kind": self.kind, "reduction_destination": self.reduction_destination,
+                "local_replication": self.local_replication, "sync": self.sync,
+                "staleness": self.staleness}
+
+
+@dataclasses.dataclass
+class AllReduceSynchronizer:
+    """All-reduce sync config (reference ``synchronizers.proto:37-57``).
+
+    ``spec`` is the communication hint: AUTO lets XLA choose; ICI pins the
+    reduce to the intra-slice interconnect; DCN to the cross-slice network
+    (the reference's AUTO/NCCL/RING map onto AUTO/ICI/ICI).
+    ``compressor`` names a class in ``parallel/compression.py``. ``group``
+    buckets small all-reduces together (the reference feeds this to the
+    ScopedAllocator grappler pass, ``all_reduce_strategy.py:60-67``; we feed
+    it to our own gradient bucketing in ``parallel/collectives.py``)."""
+    spec: str = "AUTO"        # AUTO | ICI | DCN (NCCL/RING accepted as aliases)
+    compressor: str = "NoneCompressor"
+    group: int = 0
+
+    kind = "AllReduce"
+
+    _SPEC_ALIASES = {"NCCL": "ICI", "RING": "ICI"}
+
+    def __post_init__(self):
+        self.spec = self._SPEC_ALIASES.get(self.spec, self.spec)
+
+    def to_dict(self):
+        return {"kind": self.kind, "spec": self.spec,
+                "compressor": self.compressor, "group": self.group}
+
+
+Synchronizer = Union[PSSynchronizer, AllReduceSynchronizer]
+
+
+def synchronizer_from_dict(d: dict) -> Synchronizer:
+    d = dict(d)
+    kind = d.pop("kind")
+    if kind == "PS":
+        return PSSynchronizer(**d)
+    if kind == "AllReduce":
+        return AllReduceSynchronizer(**d)
+    raise ValueError("unknown synchronizer kind: %s" % kind)
+
+
+# ------------------------------------------------------------------- nodes
+
+
+@dataclasses.dataclass
+class VarConfig:
+    """Per-variable strategy node (reference ``strategy.proto:36-49`` Node).
+
+    ``partitioner`` is a comma-joined per-axis shard-count string like
+    ``"4,1"`` (reference ``kernel/partitioner.py:38-150`` PartitionerConfig);
+    when set, ``part_configs`` holds one VarConfig per shard. ``shard_sizes``
+    supports uneven partitioning (sizes along the split axis)."""
+    var_name: str
+    synchronizer: Optional[Synchronizer] = None
+    partitioner: Optional[str] = None
+    part_configs: List["VarConfig"] = dataclasses.field(default_factory=list)
+    shard_sizes: Optional[List[int]] = None
+
+    @property
+    def partition_axis(self) -> Optional[int]:
+        if not self.partitioner:
+            return None
+        counts = [int(x) for x in self.partitioner.split(",")]
+        for ax, c in enumerate(counts):
+            if c > 1:
+                return ax
+        return None
+
+    @property
+    def num_shards(self) -> int:
+        if not self.partitioner:
+            return 1
+        counts = [int(x) for x in self.partitioner.split(",")]
+        n = 1
+        for c in counts:
+            n *= c
+        return n
+
+    def to_dict(self):
+        return {
+            "var_name": self.var_name,
+            "synchronizer": self.synchronizer.to_dict() if self.synchronizer else None,
+            "partitioner": self.partitioner,
+            "part_configs": [p.to_dict() for p in self.part_configs],
+            "shard_sizes": self.shard_sizes,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "VarConfig":
+        return cls(
+            var_name=d["var_name"],
+            synchronizer=synchronizer_from_dict(d["synchronizer"]) if d.get("synchronizer") else None,
+            partitioner=d.get("partitioner"),
+            part_configs=[cls.from_dict(p) for p in d.get("part_configs", [])],
+            shard_sizes=d.get("shard_sizes"),
+        )
+
+
+@dataclasses.dataclass
+class GraphConfig:
+    """Graph-level config (reference ``strategy.proto:60-69``): the replica
+    devices (data-parallel axis) plus TPU-native mesh extensions the
+    reference anticipated but never grew (``strategy.proto:36-41``)."""
+    replicas: List[str] = dataclasses.field(default_factory=list)
+    # extension axes beyond the reference (tensor/pipeline/sequence/expert)
+    mesh_shape: Optional[Dict[str, int]] = None
+
+    def to_dict(self):
+        return {"replicas": list(self.replicas), "mesh_shape": self.mesh_shape}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(replicas=list(d.get("replicas", [])), mesh_shape=d.get("mesh_shape"))
+
+
+# ----------------------------------------------------------------- strategy
+
+
+class Strategy:
+    """The per-variable distribution plan (reference ``strategy/base.py:28-99``)."""
+
+    def __init__(self, node_config: Optional[List[VarConfig]] = None,
+                 graph_config: Optional[GraphConfig] = None,
+                 strategy_id: Optional[str] = None):
+        self.id = strategy_id or datetime.datetime.now(
+            datetime.timezone.utc).strftime("%Y%m%dT%H%M%S%f")
+        self.node_config: List[VarConfig] = node_config or []
+        self.graph_config: GraphConfig = graph_config or GraphConfig()
+
+    def to_dict(self) -> dict:
+        return {"id": self.id,
+                "node_config": [n.to_dict() for n in self.node_config],
+                "graph_config": self.graph_config.to_dict()}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Strategy":
+        return cls(node_config=[VarConfig.from_dict(n) for n in d.get("node_config", [])],
+                   graph_config=GraphConfig.from_dict(d.get("graph_config", {})),
+                   strategy_id=d.get("id"))
+
+    def serialize(self, path: Optional[str] = None) -> str:
+        if path is None:
+            os.makedirs(const.DEFAULT_SERIALIZATION_DIR, exist_ok=True)
+            path = os.path.join(const.DEFAULT_SERIALIZATION_DIR, self.id)
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, sort_keys=True, indent=1)
+        return path
+
+    @classmethod
+    def deserialize(cls, strategy_id: Optional[str] = None, path: Optional[str] = None) -> "Strategy":
+        if path is None:
+            path = os.path.join(const.DEFAULT_SERIALIZATION_DIR, strategy_id)
+        with open(path, "r") as f:
+            return cls.from_dict(json.load(f))
+
+    def find(self, var_name: str) -> Optional[VarConfig]:
+        for n in self.node_config:
+            if n.var_name == var_name:
+                return n
+        return None
+
+    def __repr__(self):
+        return "Strategy(id=%s, vars=%d, replicas=%d)" % (
+            self.id, len(self.node_config), len(self.graph_config.replicas))
+
+    def __str__(self):
+        return json.dumps(self.to_dict(), indent=1, sort_keys=True)
+
+
+# ------------------------------------------------------------------ builder
+
+
+class StrategyBuilder(ABC):
+    """ABC for strategy builders (reference ``strategy/base.py:102-117``).
+
+    Builders are pure functions of (ModelItem, ResourceSpec) -> Strategy."""
+
+    @abstractmethod
+    def build(self, model_item, resource_spec) -> Strategy:
+        ...
+
+
+class StrategyCompiler:
+    """Resolves a Strategy against concrete cluster devices
+    (reference ``strategy/base.py:120-168`` + ``kernel/device/resolver.py``):
+    prunes configs for variables that no longer exist / aren't trainable and
+    resolves device name strings."""
+
+    def __init__(self, model_item, resource_spec):
+        self._item = model_item
+        self._spec = resource_spec
+
+    def compile(self, strategy: Strategy) -> Strategy:
+        from autodist_tpu.kernel.device.resolver import DeviceResolver
+        resolver = DeviceResolver(self._spec)
+        known = set(self._item.trainable_var_names)
+        pruned = []
+        for node in strategy.node_config:
+            if node.var_name not in known:
+                logging.debug("StrategyCompiler: pruning config for unknown var %s", node.var_name)
+                continue
+            if isinstance(node.synchronizer, PSSynchronizer) and node.synchronizer.reduction_destination:
+                node.synchronizer.reduction_destination = resolver.resolve(
+                    node.synchronizer.reduction_destination)
+            for part in node.part_configs:
+                if isinstance(part.synchronizer, PSSynchronizer) and part.synchronizer.reduction_destination:
+                    part.synchronizer.reduction_destination = resolver.resolve(
+                        part.synchronizer.reduction_destination)
+            pruned.append(node)
+        strategy.node_config = pruned
+        strategy.graph_config.replicas = [resolver.resolve(r) for r in strategy.graph_config.replicas]
+        missing = known - {n.var_name for n in pruned}
+        if missing:
+            raise ValueError("strategy has no config for trainable vars: %s" % sorted(missing))
+        return strategy

@@ -1,0 +1,43 @@
+"""PS strategy: every variable synchronized through a single parameter server.
+
+Analog of reference ``autodist/strategy/ps_strategy.py:38-55``: all vars get a
+``PSSynchronizer`` whose reduction destination is the first node's host CPU;
+replicas are all compute devices (TPU chips; on chip-less nodes, CPUs —
+mirroring "CPU-only nodes contribute CPUs").
+"""
+from autodist_tpu.strategy.base import (GraphConfig, PSSynchronizer, Strategy,
+                                        StrategyBuilder, VarConfig)
+
+
+def reduction_devices(resource_spec):
+    """One host-CPU reduction device per node (PS candidates)."""
+    return ["%s:CPU:0" % addr for addr in resource_spec.node_addresses]
+
+
+def replica_devices(resource_spec):
+    return [d.name_string() for d in resource_spec.devices]
+
+
+class PS(StrategyBuilder):
+    def __init__(self, local_proxy_variable: bool = False, sync: bool = True,
+                 staleness: int = 0):
+        self._local_proxy_variable = local_proxy_variable
+        self._sync = sync
+        self._staleness = staleness
+        if staleness > 0:
+            assert sync, "staleness is only meaningful for sync training"
+
+    def build(self, model_item, resource_spec) -> Strategy:
+        destination = reduction_devices(resource_spec)[0]
+        nodes = [
+            VarConfig(
+                var_name=name,
+                synchronizer=PSSynchronizer(
+                    reduction_destination=destination,
+                    local_replication=self._local_proxy_variable,
+                    sync=self._sync,
+                    staleness=self._staleness))
+            for name in model_item.trainable_var_names
+        ]
+        return Strategy(node_config=nodes,
+                        graph_config=GraphConfig(replicas=replica_devices(resource_spec)))

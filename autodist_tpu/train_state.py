@@ -1,0 +1,19 @@
+"""Distributed train state.
+
+The runtime analog of the reference's transformed-graph variables: params and
+optimizer state live on the mesh in their strategy-assigned storage layout
+(replicated, or shard-per-device for partitioned variables), plus
+``sync_state`` carrying stateful gradient-compressor residuals (error
+feedback, PowerSGD factors — per-device, stored with a leading device axis).
+"""
+from typing import Any
+
+import flax.struct
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: Any
+    params: Any
+    opt_state: Any
+    sync_state: Any

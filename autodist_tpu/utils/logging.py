@@ -1,0 +1,66 @@
+"""Framework logger.
+
+Analog of reference ``autodist/utils/logging.py:80-107``: a dedicated
+``autodist_tpu`` logger with PID+file+line formatting, writing to both stderr
+and a per-run file under ``/tmp/autodist_tpu/logs/<timestamp>.log``; level
+taken from the ``ADT_MIN_LOG_LEVEL`` env var.
+"""
+import logging as _logging
+import os
+import sys
+import time
+import threading
+
+from autodist_tpu import const
+
+_logger = None
+_logger_lock = threading.Lock()
+
+_FMT = "%(asctime)s %(levelname).1s %(process)d %(filename)s:%(lineno)d] %(message)s"
+
+
+def get_logger() -> _logging.Logger:
+    global _logger
+    if _logger is not None:
+        return _logger
+    with _logger_lock:
+        if _logger is not None:
+            return _logger
+        logger = _logging.getLogger("autodist_tpu")
+        logger.propagate = False
+        level = const.ENV.ADT_MIN_LOG_LEVEL.val.upper()
+        logger.setLevel(getattr(_logging, level, _logging.INFO))
+        fmt = _logging.Formatter(_FMT)
+        sh = _logging.StreamHandler(sys.stderr)
+        sh.setFormatter(fmt)
+        logger.addHandler(sh)
+        try:
+            os.makedirs(const.DEFAULT_LOG_DIR, exist_ok=True)
+            path = os.path.join(const.DEFAULT_LOG_DIR, "%d-%d.log" % (int(time.time()), os.getpid()))
+            fh = _logging.FileHandler(path)
+            fh.setFormatter(fmt)
+            logger.addHandler(fh)
+        except OSError:
+            pass
+        _logger = logger
+        return logger
+
+
+def debug(msg, *args, **kw):
+    get_logger().debug(msg, *args, stacklevel=2, **kw)
+
+
+def info(msg, *args, **kw):
+    get_logger().info(msg, *args, stacklevel=2, **kw)
+
+
+def warning(msg, *args, **kw):
+    get_logger().warning(msg, *args, stacklevel=2, **kw)
+
+
+def error(msg, *args, **kw):
+    get_logger().error(msg, *args, stacklevel=2, **kw)
+
+
+def set_verbosity(level: str):
+    get_logger().setLevel(getattr(_logging, level.upper(), _logging.INFO))

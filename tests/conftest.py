@@ -1,0 +1,42 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+The analog of the reference's CPU-only resource specs (r2/r5), which let the
+full strategy/transform path run with no accelerator
+(reference ``tests/integration/test_dist.py`` notes in SURVEY §4.3).
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The image's sitecustomize imports jax before this file runs, freezing the
+# JAX_PLATFORMS env default (axon); override through the config instead.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, "virtual 8-device CPU mesh not active"
+os.environ.setdefault("ADT_IS_TESTING", "1")
+
+import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption("--run-integration", action="store_true", default=False,
+                     help="run multi-process integration tests")
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--run-integration"):
+        return
+    skip = pytest.mark.skip(reason="needs --run-integration")
+    for item in items:
+        if "integration" in item.keywords:
+            item.add_marker(skip)
+
+
+@pytest.fixture(autouse=True)
+def _reset_autodist():
+    yield
+    import autodist_tpu
+    autodist_tpu.reset()

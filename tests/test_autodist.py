@@ -1,0 +1,17 @@
+"""AutoDist entry-object invariants (analog of reference ``tests/test_autodist.py``)."""
+import pytest
+
+import autodist_tpu
+
+
+def test_one_instance_per_process():
+    ad = autodist_tpu.AutoDist()
+    assert autodist_tpu.get_default_autodist() is ad
+    with pytest.raises(NotImplementedError):
+        autodist_tpu.AutoDist()
+
+
+def test_reset_allows_new_instance():
+    autodist_tpu.AutoDist()
+    autodist_tpu.reset()
+    autodist_tpu.AutoDist()  # no raise

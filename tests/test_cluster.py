@@ -1,0 +1,60 @@
+"""Cluster/Coordinator dry-run tests (ADT_DEBUG_REMOTE, the analog of the
+reference's AUTODIST_DEBUG_REMOTE suppressed-SSH tests)."""
+import os
+
+import pytest
+
+from autodist_tpu import const
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.runtime.cluster import SSHCluster
+
+
+@pytest.fixture(autouse=True)
+def _debug_remote():
+    os.environ[const.ENV.ADT_DEBUG_REMOTE.name_str] = "1"
+    yield
+    os.environ.pop(const.ENV.ADT_DEBUG_REMOTE.name_str, None)
+
+
+def _spec():
+    return ResourceSpec.from_dict({
+        "nodes": [
+            {"address": "10.0.0.2", "tpus": 4},
+            {"address": "10.0.0.1", "tpus": 4, "chief": True},
+        ],
+        "ssh": {"g": {"username": "u", "key_file": "/k"}},
+    })
+
+
+def test_deterministic_process_layout():
+    c = SSHCluster(_spec())
+    assert c.num_processes == 2
+    assert c.process_addresses == ["10.0.0.1", "10.0.0.2"]  # chief first
+    assert c.process_id("10.0.0.1") == 0
+    assert c.coordinator_address == "10.0.0.1:%d" % const.DEFAULT_COORDINATOR_PORT
+
+
+def test_worker_env():
+    c = SSHCluster(_spec())
+    env = c.worker_env("10.0.0.2")
+    assert env["ADT_WORKER"] == "10.0.0.2"
+    assert env["ADT_PROCESS_ID"] == "1"
+    assert env["ADT_NUM_PROCESSES"] == "2"
+    assert env["ADT_COORDINATOR_ADDR"] == c.coordinator_address
+
+
+def test_remote_exec_dry_run():
+    c = SSHCluster(_spec())
+    assert c.remote_exec("echo hi", "10.0.0.2", env={"A": "1"}) is None
+    assert c.remote_copy("/tmp/x", "/tmp/dir", "10.0.0.2") is True
+
+
+def test_coordinator_launch_dry_run(tmp_path):
+    from autodist_tpu.runtime.coordinator import Coordinator
+    from autodist_tpu.strategy.base import Strategy
+    s = Strategy()
+    s.serialize()
+    c = SSHCluster(_spec())
+    coord = Coordinator(s, c)
+    coord.launch_clients()  # dry-run: no processes spawned
+    coord.join()

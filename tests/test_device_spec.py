@@ -1,0 +1,21 @@
+"""DeviceSpec tests (analog of reference ``tests/test_device_spec.py``)."""
+from autodist_tpu.resource_spec import DeviceSpec, DeviceType
+
+
+def test_round_trip():
+    d = DeviceSpec("10.0.0.1", DeviceType.TPU, 3)
+    assert d.name_string() == "10.0.0.1:TPU:3"
+    assert DeviceSpec.from_string(d.name_string()) == d
+
+
+def test_from_string_forms():
+    assert DeviceSpec.from_string("host").device_type == DeviceType.CPU
+    assert DeviceSpec.from_string("host:2") == DeviceSpec("host", DeviceType.TPU, 2)
+    # reference-style GPU names normalize onto TPU
+    assert DeviceSpec.from_string("h:GPU:1") == DeviceSpec("h", DeviceType.TPU, 1)
+    assert DeviceSpec.from_string("h:CPU:0").device_type == DeviceType.CPU
+
+
+def test_hashable():
+    s = {DeviceSpec("a", DeviceType.TPU, 0), DeviceSpec("a", DeviceType.TPU, 0)}
+    assert len(s) == 1

@@ -1,0 +1,94 @@
+"""End-to-end numeric correctness on an 8-device mesh.
+
+The analog of reference ``tests/integration/cases/c0.py:92-121``: after one
+distributed step, the variable values must equal the hand-computed
+single-device update on the full global batch (mean of per-replica
+gradients == full-batch gradient), for EVERY builder — the strategy ×
+model coverage matrix of reference ``tests/integration/test_all.py:20-46``.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import autodist_tpu
+from autodist_tpu import strategy as S
+
+BUILDERS = [
+    ("PS", lambda: S.PS()),
+    ("PS_proxy", lambda: S.PS(local_proxy_variable=True)),
+    ("PSLoadBalancing", lambda: S.PSLoadBalancing()),
+    ("PartitionedPS", lambda: S.PartitionedPS()),
+    ("UnevenPartitionedPS", lambda: S.UnevenPartitionedPS()),
+    ("AllReduce", lambda: S.AllReduce(chunk_size=2)),
+    ("AllReduce_bf16", lambda: S.AllReduce(compressor="HorovodCompressor")),
+    ("PartitionedAR", lambda: S.PartitionedAR()),
+    ("RandomAxisPartitionAR", lambda: S.RandomAxisPartitionAR(seed=3)),
+    ("Parallax", lambda: S.Parallax()),
+]
+
+
+def _make_problem(seed=0):
+    rng = np.random.RandomState(seed)
+    params = {"w": jnp.asarray(rng.randn(4, 2).astype(np.float32)),
+              "b": jnp.zeros((2,), jnp.float32),
+              "emb": jnp.asarray(rng.randn(16, 4).astype(np.float32))}
+
+    def loss_fn(p, batch):
+        feat = jnp.take(p["emb"], batch["ids"], axis=0)  # [B, 4]
+        pred = feat @ p["w"] + p["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    batch = {"ids": rng.randint(0, 16, size=(16,)).astype(np.int32),
+             "y": rng.randn(16, 2).astype(np.float32)}
+    return params, loss_fn, batch
+
+
+def _single_device_reference(params, loss_fn, batch, opt):
+    grads = jax.grad(loss_fn)(params, batch)
+    updates, _ = opt.update(grads, opt.init(params), params)
+    return optax.apply_updates(params, updates)
+
+
+@pytest.mark.parametrize("name,make_builder", BUILDERS, ids=[b[0] for b in BUILDERS])
+def test_one_step_matches_single_device(name, make_builder):
+    params, loss_fn, batch = _make_problem()
+    opt = optax.sgd(0.1)
+    expected = _single_device_reference(params, loss_fn, batch, opt)
+
+    ad = autodist_tpu.AutoDist(strategy_builder=make_builder())
+    runner = ad.build(loss_fn, opt, params, batch)
+    runner.init(params)
+    metrics = runner.run(batch)
+    assert np.isfinite(metrics["loss"])
+
+    got = runner.gather_params()
+    tol = 2e-2 if "bf16" in name else 1e-5
+    for key in expected:
+        np.testing.assert_allclose(np.asarray(got[key]), np.asarray(expected[key]),
+                                   rtol=tol, atol=tol, err_msg="var %s" % key)
+    autodist_tpu.reset()
+
+
+def test_multiple_steps_decrease_loss():
+    params, loss_fn, batch = _make_problem()
+    ad = autodist_tpu.AutoDist(strategy_builder=S.AllReduce())
+    step = ad.function(loss_fn, optimizer=optax.adam(0.05), params=params)
+    losses = [step(batch)["loss"] for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_partitioned_state_is_actually_sharded():
+    """Partitioned vars must be stored sharded (padded) on the mesh."""
+    params, loss_fn, batch = _make_problem()
+    ad = autodist_tpu.AutoDist(strategy_builder=S.PartitionedAR())
+    runner = ad.build(loss_fn, optax.adam(0.1), params, batch)
+    runner.init(params)
+    layouts = runner.distributed_step.layouts
+    assert layouts["emb"].partitioned  # 16 rows over 8 devices
+    st_emb = runner.state.params["emb"]
+    assert st_emb.shape[0] == layouts["emb"].padded_dim
+    # each device holds 1/8 of the rows
+    shard_shape = st_emb.sharding.shard_shape(st_emb.shape)
+    assert shard_shape[0] == layouts["emb"].padded_dim // 8

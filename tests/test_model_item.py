@@ -1,0 +1,107 @@
+"""ModelItem capture tests.
+
+The key coverage mirror of reference ``tests/test_graph_item.py:54-84``: a
+matrix of optimizer configs, asserting variable/optimizer metadata capture
+finds every trainable variable; plus sparse (embedding) detection — the
+analog of the reference recognizing sparse update ops.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.kernel.common.variable_utils import match_state_to_var
+
+OPTIMIZER_CASES = [
+    ("sgd", lambda: optax.sgd(0.1)),
+    ("sgd_momentum", lambda: optax.sgd(0.1, momentum=0.9)),
+    ("sgd_nesterov", lambda: optax.sgd(0.1, momentum=0.9, nesterov=True)),
+    ("adam", lambda: optax.adam(1e-3)),
+    ("adamw", lambda: optax.adamw(1e-3)),
+    ("adagrad", lambda: optax.adagrad(0.1)),
+    ("adadelta", lambda: optax.adadelta(0.1)),
+    ("rmsprop", lambda: optax.rmsprop(0.01)),
+    ("rmsprop_momentum", lambda: optax.rmsprop(0.01, momentum=0.9)),
+    ("rmsprop_centered", lambda: optax.rmsprop(0.01, centered=True)),
+    ("lamb", lambda: optax.lamb(1e-3)),
+    ("lion", lambda: optax.lion(1e-4)),
+    ("nadam", lambda: optax.nadam(1e-3)),
+    ("adafactor", lambda: optax.adafactor(1e-3)),
+]
+
+
+def _params():
+    return {"dense": {"kernel": jnp.ones((4, 3)), "bias": jnp.zeros((3,))},
+            "out": {"kernel": jnp.ones((3, 1))}}
+
+
+def _loss(params, batch):
+    x, y = batch["x"], batch["y"]
+    h = jnp.tanh(x @ params["dense"]["kernel"] + params["dense"]["bias"])
+    pred = h @ params["out"]["kernel"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def _batch():
+    return {"x": np.ones((8, 4), np.float32), "y": np.zeros((8, 1), np.float32)}
+
+
+@pytest.mark.parametrize("name,make_opt", OPTIMIZER_CASES, ids=[c[0] for c in OPTIMIZER_CASES])
+def test_optimizer_matrix(name, make_opt):
+    """Every optimizer: capture succeeds, every trainable var is found, the
+    optimizer ctor info is recorded, and every var-shaped optimizer state
+    leaf maps back to its variable."""
+    opt = make_opt()
+    item = ModelItem(loss_fn=_loss, optimizer=opt, params=_params(),
+                     example_batch=_batch()).prepare()
+    assert sorted(item.trainable_var_names) == [
+        "dense/bias", "dense/kernel", "out/kernel"]
+    assert item.optimizer_name == name.split("_")[0]
+    # grads pair 1:1 with vars
+    loss, grads = item.grad_fn()(item.params, _batch())
+    assert jax.tree_util.tree_structure(grads) == jax.tree_util.tree_structure(item.params)
+    # opt state leaves match vars (adafactor factors states; skip its check)
+    if name == "adafactor":
+        return
+    state = opt.init(item.params)
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    for path, leaf in flat:
+        shape = getattr(leaf, "shape", ())
+        if tuple(shape) in {(4, 3), (3,), (3, 1)}:
+            from autodist_tpu.model_item import _normalize_path
+            var = match_state_to_var(_normalize_path(path), shape, item.var_infos)
+            assert var, "unmatched state leaf %s" % _normalize_path(path)
+
+
+def test_sparse_detection():
+    params = {"emb": {"table": jnp.ones((100, 8))},
+              "out": {"kernel": jnp.ones((8, 1))}}
+
+    def loss(p, batch):
+        e = jnp.take(p["emb"]["table"], batch["ids"], axis=0)
+        pred = jnp.sum(e, axis=1) @ p["out"]["kernel"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    batch = {"ids": np.zeros((4, 5), np.int32), "y": np.zeros((4, 1), np.float32)}
+    item = ModelItem(loss_fn=loss, optimizer=optax.sgd(0.1), params=params,
+                     example_batch=batch).prepare()
+    assert item.sparse_var_names == ["emb/table"]
+    assert item.var_infos["out/kernel"].sparse is False
+
+
+def test_var_info_byte_size():
+    item = ModelItem(loss_fn=_loss, optimizer=optax.sgd(0.1), params=_params(),
+                     example_batch=_batch()).prepare()
+    assert item.var_infos["dense/kernel"].byte_size == 4 * 3 * 4
+    assert item.total_bytes() == (12 + 3 + 3) * 4
+
+
+def test_spec_serialization_round_trip():
+    item = ModelItem(loss_fn=_loss, optimizer=optax.adam(1e-3), params=_params(),
+                     example_batch=_batch()).prepare()
+    spec = ModelItem.spec_from_bytes(item.serialize_spec())
+    assert spec["optimizer_name"] == "adam"
+    assert len(spec["vars"]) == 3
+    assert spec["mode"] == "loss_fn"

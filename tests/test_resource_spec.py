@@ -1,0 +1,74 @@
+"""ResourceSpec parsing tests (analog of reference ``tests/test_resource_spec.py``)."""
+import pytest
+
+from autodist_tpu.resource_spec import DeviceType, ResourceSpec
+
+SPEC_MULTI = """
+nodes:
+  - address: 10.0.0.1
+    tpus: 4
+    chief: true
+    ssh_config: conf
+    network_bandwidth: 100
+  - address: 10.0.0.2
+    tpus: 4
+    ssh_config: conf
+ssh:
+  conf:
+    username: tpu
+    key_file: /k
+    port: 2222
+slice:
+  type: v5e-8
+  ici_bandwidth: 400
+"""
+
+SPEC_CPU_ONLY = """
+nodes:
+  - address: 127.0.0.1
+    cpus: [0, 1]
+"""
+
+
+def _write(tmp_path, text):
+    p = tmp_path / "spec.yml"
+    p.write_text(text)
+    return str(p)
+
+
+def test_multi_node(tmp_path):
+    spec = ResourceSpec(_write(tmp_path, SPEC_MULTI))
+    assert spec.num_nodes == 2
+    assert spec.chief == "10.0.0.1"
+    assert spec.num_tpus == 8
+    assert [d.name_string() for d in spec.devices][:2] == ["10.0.0.1:TPU:0", "10.0.0.1:TPU:1"]
+    assert spec.network_bandwidth_gbps("10.0.0.1") == 100
+    assert spec.network_bandwidth_gbps("10.0.0.2") == 1  # default
+    assert spec.ici_bandwidth_gbps() == 400
+    conf = spec.ssh_config_map.for_host("10.0.0.2")
+    assert conf.username == "tpu" and conf.port == 2222
+
+
+def test_cpu_only(tmp_path):
+    spec = ResourceSpec(_write(tmp_path, SPEC_CPU_ONLY))
+    assert spec.num_tpus == 0
+    assert len(spec.devices) == 2
+    assert spec.devices[0].device_type == DeviceType.CPU
+    assert spec.chief == "127.0.0.1"  # single node auto-chief
+
+
+def test_gpu_synonym(tmp_path):
+    spec = ResourceSpec(_write(tmp_path, "nodes:\n  - address: a\n    gpus: 2\n"))
+    assert spec.num_tpus == 2
+
+
+def test_multi_node_requires_chief(tmp_path):
+    bad = "nodes:\n  - address: a\n    tpus: 1\n  - address: b\n    tpus: 1\n"
+    with pytest.raises(ValueError):
+        ResourceSpec(_write(tmp_path, bad))
+
+
+def test_from_local():
+    spec = ResourceSpec.from_local()
+    assert spec.is_single_node()
+    assert len(spec.devices) >= 1

@@ -1,0 +1,39 @@
+"""Strategy serialization round-trip (analog of reference ``tests/test_strategy_base.py``)."""
+from autodist_tpu.strategy.base import (AllReduceSynchronizer, GraphConfig,
+                                        PSSynchronizer, Strategy, VarConfig)
+
+
+def _sample():
+    return Strategy(
+        node_config=[
+            VarConfig("w", AllReduceSynchronizer(spec="AUTO", compressor="HorovodCompressor", group=1)),
+            VarConfig("emb", partitioner="2,1",
+                      part_configs=[
+                          VarConfig("emb/part_0", PSSynchronizer(reduction_destination="a:CPU:0")),
+                          VarConfig("emb/part_1", PSSynchronizer(reduction_destination="b:CPU:0")),
+                      ],
+                      shard_sizes=[3, 2]),
+        ],
+        graph_config=GraphConfig(replicas=["a:TPU:0", "a:TPU:1"]))
+
+
+def test_round_trip(tmp_path):
+    s = _sample()
+    path = s.serialize(str(tmp_path / "strat"))
+    s2 = Strategy.deserialize(path=path)
+    assert s2.to_dict() == s.to_dict()
+    assert s2.id == s.id
+
+
+def test_var_config_partition_props():
+    s = _sample()
+    node = s.find("emb")
+    assert node.partition_axis == 0
+    assert node.num_shards == 2
+    assert s.find("w").num_shards == 1
+    assert s.find("missing") is None
+
+
+def test_nccl_alias_normalizes():
+    ar = AllReduceSynchronizer(spec="NCCL")
+    assert ar.spec == "ICI"
