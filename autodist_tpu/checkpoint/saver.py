@@ -1,0 +1,155 @@
+"""Checkpoint saver — original-layout, framework-free restore.
+
+Analog of reference ``autodist/checkpoint/saver.py:28-133``. The reference's
+defining property (``saver.py:50-57``, ``docs/usage/tutorials/save-restore.md``):
+checkpoints are written in the *original single-device namespace*, so they
+load in vanilla TF with no AutoDist installed. Here the same contract:
+``Saver.save`` gathers partitioned variables back to their full unpadded
+shapes (``DistributedStep.gather_params``) and writes plain ``.npz`` files
+keyed by the slash-joined variable names — loadable with ``numpy.load``
+alone. Optimizer state is saved alongside (the reference saves slot
+variables through the same saver), so training resumes exactly; a vanilla
+consumer can ignore it.
+
+Chief-only saving for shared filesystems mirrors the ``IS_AUTODIST_CHIEF``
+gate (reference ``autodist/autodist.py:40-41``).
+"""
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from autodist_tpu import const
+from autodist_tpu.kernel.common import variable_utils
+from autodist_tpu.utils import logging
+
+
+def _tree_to_flat(tree) -> Dict[str, np.ndarray]:
+    names, leaves, _ = variable_utils.flatten_named(tree)
+    return {n: np.asarray(jax.device_get(l)) for n, l in zip(names, leaves)}
+
+
+def _flat_to_tree(template, flat: Dict[str, np.ndarray]):
+    names, leaves, treedef = variable_utils.flatten_named(template)
+    out = []
+    for n, leaf in zip(names, leaves):
+        if n not in flat:
+            raise KeyError("checkpoint missing variable %r" % n)
+        arr = flat[n]
+        want = tuple(getattr(leaf, "shape", ()))
+        if tuple(arr.shape) != want:
+            raise ValueError("checkpoint var %r has shape %s, model wants %s"
+                             % (n, arr.shape, want))
+        out.append(arr)
+    return variable_utils.unflatten_named(treedef, out)
+
+
+class Saver:
+    """Save/restore distributed training state in the original layout."""
+
+    def __init__(self, directory: Optional[str] = None, max_to_keep: int = 5,
+                 chief_only: bool = True):
+        self.directory = directory or const.DEFAULT_CHECKPOINT_DIR
+        self.max_to_keep = max_to_keep
+        self.chief_only = chief_only
+        os.makedirs(self.directory, exist_ok=True)
+
+    # ------------------------------------------------------------------ save
+
+    def save(self, runner_or_step, state=None, step: Optional[int] = None) -> Optional[str]:
+        """Write a checkpoint. Accepts a Runner (uses its state) or a
+        DistributedStep + explicit TrainState."""
+        if self.chief_only and not const.is_chief():
+            return None
+        if hasattr(runner_or_step, "distributed_step"):  # Runner
+            dstep = runner_or_step.distributed_step
+            state = state if state is not None else runner_or_step.state
+        else:
+            dstep = runner_or_step
+        if state is None:
+            raise ValueError("no state to save")
+        params = dstep.gather_params(state)
+        if step is None:
+            step = int(jax.device_get(state.step))
+        path = os.path.join(self.directory, "ckpt-%d" % step)
+        np.savez(path + ".params.npz", **_tree_to_flat(params))
+        # optimizer + sync state: gathered via the same replicated-jit trick
+        opt_state_host = self._gather_opt_state(dstep, state)
+        np.savez(path + ".opt.npz", **_tree_to_flat(opt_state_host))
+        meta = {"step": step, "format": "autodist_tpu.v1",
+                "strategy_id": dstep.strategy.id}
+        with open(path + ".meta.json", "w") as f:
+            json.dump(meta, f)
+        self._gc()
+        logging.info("saved checkpoint %s (step %d)", path, step)
+        return path
+
+    def _gather_opt_state(self, dstep, state):
+        """Optimizer state back to full (unpadded) original layout."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from autodist_tpu.kernel.partitioner import VarLayout
+        layout_tree = variable_utils.map_state_layouts(
+            state.opt_state, dstep.model_item.var_infos, dstep.layouts,
+            VarLayout(name=""))
+        rep = jax.tree_util.tree_map(
+            lambda _: NamedSharding(dstep.mesh, P()), state.opt_state)
+        gathered = jax.jit(
+            lambda s: jax.tree_util.tree_map(
+                lambda leaf, lay: lay.unpad(leaf), s, layout_tree),
+            out_shardings=rep)(state.opt_state)
+        return jax.device_get(gathered)
+
+    def _gc(self):
+        metas = sorted(
+            (f for f in os.listdir(self.directory) if f.endswith(".meta.json")),
+            key=lambda f: int(f.split("-")[1].split(".")[0]))
+        while len(metas) > self.max_to_keep:
+            victim = metas.pop(0).replace(".meta.json", "")
+            for suffix in (".meta.json", ".params.npz", ".opt.npz"):
+                try:
+                    os.remove(os.path.join(self.directory, victim + suffix))
+                except FileNotFoundError:
+                    pass
+
+    # --------------------------------------------------------------- restore
+
+    def latest(self) -> Optional[str]:
+        metas = [f for f in os.listdir(self.directory) if f.endswith(".meta.json")]
+        if not metas:
+            return None
+        newest = max(metas, key=lambda f: int(f.split("-")[1].split(".")[0]))
+        return os.path.join(self.directory, newest.replace(".meta.json", ""))
+
+    def restore_params(self, params_template, path: Optional[str] = None):
+        """Params pytree in the original layout — usable with or without the
+        framework (the vanilla-restore property)."""
+        path = path or self.latest()
+        if path is None:
+            raise FileNotFoundError("no checkpoint in %s" % self.directory)
+        flat = dict(np.load(path + ".params.npz"))
+        return _flat_to_tree(params_template, flat)
+
+    def restore(self, runner, path: Optional[str] = None) -> Tuple[Any, int]:
+        """Restore a Runner's distributed state; returns (state, step)."""
+        path = path or self.latest()
+        if path is None:
+            raise FileNotFoundError("no checkpoint in %s" % self.directory)
+        dstep = runner.distributed_step
+        params = self.restore_params(dstep.model_item.params, path)
+        opt_flat = dict(np.load(path + ".opt.npz"))
+        opt_template = dstep.model_item.optimizer.init(dstep.model_item.params)
+        opt_state = _flat_to_tree(opt_template, opt_flat)
+        state = dstep.init_state(params, opt_state)
+        with open(path + ".meta.json") as f:
+            step = json.load(f)["step"]
+        # advance the step counter to the saved step
+        from autodist_tpu.train_state import TrainState
+        state = TrainState(step=dstep._put(np.asarray(step, np.int32),
+                                           jax.sharding.PartitionSpec()),
+                           params=state.params, opt_state=state.opt_state,
+                           sync_state=state.sync_state)
+        runner.state = state
+        logging.info("restored checkpoint %s (step %d)", path, step)
+        return state, step

@@ -1,0 +1,60 @@
+"""Primitive-recognition tables.
+
+Analog of reference ``autodist/kernel/common/op_info.py`` — the tables of TF
+op types by which AutoDist *recognizes* structure generically (dense/sparse
+update ops ``:24-117``, queue/iterator ops ``:119-149``, mutable-state ops
+``:151-163``, control-flow ops ``:165-181``). In JAX the graph is a jaxpr
+and the same recognition works on primitive names: these tables drive
+sparse-variable detection (``model_item.detect_sparse_vars``) and the
+jaxpr-traversal utilities (``kernel/common/utils.py``).
+"""
+
+# Shape-preserving primitives through which variable identity is tracked
+# when looking for indexed reads (the reference's notion of a variable's
+# read op chain, ``common/variable_utils.py``).
+TRANSPARENT_PRIMITIVES = frozenset({
+    "reshape", "transpose", "convert_element_type", "squeeze",
+    "broadcast_in_dim", "copy", "stop_gradient", "slice", "rev",
+})
+
+# Primitives that perform an indexed (row-wise) read of their first operand
+# — the recognition behind "this variable has sparse gradients" (the
+# reference checks for IndexedSlices / sparse update op types, ``:73-117``).
+INDEXED_READ_PRIMITIVES = frozenset({"gather"})
+
+# Primitives that perform an indexed write (scatter family) — the analog of
+# the sparse update-op table (``:73-117``).
+INDEXED_UPDATE_PRIMITIVES = frozenset({
+    "scatter", "scatter-add", "scatter_add", "scatter_mul", "scatter_min",
+    "scatter_max",
+})
+
+# Cross-replica collectives (the analog of CollectiveReduce/Gather types).
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "all_gather", "all_to_all",
+    "reduce_scatter", "psum_scatter", "pgather", "axis_index",
+})
+
+# Structured-control-flow primitives (the analog of the while/cond op table,
+# ``:165-181``) — sub-jaxprs live in their params.
+CONTROL_FLOW_PRIMITIVES = frozenset({
+    "while", "cond", "scan", "pjit", "custom_jvp_call", "custom_vjp_call",
+    "custom_vjp_call_jaxpr", "remat", "checkpoint", "closed_call", "core_call",
+})
+
+# Primitives whose execution has side effects / ordering constraints (the
+# analog of the mutable-state & queue op tables, ``:119-163``).
+EFFECTFUL_PRIMITIVES = frozenset({
+    "io_callback", "pure_callback", "debug_callback", "infeed", "outfeed",
+})
+
+
+def sub_jaxprs(eqn):
+    """Yield the sub-jaxprs carried in an eqn's params (cond/scan/pjit...)."""
+    for val in eqn.params.values():
+        if hasattr(val, "jaxpr"):
+            yield val.jaxpr
+        elif isinstance(val, (list, tuple)):
+            for item in val:
+                if hasattr(item, "jaxpr"):
+                    yield item.jaxpr

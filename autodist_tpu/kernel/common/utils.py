@@ -1,0 +1,93 @@
+"""Jaxpr traversal toolbox.
+
+Analog of reference ``autodist/kernel/common/utils.py`` — the graph-surgery
+helpers (consumer queries ``:102-129``, BFS ``traverse``/``get_ancestors``
+``:132-187``, input rewiring ``:190-259``). Jaxprs are immutable, so there is
+no in-place rewiring; what transfers is the *query* half: producers,
+consumers, ancestor sets, and primitive search, recursing through
+control-flow sub-jaxprs. These power sparse detection today and future
+strategy passes (e.g. locating attention blocks for sequence parallelism).
+"""
+from collections import deque
+from typing import Callable, Dict, List, Set
+
+from autodist_tpu.kernel.common import op_info
+
+
+def _atom_vars(atoms):
+    return [a for a in atoms if not hasattr(a, "val")]  # drop Literals
+
+
+def producers(jaxpr) -> Dict[object, object]:
+    """Map each var to the eqn that produces it (None for invars)."""
+    out = {}
+    for eqn in jaxpr.eqns:
+        for v in eqn.outvars:
+            out[v] = eqn
+    return out
+
+
+def consumers(jaxpr, var) -> List[object]:
+    """Eqns that read ``var`` (reference ``get_consumers``, ``:102-115``)."""
+    return [eqn for eqn in jaxpr.eqns if var in _atom_vars(eqn.invars)]
+
+
+def get_ancestors(jaxpr, var) -> Set[object]:
+    """All vars reachable backwards from ``var``
+    (reference ``get_ancestors``, ``:150-187``)."""
+    prod = producers(jaxpr)
+    seen: Set[object] = set()
+    queue = deque([var])
+    while queue:
+        v = queue.popleft()
+        if v in seen:
+            continue
+        seen.add(v)
+        eqn = prod.get(v)
+        if eqn is not None:
+            queue.extend(_atom_vars(eqn.invars))
+    return seen
+
+
+def traverse(jaxpr, visit: Callable[[object], None], recursive: bool = True):
+    """BFS over eqns, optionally descending into control-flow sub-jaxprs
+    (reference ``traverse``, ``:132-148``)."""
+    queue = deque([jaxpr])
+    while queue:
+        jp = queue.popleft()
+        for eqn in jp.eqns:
+            visit(eqn)
+            if recursive:
+                queue.extend(op_info.sub_jaxprs(eqn))
+
+
+def find_primitives(jaxpr, names, recursive: bool = True) -> List[object]:
+    """All eqns whose primitive name is in ``names``."""
+    names = frozenset(names)
+    hits: List[object] = []
+    traverse(jaxpr, lambda eqn: hits.append(eqn)
+             if eqn.primitive.name in names else None, recursive)
+    return hits
+
+
+def uses_control_flow(jaxpr) -> bool:
+    return bool(find_primitives(jaxpr, op_info.CONTROL_FLOW_PRIMITIVES,
+                                recursive=False))
+
+
+def count_flops_estimate(jaxpr) -> int:
+    """Rough dot/conv FLOP count — used by the simulator's cost model."""
+    import numpy as np
+    total = 0
+
+    def visit(eqn):
+        nonlocal total
+        if eqn.primitive.name in ("dot_general", "conv_general_dilated"):
+            out = eqn.outvars[0].aval
+            lhs = eqn.invars[0].aval
+            # 2 * output elements * contraction length (approximate)
+            k = int(np.prod(lhs.shape)) // max(
+                int(np.prod(out.shape[:1] or (1,))), 1)
+            total += 2 * int(np.prod(out.shape)) * max(k, 1)
+    traverse(jaxpr, visit)
+    return total

@@ -69,6 +69,17 @@ class DistributedStep:
         fn = self._step_fn if donate else self._step_fn_nodonate
         return fn(state, batch)
 
+    def snapshot_lowered(self, state: TrainState, batch):
+        """Dump the transformed program's StableHLO (the reference's
+        '3-transformed' TensorBoard snapshot, ``graph_transformer.py:90``)."""
+        from autodist_tpu.utils import visualization_util
+        try:
+            text = self._step_fn_nodonate.lower(state, batch).as_text()
+            visualization_util.log_program("3-transformed-stablehlo", text,
+                                           force=True)
+        except Exception as e:  # noqa: BLE001 — diagnostics must not break runs
+            logging.warning("snapshot_lowered failed: %s", e)
+
     # ------------------------------------------------------------- state mgmt
 
     def _put(self, value, pspec: P):
@@ -155,12 +166,18 @@ class GraphTransformer:
     # ---------------------------------------------------------------- main
 
     def transform(self) -> DistributedStep:
+        from autodist_tpu.utils import visualization_util
         item = self._item
         if item.loss_fn is None:
             raise NotImplementedError("step_fn capture mode lowers via "
                                       "Runner.lower_step_fn; GraphTransformer "
                                       "needs loss_fn mode")
         var_infos = item.var_infos
+        if visualization_util.enabled():
+            # stage 0: the user's original program (reference writes
+            # '0-original' TensorBoard graphs, graph_transformer.py:62)
+            visualization_util.log_jaxpr("0-original-loss", item.loss_fn,
+                                         item.params, item.example_batch)
         layouts = VariablePartitioner.apply(
             self._strategy, var_infos, self.num_replicas, self._axis)
 

@@ -81,10 +81,8 @@ class VarInfo:
 
 # ------------------------------------------------------------------ sparse detection
 
-_TRANSPARENT_PRIMS = {
-    "reshape", "transpose", "convert_element_type", "squeeze", "broadcast_in_dim",
-    "copy", "stop_gradient", "slice", "rev",
-}
+from autodist_tpu.kernel.common.op_info import (  # noqa: E402
+    TRANSPARENT_PRIMITIVES as _TRANSPARENT_PRIMS)
 
 
 def _gather_indexed_invars(jaxpr, candidates: set) -> set:

@@ -1,0 +1,38 @@
+"""Network/address utilities.
+
+Analog of reference ``autodist/utils/network.py:21-75`` (loopback/local
+address detection via netifaces) — used to decide whether a resource-spec
+node address refers to this machine (chief-vs-remote launch decisions).
+Implemented with the stdlib only.
+"""
+import socket
+from typing import Set
+
+
+def _local_addresses() -> Set[str]:
+    addrs = {"127.0.0.1", "localhost", "::1"}
+    hostname = socket.gethostname()
+    addrs.add(hostname)
+    try:
+        addrs.update(info[4][0] for info in socket.getaddrinfo(hostname, None))
+    except socket.gaierror:
+        pass
+    try:
+        # UDP connect trick: learn the outbound-interface address
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        addrs.add(s.getsockname()[0])
+        s.close()
+    except OSError:
+        pass
+    return addrs
+
+
+def is_loopback_address(address: str) -> bool:
+    host = address.split(":")[0]
+    return host in ("127.0.0.1", "localhost", "::1")
+
+
+def is_local_address(address: str) -> bool:
+    host = address.split(":")[0]
+    return host in _local_addresses()

@@ -1,0 +1,50 @@
+"""Compile-time program snapshots.
+
+Analog of reference ``autodist/utils/visualization_util.py:24-36``, which
+writes the graph to TensorBoard event files at each transformation stage
+(``0-original`` … ``3-transformed``, ``kernel/graph_transformer.py:62-90``).
+The JAX equivalents of "the graph" are the jaxpr and the lowered StableHLO:
+``log_program`` dumps them as text under
+``/tmp/autodist_tpu/snapshots/<run>/<stage>.txt``, gated by the
+``ADT_SNAPSHOT`` env var or an explicit call.
+"""
+import os
+import time
+
+from autodist_tpu import const
+from autodist_tpu.utils import logging
+
+_RUN_DIR = None
+
+
+def _run_dir() -> str:
+    global _RUN_DIR
+    if _RUN_DIR is None:
+        _RUN_DIR = os.path.join(const.DEFAULT_SNAPSHOT_DIR,
+                                time.strftime("%Y%m%d-%H%M%S"))
+        os.makedirs(_RUN_DIR, exist_ok=True)
+    return _RUN_DIR
+
+
+def enabled() -> bool:
+    return os.environ.get("ADT_SNAPSHOT", "") not in ("", "0")
+
+
+def log_program(stage: str, text: str, force: bool = False):
+    """Write one stage's program text (jaxpr or HLO)."""
+    if not (force or enabled()):
+        return
+    path = os.path.join(_run_dir(), "%s.txt" % stage)
+    with open(path, "w") as f:
+        f.write(text)
+    logging.debug("snapshot %s -> %s", stage, path)
+
+
+def log_jaxpr(stage: str, fn, *example_args, force: bool = False):
+    if not (force or enabled()):
+        return
+    import jax
+    try:
+        log_program(stage, str(jax.make_jaxpr(fn)(*example_args)), force=force)
+    except Exception as e:  # noqa: BLE001 — diagnostics must not break builds
+        logging.warning("snapshot %s failed: %s", stage, e)

@@ -1,0 +1,64 @@
+"""kernel.common utilities tests (analog of reference
+``tests/test_kernels/test_common/test_utils.py``)."""
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.kernel.common import op_info, utils
+from autodist_tpu.kernel.common.proxy_variable import ProxyVariable
+from autodist_tpu.kernel.partitioner import VarLayout
+from autodist_tpu.utils import network
+
+
+def _jaxpr():
+    def f(w, x, ids):
+        e = jnp.take(w, ids, axis=0)
+        h = jnp.tanh(e @ x)
+        return jnp.sum(h)
+    return jax.make_jaxpr(f)(jnp.ones((8, 4)), jnp.ones((4, 2)),
+                             jnp.zeros((3,), jnp.int32)).jaxpr
+
+
+def test_find_primitives():
+    jaxpr = _jaxpr()
+    gathers = utils.find_primitives(jaxpr, op_info.INDEXED_READ_PRIMITIVES)
+    assert len(gathers) == 1
+    dots = utils.find_primitives(jaxpr, {"dot_general"})
+    assert len(dots) == 1
+
+
+def test_consumers_and_ancestors():
+    jaxpr = _jaxpr()
+    w = jaxpr.invars[0]
+    cons = utils.consumers(jaxpr, w)
+    # jnp.take wraps its gather in an inner jit; the top-level consumer is
+    # that wrapper eqn
+    assert cons and cons[0].primitive.name in ("gather", "jit", "pjit")
+    out = jaxpr.outvars[0]
+    anc = utils.get_ancestors(jaxpr, out)
+    assert w in anc and jaxpr.invars[1] in anc
+
+
+def test_control_flow_detection():
+    def f(x):
+        return jax.lax.fori_loop(0, 3, lambda i, a: a * 2.0, x)
+    jaxpr = jax.make_jaxpr(f)(1.0).jaxpr
+    assert utils.uses_control_flow(jaxpr)
+    assert not utils.uses_control_flow(_jaxpr())
+
+
+def test_flops_estimate_positive():
+    assert utils.count_flops_estimate(_jaxpr()) > 0
+
+
+def test_proxy_plan():
+    part = VarLayout(name="v", partitioned=True, axis=0, num_shards=2,
+                     orig_dim=8, padded_dim=8)
+    rep = VarLayout(name="v")
+    assert ProxyVariable.plan("v", None, part).cached is False
+    assert ProxyVariable.plan("v", None, rep).cached is True
+
+
+def test_network_utils():
+    assert network.is_loopback_address("127.0.0.1:TPU:0")
+    assert network.is_local_address("localhost")
+    assert not network.is_loopback_address("10.0.0.1")
