@@ -118,11 +118,13 @@ class AutoDist:
         self._coordinator.launch_clients()
 
     def build(self, loss_fn: Callable, optimizer, params, example_batch,
-              has_aux: bool = False, apply_fn: Optional[Callable] = None) -> Runner:
+              has_aux: bool = False, apply_fn: Optional[Callable] = None,
+              trainable_filter: Optional[Callable] = None) -> Runner:
         """Capture + compile + lower; returns a Runner (uninitialized)."""
         item = ModelItem(loss_fn=loss_fn, optimizer=optimizer, params=params,
                          example_batch=example_batch, has_aux=has_aux,
-                         apply_fn=apply_fn).prepare()
+                         apply_fn=apply_fn,
+                         trainable_filter=trainable_filter).prepare()
         strategy = self._build_or_load_strategy(item)
         compiled = StrategyCompiler(item, self._resource_spec).compile(strategy)
         logging.info("compiled %r", compiled)

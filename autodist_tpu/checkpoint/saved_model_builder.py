@@ -17,20 +17,23 @@ import numpy as np
 
 
 class SavedModelBuilder:
-    def __init__(self, export_dir: str, saver: Optional[Saver] = None):
-        # a Saver is a required collaborator in the reference (its ctor
-        # requires one); here it's optional because gather lives on the step
+    def __init__(self, export_dir: str):
         self.export_dir = export_dir
-        self.saver = saver
         os.makedirs(export_dir, exist_ok=True)
 
-    def save(self, runner, signature: Optional[dict] = None) -> str:
+    def save(self, runner, signature: Optional[dict] = None,
+             apply_fn: Optional[Callable] = None) -> str:
         dstep = runner.distributed_step
         params = dstep.gather_params(runner.state)
         np.savez(os.path.join(self.export_dir, "params.npz"),
                  **_tree_to_flat(params))
         spec = dstep.model_item.to_spec_dict()
         spec["signature"] = signature or {}
+        fn = apply_fn or dstep.model_item.apply_fn
+        if fn is not None:
+            spec["apply_fn"] = "%s.%s" % (getattr(fn, "__module__", "?"),
+                                          getattr(fn, "__qualname__",
+                                                  repr(fn)))
         with open(os.path.join(self.export_dir, "model_spec.json"), "w") as f:
             json.dump(spec, f, indent=1, sort_keys=True)
         logging.info("exported model to %s", self.export_dir)
@@ -40,4 +43,4 @@ class SavedModelBuilder:
 def export_for_serving(runner, export_dir: str,
                        apply_fn: Optional[Callable] = None) -> str:
     """Convenience wrapper mirroring the reference's usage pattern."""
-    return SavedModelBuilder(export_dir).save(runner)
+    return SavedModelBuilder(export_dir).save(runner, apply_fn=apply_fn)

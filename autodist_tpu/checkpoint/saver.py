@@ -60,9 +60,9 @@ class Saver:
 
     def save(self, runner_or_step, state=None, step: Optional[int] = None) -> Optional[str]:
         """Write a checkpoint. Accepts a Runner (uses its state) or a
-        DistributedStep + explicit TrainState."""
-        if self.chief_only and not const.is_chief():
-            return None
+        DistributedStep + explicit TrainState. The gathers are collectives —
+        EVERY process must call save(); only the file writes are
+        chief-gated."""
         if hasattr(runner_or_step, "distributed_step"):  # Runner
             dstep = runner_or_step.distributed_step
             state = state if state is not None else runner_or_step.state
@@ -70,14 +70,20 @@ class Saver:
             dstep = runner_or_step
         if state is None:
             raise ValueError("no state to save")
+        # cross-process collectives: run on all processes before any gating
         params = dstep.gather_params(state)
+        opt_state_host = dstep.gather_opt_state(state)
+        sync_state_host = dstep.gather_sync_state(state)
         if step is None:
             step = int(jax.device_get(state.step))
+        if self.chief_only and not const.is_chief():
+            return None
         path = os.path.join(self.directory, "ckpt-%d" % step)
         np.savez(path + ".params.npz", **_tree_to_flat(params))
-        # optimizer + sync state: gathered via the same replicated-jit trick
-        opt_state_host = self._gather_opt_state(dstep, state)
         np.savez(path + ".opt.npz", **_tree_to_flat(opt_state_host))
+        sync_flat = _tree_to_flat(sync_state_host)
+        if sync_flat:
+            np.savez(path + ".sync.npz", **sync_flat)
         meta = {"step": step, "format": "autodist_tpu.v1",
                 "strategy_id": dstep.strategy.id}
         with open(path + ".meta.json", "w") as f:
@@ -86,28 +92,24 @@ class Saver:
         logging.info("saved checkpoint %s (step %d)", path, step)
         return path
 
-    def _gather_opt_state(self, dstep, state):
-        """Optimizer state back to full (unpadded) original layout."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        from autodist_tpu.kernel.partitioner import VarLayout
-        layout_tree = variable_utils.map_state_layouts(
-            state.opt_state, dstep.model_item.var_infos, dstep.layouts,
-            VarLayout(name=""))
-        rep = jax.tree_util.tree_map(
-            lambda _: NamedSharding(dstep.mesh, P()), state.opt_state)
-        gathered = jax.jit(
-            lambda s: jax.tree_util.tree_map(
-                lambda leaf, lay: lay.unpad(leaf), s, layout_tree),
-            out_shardings=rep)(state.opt_state)
-        return jax.device_get(gathered)
+    _META_RE = __import__("re").compile(r"^ckpt-(\d+)\.meta\.json$")
+
+    def _own_metas(self):
+        """(step, filename) for files this saver wrote; foreign files in a
+        shared directory are ignored, not crashed on."""
+        out = []
+        for f in os.listdir(self.directory):
+            m = self._META_RE.match(f)
+            if m:
+                out.append((int(m.group(1)), f))
+        return sorted(out)
 
     def _gc(self):
-        metas = sorted(
-            (f for f in os.listdir(self.directory) if f.endswith(".meta.json")),
-            key=lambda f: int(f.split("-")[1].split(".")[0]))
+        metas = self._own_metas()
         while len(metas) > self.max_to_keep:
-            victim = metas.pop(0).replace(".meta.json", "")
-            for suffix in (".meta.json", ".params.npz", ".opt.npz"):
+            _, fname = metas.pop(0)
+            victim = fname.replace(".meta.json", "")
+            for suffix in (".meta.json", ".params.npz", ".opt.npz", ".sync.npz"):
                 try:
                     os.remove(os.path.join(self.directory, victim + suffix))
                 except FileNotFoundError:
@@ -116,11 +118,11 @@ class Saver:
     # --------------------------------------------------------------- restore
 
     def latest(self) -> Optional[str]:
-        metas = [f for f in os.listdir(self.directory) if f.endswith(".meta.json")]
+        metas = self._own_metas()
         if not metas:
             return None
-        newest = max(metas, key=lambda f: int(f.split("-")[1].split(".")[0]))
-        return os.path.join(self.directory, newest.replace(".meta.json", ""))
+        return os.path.join(self.directory,
+                            metas[-1][1].replace(".meta.json", ""))
 
     def restore_params(self, params_template, path: Optional[str] = None):
         """Params pytree in the original layout — usable with or without the
@@ -141,7 +143,15 @@ class Saver:
         opt_flat = dict(np.load(path + ".opt.npz"))
         opt_template = dstep.model_item.optimizer.init(dstep.model_item.params)
         opt_state = _flat_to_tree(opt_template, opt_flat)
-        state = dstep.init_state(params, opt_state)
+        sync_state = None
+        if os.path.exists(path + ".sync.npz"):
+            sync_flat = dict(np.load(path + ".sync.npz"))
+            try:
+                sync_state = _flat_to_tree(dstep._sync_state_init(), sync_flat)
+            except (KeyError, ValueError) as e:
+                logging.warning("sync state in checkpoint incompatible with "
+                                "current strategy (%s); reinitializing", e)
+        state = dstep.init_state(params, opt_state, sync_state)
         with open(path + ".meta.json") as f:
             step = json.load(f)["step"]
         # advance the step counter to the saved step

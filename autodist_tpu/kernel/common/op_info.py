@@ -23,10 +23,10 @@ TRANSPARENT_PRIMITIVES = frozenset({
 INDEXED_READ_PRIMITIVES = frozenset({"gather"})
 
 # Primitives that perform an indexed write (scatter family) — the analog of
-# the sparse update-op table (``:73-117``).
+# the sparse update-op table (``:73-117``). JAX names these with hyphens
+# (lax.scatter_mul_p.name == 'scatter-mul').
 INDEXED_UPDATE_PRIMITIVES = frozenset({
-    "scatter", "scatter-add", "scatter_add", "scatter_mul", "scatter_min",
-    "scatter_max",
+    "scatter", "scatter-add", "scatter-mul", "scatter-min", "scatter-max",
 })
 
 # Cross-replica collectives (the analog of CollectiveReduce/Gather types).
@@ -36,10 +36,15 @@ COLLECTIVE_PRIMITIVES = frozenset({
 })
 
 # Structured-control-flow primitives (the analog of the while/cond op table,
-# ``:165-181``) — sub-jaxprs live in their params.
-CONTROL_FLOW_PRIMITIVES = frozenset({
-    "while", "cond", "scan", "pjit", "custom_jvp_call", "custom_vjp_call",
-    "custom_vjp_call_jaxpr", "remat", "checkpoint", "closed_call", "core_call",
+# ``:165-181``).
+CONTROL_FLOW_PRIMITIVES = frozenset({"while", "cond", "scan"})
+
+# Container primitives that merely wrap a sub-jaxpr (inner jits from jnp ops,
+# custom-derivative wrappers, remat) — traversal descends through these but
+# they are not themselves control flow. jax 0.9 names inner jits 'jit'.
+CONTAINER_PRIMITIVES = frozenset({
+    "jit", "pjit", "closed_call", "core_call", "custom_jvp_call",
+    "custom_vjp_call", "custom_vjp_call_jaxpr", "remat", "checkpoint",
 })
 
 # Primitives whose execution has side effects / ordering constraints (the

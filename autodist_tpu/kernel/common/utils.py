@@ -71,8 +71,10 @@ def find_primitives(jaxpr, names, recursive: bool = True) -> List[object]:
 
 
 def uses_control_flow(jaxpr) -> bool:
+    """True when while/cond/scan appears anywhere, descending through
+    container primitives (inner jits) which are not themselves control flow."""
     return bool(find_primitives(jaxpr, op_info.CONTROL_FLOW_PRIMITIVES,
-                                recursive=False))
+                                recursive=True))
 
 
 def count_flops_estimate(jaxpr) -> int:

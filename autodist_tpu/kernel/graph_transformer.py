@@ -86,7 +86,7 @@ class DistributedStep:
         from autodist_tpu.parallel.mesh import host_to_mesh
         return host_to_mesh(self.mesh, value, pspec)
 
-    def init_state(self, params, opt_state=None) -> TrainState:
+    def init_state(self, params, opt_state=None, sync_state=None) -> TrainState:
         """Shard initial params/optimizer state into storage layout
         (pad partitioned vars, place on the mesh)."""
         item = self.model_item
@@ -105,22 +105,41 @@ class DistributedStep:
         opt_layout_tree = variable_utils.map_state_layouts(
             opt_state, item.var_infos, self.layouts, VarLayout(name=""))
         opt_placed = _tree_map_layouts(place_var, opt_state, opt_layout_tree)
-        sync_state = jax.tree_util.tree_map(
-            lambda arr: self._put(arr, P(self.mesh_axis)), self._sync_state_init())
+        if sync_state is None:
+            sync_state = self._sync_state_init()
+        sync_placed = jax.tree_util.tree_map(
+            lambda arr: self._put(arr, P(self.mesh_axis)), sync_state)
         step0 = self._put(np.zeros((), np.int32), P())
         return TrainState(step=step0, params=params_placed,
-                          opt_state=opt_placed, sync_state=sync_state)
+                          opt_state=opt_placed, sync_state=sync_placed)
 
     def gather_params(self, state: TrainState):
         """Params back in the original (full, unpadded) layout, on host —
         the reference's 'checkpoints load in vanilla TF' property
         (reference ``checkpoint/saver.py:50-57``)."""
+        return self._gather_tree(state.params, self._layout_tree)
+
+    def gather_opt_state(self, state: TrainState):
+        """Optimizer state in the original (full, unpadded) layout."""
+        from autodist_tpu.kernel.common import variable_utils
+        layout_tree = variable_utils.map_state_layouts(
+            state.opt_state, self.model_item.var_infos, self.layouts,
+            VarLayout(name=""))
+        return self._gather_tree(state.opt_state, layout_tree)
+
+    def gather_sync_state(self, state: TrainState):
+        """Compressor state to host, keeping the leading device axis."""
         rep = jax.tree_util.tree_map(
-            lambda _: NamedSharding(self.mesh, P()), state.params)
+            lambda _: NamedSharding(self.mesh, P()), state.sync_state)
+        gathered = jax.jit(lambda s: s, out_shardings=rep)(state.sync_state)
+        return jax.device_get(gathered)
+
+    def _gather_tree(self, tree, layout_tree):
+        rep = jax.tree_util.tree_map(lambda _: NamedSharding(self.mesh, P()), tree)
         gathered = jax.jit(
-            lambda p: _tree_map_layouts(lambda leaf, lay: lay.unpad(leaf),
-                                        p, self._layout_tree),
-            out_shardings=rep)(state.params)
+            lambda t: _tree_map_layouts(lambda leaf, lay: lay.unpad(leaf),
+                                        t, layout_tree),
+            out_shardings=rep)(tree)
         return jax.device_get(gathered)
 
     def shard_batch(self, batch):

@@ -188,7 +188,12 @@ class ModelItem:
         self.params = params
         self.example_batch = example_batch
         self.has_aux = has_aux
-        self.trainable_filter = trainable_filter or (lambda name: True)
+        # default: everything trains except flax's batch_stats collection
+        # (BatchNorm running statistics are EMA state, not weights — updating
+        # them by gradient would corrupt normalization)
+        self.trainable_filter = trainable_filter or (
+            lambda name: not (name.startswith("batch_stats/")
+                              or "/batch_stats/" in name))
         # filled by patch.py when optimizer construction was captured
         self.optimizer_name: Optional[str] = None
         self.optimizer_args: Dict[str, Any] = {}

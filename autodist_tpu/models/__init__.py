@@ -1,0 +1,23 @@
+"""Model zoo — the reference's benchmark model families, TPU-first.
+
+Every model exposes ``make_train_setup(...) -> (loss_fn, params,
+example_batch, apply_fn)``, plugging directly into
+``AutoDist.build(loss_fn, optimizer, params, example_batch)``.
+"""
+from autodist_tpu.models import bert, lm, ncf, resnet  # noqa: F401
+
+REGISTRY = {
+    "resnet18": lambda **kw: resnet.make_train_setup(resnet.ResNet18, **kw),
+    "resnet50": lambda **kw: resnet.make_train_setup(resnet.ResNet50, **kw),
+    "resnet101": lambda **kw: resnet.make_train_setup(resnet.ResNet101, **kw),
+    "bert_base": lambda **kw: bert.make_train_setup(bert.BertConfig.base(), **kw),
+    "bert_large": lambda **kw: bert.make_train_setup(bert.BertConfig.large(), **kw),
+    "lm": lambda **kw: lm.make_train_setup(**kw),
+    "ncf": lambda **kw: ncf.make_train_setup(**kw),
+}
+
+
+def make_train_setup(name: str, **kw):
+    if name not in REGISTRY:
+        raise ValueError("unknown model %r (have %s)" % (name, sorted(REGISTRY)))
+    return REGISTRY[name](**kw)

@@ -1,0 +1,72 @@
+"""Shared model layers (attention, transformer blocks).
+
+TPU-first building blocks for the model zoo: bfloat16-friendly, static
+shapes, MXU-sized matmuls. Attention routes through
+``autodist_tpu.ops.attention`` so sequence-parallel (ring) execution can be
+swapped in by the strategy layer without touching model code.
+"""
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+Dtype = Any
+
+
+def causal_mask(seq_len: int) -> jnp.ndarray:
+    return jnp.tril(jnp.ones((1, 1, seq_len, seq_len), jnp.bool_))
+
+
+class MultiHeadAttention(nn.Module):
+    """Standard MHA with an injectable attention implementation."""
+    num_heads: int
+    head_dim: int
+    dtype: Dtype = jnp.float32
+    attn_fn: Optional[Callable] = None  # (q, k, v, mask) -> out
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        d_model = x.shape[-1]
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            features=(self.num_heads, self.head_dim), dtype=self.dtype,
+            axis=-1, name=name)
+        q = dense("query")(x)
+        k = dense("key")(x)
+        v = dense("value")(x)
+        if self.attn_fn is not None:
+            out = self.attn_fn(q, k, v, mask)
+        else:
+            scale = 1.0 / np.sqrt(self.head_dim)
+            logits = jnp.einsum("...qhd,...khd->...hqk", q, k) * scale
+            if mask is not None:
+                logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+            weights = nn.softmax(logits.astype(jnp.float32)).astype(self.dtype)
+            out = jnp.einsum("...hqk,...khd->...qhd", weights, v)
+        return nn.DenseGeneral(features=d_model, axis=(-2, -1),
+                               dtype=self.dtype, name="out")(out)
+
+
+class TransformerBlock(nn.Module):
+    num_heads: int
+    head_dim: int
+    mlp_dim: int
+    dtype: Dtype = jnp.float32
+    dropout_rate: float = 0.0
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic=True):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = MultiHeadAttention(self.num_heads, self.head_dim, self.dtype,
+                               self.attn_fn)(h, mask)
+        if self.dropout_rate:
+            h = nn.Dropout(self.dropout_rate)(h, deterministic=deterministic)
+        x = x + h
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype)(h)
+        if self.dropout_rate:
+            h = nn.Dropout(self.dropout_rate)(h, deterministic=deterministic)
+        return x + h

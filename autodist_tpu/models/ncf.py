@@ -1,0 +1,77 @@
+"""Neural Collaborative Filtering (NeuMF) recommender.
+
+TPU-native counterpart of the reference's NCF/MovieLens benchmark
+(``examples/benchmark/ncf.py`` + ``utils/recommendation/``): GMF + MLP
+towers over user/item embeddings with a binary logistic objective. The four
+embedding tables are the sparse/PS stress case (Parallax routes them to
+load-balanced PS; DLRM-style big-table configs stress PartitionedPS).
+"""
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class NCFConfig:
+    num_users: int = 138_000
+    num_items: int = 27_000
+    mf_dim: int = 64
+    mlp_dims: tuple = (256, 128, 64)
+    dtype: Any = jnp.float32
+
+    @classmethod
+    def tiny(cls, **kw):
+        return cls(num_users=64, num_items=48, mf_dim=8, mlp_dims=(16, 8), **kw)
+
+
+class NeuMF(nn.Module):
+    config: NCFConfig
+
+    @nn.compact
+    def __call__(self, user_ids, item_ids):
+        cfg = self.config
+        mf_u = nn.Embed(cfg.num_users, cfg.mf_dim, dtype=cfg.dtype,
+                        name="mf_user_embedding")(user_ids)
+        mf_i = nn.Embed(cfg.num_items, cfg.mf_dim, dtype=cfg.dtype,
+                        name="mf_item_embedding")(item_ids)
+        gmf = mf_u * mf_i
+        mlp_dim0 = cfg.mlp_dims[0] // 2
+        mlp_u = nn.Embed(cfg.num_users, mlp_dim0, dtype=cfg.dtype,
+                         name="mlp_user_embedding")(user_ids)
+        mlp_i = nn.Embed(cfg.num_items, mlp_dim0, dtype=cfg.dtype,
+                         name="mlp_item_embedding")(item_ids)
+        h = jnp.concatenate([mlp_u, mlp_i], axis=-1)
+        for i, d in enumerate(cfg.mlp_dims[1:]):
+            h = nn.relu(nn.Dense(d, dtype=cfg.dtype, name="mlp_%d" % i)(h))
+        x = jnp.concatenate([gmf, h], axis=-1)
+        return nn.Dense(1, dtype=jnp.float32, name="prediction")(x)[..., 0]
+
+
+def make_train_setup(config: Optional[NCFConfig] = None, batch_size: int = 256,
+                     seed: int = 0):
+    cfg = config or NCFConfig()
+    model = NeuMF(cfg)
+    rng = jax.random.PRNGKey(seed)
+    variables = model.init(rng, jnp.zeros((1,), jnp.int32),
+                           jnp.zeros((1,), jnp.int32))
+
+    def loss_fn(params, batch):
+        logits = model.apply(params, batch["user"], batch["item"])
+        labels = batch["label"].astype(jnp.float32)
+        # numerically-stable sigmoid cross-entropy
+        loss = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
+            jnp.exp(-jnp.abs(logits)))
+        return jnp.mean(loss)
+
+    npr = np.random.RandomState(seed)
+    example_batch = {
+        "user": npr.randint(0, cfg.num_users, (batch_size,)).astype(np.int32),
+        "item": npr.randint(0, cfg.num_items, (batch_size,)).astype(np.int32),
+        "label": npr.randint(0, 2, (batch_size,)).astype(np.int32),
+    }
+    apply_fn = lambda p, u, i: model.apply(p, u, i)  # noqa: E731
+    return loss_fn, dict(variables), example_batch, apply_fn

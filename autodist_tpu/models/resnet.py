@@ -1,0 +1,129 @@
+"""ResNet family (ResNet-18/50/101, plus the reference's benchmark CNNs).
+
+TPU-native counterpart of the reference's ImageNet benchmark models
+(``examples/benchmark/imagenet.py:150-182`` uses keras ResNet101/VGG16/
+InceptionV3/DenseNet121). Implemented from scratch in flax: NHWC layout
+(TPU conv-native), bfloat16 compute with float32 params/batch-stats, static
+shapes throughout.
+"""
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.float32
+    norm: Callable = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(self.norm, use_running_average=not train,
+                       momentum=0.9, dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (3, 3), self.strides, padding="SAME")(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), padding="SAME")(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1), self.strides,
+                            name="conv_proj")(residual)
+            residual = norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.float32
+    norm: Callable = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(self.norm, use_running_average=not train,
+                       momentum=0.9, dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), self.strides, padding="SAME")(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1), self.strides,
+                            name="conv_proj")(residual)
+            residual = norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=jnp.float32, name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(self.num_filters * 2 ** i, strides,
+                                   dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+ResNet18 = partial(ResNet, stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock)
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock)
+ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3], block_cls=BottleneckBlock)
+# a tiny config for tests
+ResNetTiny = partial(ResNet, stage_sizes=[1, 1], block_cls=BasicBlock,
+                     num_filters=8)
+
+
+def make_train_setup(model_cls=ResNet50, num_classes: int = 1000,
+                     image_size: int = 224, batch_size: int = 64,
+                     dtype=jnp.bfloat16, seed: int = 0):
+    """(loss_fn, params, example_batch, apply_fn) for the framework's
+    loss_fn capture mode. BatchNorm runs in inference mode inside the loss
+    (statistics from params) so the captured program is a pure function; the
+    training-statistics variant arrives with the mutable-state capture mode."""
+    import jax
+    import numpy as np
+    model = model_cls(num_classes=num_classes, dtype=dtype)
+    rng = jax.random.PRNGKey(seed)
+    x0 = jnp.ones((1, image_size, image_size, 3), jnp.float32)
+    variables = model.init(rng, x0, train=False)
+
+    def loss_fn(params, batch):
+        logits = model.apply(params, batch["image"], train=False)
+        one_hot = jax.nn.one_hot(batch["label"], num_classes)
+        loss = -jnp.sum(one_hot * jax.nn.log_softmax(logits), axis=-1)
+        return jnp.mean(loss)
+
+    npr = np.random.RandomState(seed)
+    example_batch = {
+        "image": npr.randn(batch_size, image_size, image_size, 3).astype(np.float32),
+        "label": npr.randint(0, num_classes, (batch_size,)).astype(np.int32),
+    }
+    apply_fn = lambda p, x: model.apply(p, x, train=False)  # noqa: E731
+    return loss_fn, dict(variables), example_batch, apply_fn

@@ -28,11 +28,23 @@ def _local_addresses() -> Set[str]:
     return addrs
 
 
+def _host_of(address: str) -> str:
+    """Strip device/port suffixes: 'h:TPU:0' -> 'h', '[::1]:80' -> '::1',
+    bare IPv6 like '::1' passes through unchanged."""
+    if address.startswith("["):  # bracketed IPv6
+        return address[1:].split("]")[0]
+    try:
+        import ipaddress
+        ipaddress.IPv6Address(address)
+        return address
+    except (ValueError, ImportError):
+        pass
+    return address.split(":")[0]
+
+
 def is_loopback_address(address: str) -> bool:
-    host = address.split(":")[0]
-    return host in ("127.0.0.1", "localhost", "::1")
+    return _host_of(address) in ("127.0.0.1", "localhost", "::1")
 
 
 def is_local_address(address: str) -> bool:
-    host = address.split(":")[0]
-    return host in _local_addresses()
+    return _host_of(address) in _local_addresses()

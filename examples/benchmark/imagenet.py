@@ -1,0 +1,73 @@
+"""ImageNet CNN benchmark harness.
+
+Mirror of reference ``examples/benchmark/imagenet.py``: model selected by
+``--model`` (resnet50/resnet101/resnet18), strategy by
+``--autodist_strategy`` (``:160-182``), per-model all-reduce chunk sizes
+(``:150-158``), examples/sec logging. Synthetic ImageNet-shaped data.
+
+  python examples/benchmark/imagenet.py --model resnet50 \
+      --autodist_strategy AllReduce --batch_size 64 --steps 200
+"""
+import argparse
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import autodist_tpu as adt
+from autodist_tpu import strategy as S
+from autodist_tpu.models import resnet
+from examples.benchmark.utils.logs import BenchmarkLogger, ExamplesPerSecondHook
+
+# per-model chunk sizes, as tuned in the reference (imagenet.py:150-158)
+CHUNK_SIZES = {"resnet101": 200, "resnet50": 200, "resnet18": 512}
+
+MODELS = {"resnet18": resnet.ResNet18, "resnet50": resnet.ResNet50,
+          "resnet101": resnet.ResNet101}
+
+
+def make_builder(name: str, chunk: int):
+    builders = {
+        "PS": lambda: S.PS(),
+        "PSLoadBalancing": lambda: S.PSLoadBalancing(),
+        "PartitionedPS": lambda: S.PartitionedPS(),
+        "AllReduce": lambda: S.AllReduce(chunk_size=chunk),
+        "PartitionedAR": lambda: S.PartitionedAR(chunk_size=chunk),
+        "Parallax": lambda: S.Parallax(chunk_size=chunk),
+    }
+    return builders[name]()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50", choices=sorted(MODELS))
+    p.add_argument("--autodist_strategy", default="AllReduce")
+    p.add_argument("--batch_size", type=int, default=64)
+    p.add_argument("--image_size", type=int, default=224)
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--resource_spec", default=None)
+    p.add_argument("--bf16", action="store_true", default=True)
+    args = p.parse_args()
+
+    chunk = CHUNK_SIZES.get(args.model, 512)
+    ad = adt.AutoDist(resource_spec_file=args.resource_spec,
+                      strategy_builder=make_builder(args.autodist_strategy, chunk))
+    loss_fn, params, batch, _ = resnet.make_train_setup(
+        MODELS[args.model], image_size=args.image_size,
+        batch_size=args.batch_size,
+        dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+    step = ad.function(loss_fn, optimizer=optax.sgd(0.1, momentum=0.9),
+                       params=params)
+    hook = ExamplesPerSecondHook(args.batch_size, every_n_steps=20,
+                                 name=args.model)
+    for i in range(args.steps):
+        m = step(batch)
+        hook.after_step()
+    BenchmarkLogger().log(model=args.model, strategy=args.autodist_strategy,
+                          batch_size=args.batch_size,
+                          examples_per_sec=round(hook.average, 1),
+                          final_loss=float(m["loss"]))
+
+
+if __name__ == "__main__":
+    main()

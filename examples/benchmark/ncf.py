@@ -1,0 +1,40 @@
+"""NCF (NeuMF) recommender benchmark harness.
+
+Mirror of reference ``examples/benchmark/ncf.py`` (MovieLens NeuMF):
+synthetic interactions, examples/sec metric; the four embedding tables
+stress the sparse/PS path.
+"""
+import argparse
+
+import optax
+
+import autodist_tpu as adt
+from autodist_tpu.models import ncf
+from examples.benchmark.utils.logs import BenchmarkLogger, ExamplesPerSecondHook
+from examples.benchmark.imagenet import make_builder
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--autodist_strategy", default="PSLoadBalancing")
+    p.add_argument("--batch_size", type=int, default=1024)
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--resource_spec", default=None)
+    args = p.parse_args()
+
+    ad = adt.AutoDist(resource_spec_file=args.resource_spec,
+                      strategy_builder=make_builder(args.autodist_strategy, 512))
+    loss_fn, params, batch, _ = ncf.make_train_setup(
+        batch_size=args.batch_size)
+    step = ad.function(loss_fn, optimizer=optax.adam(1e-3), params=params)
+    hook = ExamplesPerSecondHook(args.batch_size, every_n_steps=20, name="ncf")
+    for _ in range(args.steps):
+        m = step(batch)
+        hook.after_step()
+    BenchmarkLogger().log(model="ncf", strategy=args.autodist_strategy,
+                          examples_per_sec=round(hook.average, 1),
+                          final_loss=float(m["loss"]))
+
+
+if __name__ == "__main__":
+    main()

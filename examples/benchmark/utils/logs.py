@@ -1,0 +1,51 @@
+"""Benchmark logging utilities.
+
+Analog of the reference's vendored ``examples/benchmark/utils/logs/``
+(``hooks.py:28`` ExamplesPerSecondHook, ``logger.py`` BenchmarkLogger): a
+throughput meter that logs examples/sec every N steps and a JSON-line
+benchmark logger.
+"""
+import json
+import time
+
+
+class ExamplesPerSecondHook:
+    def __init__(self, batch_size: int, every_n_steps: int = 100, name: str = ""):
+        self.batch_size = batch_size
+        self.every_n = every_n_steps
+        self.name = name
+        self._t0 = None
+        self._step0 = 0
+        self._step = 0
+        self.history = []
+
+    def after_step(self):
+        self._step += 1
+        if self._t0 is None:
+            self._t0, self._step0 = time.perf_counter(), self._step
+            return None
+        if (self._step - self._step0) >= self.every_n:
+            dt = time.perf_counter() - self._t0
+            eps = (self._step - self._step0) * self.batch_size / dt
+            self.history.append(eps)
+            print("%s step %d: %.1f examples/sec" % (self.name, self._step, eps))
+            self._t0, self._step0 = time.perf_counter(), self._step
+            return eps
+        return None
+
+    @property
+    def average(self):
+        return sum(self.history) / len(self.history) if self.history else 0.0
+
+
+class BenchmarkLogger:
+    def __init__(self, path=None):
+        self.path = path
+
+    def log(self, **record):
+        record.setdefault("timestamp", time.time())
+        line = json.dumps(record, sort_keys=True)
+        print(line)
+        if self.path:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")

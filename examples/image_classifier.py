@@ -1,0 +1,25 @@
+"""Tiny image classifier example (mirror of reference examples/image_classifier.py)."""
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import autodist_tpu as adt
+from autodist_tpu import strategy
+from autodist_tpu.models import resnet
+
+
+def main():
+    ad = adt.AutoDist(strategy_builder=strategy.AllReduce())
+    loss_fn, params, batch, _ = resnet.make_train_setup(
+        resnet.ResNetTiny, num_classes=10, image_size=32, batch_size=64,
+        dtype=jnp.float32)
+    step = ad.function(loss_fn, optimizer=optax.sgd(0.1, momentum=0.9),
+                       params=params)
+    for i in range(30):
+        m = step(batch)
+        if i % 10 == 0:
+            print("step %d loss %.4f" % (i, m["loss"]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""lm1b-style language-model training (words/sec metric).
+
+Mirror of reference ``examples/lm1b/lm1b_train.py`` (``:62-75`` logs wps =
+batch x num_replicas x log_frequency / elapsed): a causal transformer LM on
+synthetic 1B-word-shaped data under PartitionedPS (the reference's lm1b
+config per BASELINE.md).
+"""
+import argparse
+import time
+
+import optax
+
+import autodist_tpu as adt
+from autodist_tpu import strategy as S
+from autodist_tpu.models import lm
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="tiny", choices=["tiny", "default", "lm1b"])
+    p.add_argument("--batch_size", type=int, default=64)
+    p.add_argument("--seq_len", type=int, default=128)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--log_frequency", type=int, default=20)
+    p.add_argument("--resource_spec", default=None)
+    args = p.parse_args()
+
+    cfg = {"tiny": lm.LMConfig.tiny, "default": lm.LMConfig,
+           "lm1b": lm.LMConfig.lm1b}[args.config]()
+    ad = adt.AutoDist(resource_spec_file=args.resource_spec,
+                      strategy_builder=S.PartitionedPS())
+    loss_fn, params, batch, _ = lm.make_train_setup(
+        cfg, seq_len=args.seq_len, batch_size=args.batch_size)
+    step = ad.function(loss_fn, optimizer=optax.adam(1e-3), params=params)
+
+    t0, words = time.perf_counter(), 0
+    for i in range(args.steps):
+        m = step(batch)
+        words += args.batch_size * args.seq_len
+        if (i + 1) % args.log_frequency == 0:
+            dt = time.perf_counter() - t0
+            print("step %d loss %.4f wps %.1f" % (i + 1, m["loss"], words / dt))
+            t0, words = time.perf_counter(), 0
+
+
+if __name__ == "__main__":
+    main()

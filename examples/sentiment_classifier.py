@@ -1,0 +1,49 @@
+"""Sentiment classifier with a partitioned embedding.
+
+Mirror of reference ``examples/sentiment_classifier.py`` (embedding model
+under PartitionedPS, ``:12,22-41``): mean-pooled word embeddings + dense
+head; the vocabulary table is sharded across parameter servers.
+Synthetic data (the reference downloads IMDB).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import autodist_tpu as adt
+from autodist_tpu import strategy
+
+VOCAB, SEQ, BATCH, EMBED = 10_000, 64, 128, 64
+
+
+def main():
+    ad = adt.AutoDist(strategy_builder=strategy.PartitionedPS())
+
+    rng = np.random.RandomState(0)
+    key = jax.random.PRNGKey(0)
+    params = {
+        "embedding": jax.random.normal(key, (VOCAB, EMBED)) * 0.05,
+        "dense": {"kernel": jax.random.normal(key, (EMBED, 1)) * 0.1,
+                  "bias": jnp.zeros((1,))},
+    }
+
+    def loss_fn(p, batch):
+        emb = jnp.take(p["embedding"], batch["tokens"], axis=0)  # [B,S,E]
+        pooled = jnp.mean(emb, axis=1)
+        logits = (pooled @ p["dense"]["kernel"] + p["dense"]["bias"])[..., 0]
+        labels = batch["label"].astype(jnp.float32)
+        loss = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
+            jnp.exp(-jnp.abs(logits)))
+        return jnp.mean(loss)
+
+    step = ad.function(loss_fn, optimizer=optax.adam(1e-3), params=params)
+    for i in range(50):
+        batch = {"tokens": rng.randint(0, VOCAB, (BATCH, SEQ)).astype(np.int32),
+                 "label": rng.randint(0, 2, (BATCH,)).astype(np.int32)}
+        m = step(batch)
+        if i % 10 == 0:
+            print("step %d loss %.4f" % (i, m["loss"]))
+
+
+if __name__ == "__main__":
+    main()

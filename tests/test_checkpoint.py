@@ -89,6 +89,45 @@ def test_framework_resume_bitexact(tmp_path):
                                    rtol=1e-6, atol=1e-6)
 
 
+def test_resume_with_compressor_state_bitexact(tmp_path):
+    """Error-feedback residuals must round-trip through checkpoints."""
+    params, loss_fn, batch = _problem()
+    opt = optax.sgd(0.05)
+    ad = autodist_tpu.AutoDist(
+        strategy_builder=S.AllReduce(compressor="HorovodCompressorEF"))
+    runner = ad.build(loss_fn, opt, params, batch)
+    runner.init(params)
+    for _ in range(3):
+        runner.run(batch)
+    saver = Saver(directory=str(tmp_path))
+    path = saver.save(runner)
+    import os
+    assert os.path.exists(path + ".sync.npz")
+    for _ in range(2):
+        runner.run(batch)
+    final_a = runner.gather_params()
+
+    saver.restore(runner, path)
+    for _ in range(2):
+        runner.run(batch)
+    final_b = runner.gather_params()
+    for k in final_a:
+        np.testing.assert_allclose(np.asarray(final_a[k]), np.asarray(final_b[k]),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_gc_ignores_foreign_files(tmp_path):
+    (tmp_path / "best-model.meta.json").write_text("{}")
+    params, loss_fn, batch = _problem()
+    ad = autodist_tpu.AutoDist(strategy_builder=S.AllReduce())
+    runner = ad.build(loss_fn, optax.sgd(0.01), params, batch)
+    runner.init(params)
+    runner.run(batch)
+    saver = Saver(directory=str(tmp_path), max_to_keep=1)
+    assert saver.save(runner) is not None  # must not crash on the foreign file
+    assert (tmp_path / "best-model.meta.json").exists()
+
+
 def test_max_to_keep(tmp_path):
     params, loss_fn, batch = _problem()
     ad = autodist_tpu.AutoDist(strategy_builder=S.AllReduce())
