@@ -52,6 +52,8 @@ class DistributedStep:
                  step_fn_nodonate: Optional[Callable] = None):
         self.mesh = mesh
         self.mesh_axis = mesh_axis
+        self.all_axes = tuple(mesh.axis_names)
+        self.seq_axis = strategy.graph_config.seq_axis
         self._step_fn = step_fn
         self._step_fn_nodonate = step_fn_nodonate or step_fn
         self.layouts = layouts
@@ -108,7 +110,7 @@ class DistributedStep:
         if sync_state is None:
             sync_state = self._sync_state_init()
         sync_placed = jax.tree_util.tree_map(
-            lambda arr: self._put(arr, P(self.mesh_axis)), sync_state)
+            lambda arr: self._put(arr, P(self.all_axes)), sync_state)
         step0 = self._put(np.zeros((), np.int32), P())
         return TrainState(step=step0, params=params_placed,
                           opt_state=opt_placed, sync_state=sync_placed)
@@ -146,7 +148,8 @@ class DistributedStep:
         """Place a host-global batch onto the mesh, split along the data axis
         (delegates to the Remapper's validated feed path)."""
         from autodist_tpu.remapper import Remapper
-        return Remapper(self.mesh, self.mesh_axis).remap_feed(batch)
+        return Remapper(self.mesh, self.mesh_axis,
+                        seq_axis=self.seq_axis).remap_feed(batch)
 
 
 class GraphTransformer:
@@ -157,9 +160,17 @@ class GraphTransformer:
         self._strategy = compiled_strategy
         self._mesh = mesh
         self._item = model_item
-        self._axis = mesh_axis
+        # the data axis carries batch dim 0 and partitioned-var shards; any
+        # further mesh axes (seq/...) replicate params and also reduce grads
+        self._axis = mesh_axis if mesh_axis in mesh.axis_names else mesh.axis_names[0]
+        self._axes = tuple(mesh.axis_names)
         self._donate = donate
-        self.num_replicas = int(mesh.shape[mesh_axis])
+        self.num_replicas = int(mesh.shape[self._axis])
+        self.total_devices = int(np.prod([mesh.shape[a] for a in self._axes]))
+        self._seq_axis = compiled_strategy.graph_config.seq_axis
+        if self._seq_axis and self._seq_axis not in self._axes:
+            raise ValueError("strategy seq_axis %r not in mesh axes %s"
+                             % (self._seq_axis, self._axes))
 
     # ---------------------------------------------------------------- helpers
 
@@ -177,9 +188,10 @@ class GraphTransformer:
                 raise ValueError("no synchronizer for var %s" % node.var_name)
             kind = ("AllReduceSynchronizer" if cfg.kind == "AllReduce"
                     else "PSSynchronizer")
+            extra = tuple(a for a in self._axes if a != self._axis)
             syncs[node.var_name] = Synchronizer.create(
-                kind, node.var_name, cfg, self.num_replicas, self._axis,
-                layouts[node.var_name])
+                kind, node.var_name, cfg, self.total_devices, self._axis,
+                layouts[node.var_name], extra)
         return syncs
 
     # ---------------------------------------------------------------- main
@@ -218,7 +230,7 @@ class GraphTransformer:
         bucketed_names = {n for b in buckets for n in b.var_names}
 
         # ----- sync_state initialization (host-side zeros w/ leading dev axis)
-        N = self.num_replicas
+        N = self.total_devices
         def sync_state_init():
             st = {"bucket": {}, "var": {}}
             for b in buckets:
@@ -250,6 +262,7 @@ class GraphTransformer:
         optimizer = item.optimizer
         has_aux = item.has_aux
         axis = self._axis
+        all_axes = self._axes
         frozen_names = frozenset(n for n, v in var_infos.items() if not v.trainable)
 
         def local_step(state: TrainState, batch):
@@ -267,7 +280,7 @@ class GraphTransformer:
             new_bucket_state = dict(sync_state.get("bucket", {}))
             new_var_state = dict(sync_state.get("var", {}))
             synced: Dict[str, Any] = {}
-            psum = lambda x: jax.lax.psum(x, axis)  # noqa: E731
+            psum = lambda x: jax.lax.psum(x, all_axes)  # noqa: E731
 
             if N == 1:
                 # single replica: gradients are already global; collectives
@@ -315,12 +328,12 @@ class GraphTransformer:
                 updates = variable_utils.unflatten_named(u_treedef, u)
             new_params = optax.apply_updates(state.params, updates)
 
-            metrics = {"loss": jax.lax.pmean(loss, axis)}
+            metrics = {"loss": jax.lax.pmean(loss, all_axes)}
             if aux is not None:
                 metrics["aux"] = jax.tree_util.tree_map(
-                    lambda a: (jax.lax.pmean(a, axis)
+                    lambda a: (jax.lax.pmean(a, all_axes)
                                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact)
-                               else jax.lax.pmax(a, axis)), aux)
+                               else jax.lax.pmax(a, all_axes)), aux)
             new_sync = {}
             if new_bucket_state:
                 new_sync["bucket"] = new_bucket_state
@@ -338,17 +351,27 @@ class GraphTransformer:
             opt_state_spec, var_infos, layouts, VarLayout(name=""))
         opt_specs = _tree_map_layouts(lambda _leaf, lay: lay.pspec,
                                       opt_state_spec, opt_layout_tree)
-        sync_specs = jax.tree_util.tree_map(lambda _: P(axis), sync_state_init())
+        sync_specs = jax.tree_util.tree_map(lambda _: P(all_axes),
+                                            sync_state_init())
         state_specs = TrainState(step=P(), params=param_specs,
                                  opt_state=opt_specs, sync_state=sync_specs)
-        batch_specs = jax.tree_util.tree_map(
-            lambda leaf: P(axis) if np.ndim(leaf) >= 1 else P(),
-            item.example_batch)
+        seq_axis = self._seq_axis
 
-        # metrics out-structure from an abstract eval of the loss
-        loss_spec = jax.eval_shape(item.loss_fn, item.params, item.example_batch)
+        def batch_pspec(leaf):
+            nd = np.ndim(leaf)
+            if nd == 0:
+                return P()
+            if seq_axis and nd >= 2:
+                return P(axis, seq_axis)
+            return P(axis)
+        batch_specs = jax.tree_util.tree_map(batch_pspec, item.example_batch)
+
+        # metrics out-structure from an abstract eval of the loss (may fail
+        # for SP losses that need a bound axis; scalar-loss fallback)
         metric_specs = {"loss": P()}
         if has_aux:
+            loss_spec = jax.eval_shape(item.loss_fn, item.params,
+                                       item.example_batch)
             metric_specs["aux"] = jax.tree_util.tree_map(lambda _: P(), loss_spec[1])
 
         # check_vma=False: with the check on, differentiating w.r.t. a

@@ -23,8 +23,10 @@ from autodist_tpu.utils import logging
 
 
 class AllReduceSynchronizer(Synchronizer):
-    def __init__(self, var_name, config, num_replicas, mesh_axis="data", layout=None):
-        super().__init__(var_name, config, num_replicas, mesh_axis, layout)
+    def __init__(self, var_name, config, num_replicas, mesh_axis="data",
+                 layout=None, extra_axes=()):
+        super().__init__(var_name, config, num_replicas, mesh_axis, layout,
+                         extra_axes)
         self.compressor = compressor_lib.create(
             getattr(config, "compressor", None), var_name)
         self.group = getattr(config, "group", 0)
@@ -40,8 +42,9 @@ class AllReduceSynchronizer(Synchronizer):
 
     def sync(self, grad, state):
         if self.layout is not None and self.layout.partitioned:
-            # reduce-scatter: summed shard, then normalize to mean
-            local = self.layout.reduce_scatter_grad(grad)
+            # reduce-scatter over the data axis, plain psum over any extra
+            # axes, then normalize to mean over all devices
+            local = self.psum_extra(self.layout.reduce_scatter_grad(grad))
             return local / self.num_replicas, state
         reduced, new_state = self.compressor.reduce(grad, state, self.psum)
         return reduced / self.num_replicas, new_state

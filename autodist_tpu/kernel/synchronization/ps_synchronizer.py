@@ -30,8 +30,10 @@ from autodist_tpu.kernel.synchronization.synchronizer import Synchronizer
 
 
 class PSSynchronizer(Synchronizer):
-    def __init__(self, var_name, config, num_replicas, mesh_axis="data", layout=None):
-        super().__init__(var_name, config, num_replicas, mesh_axis, layout)
+    def __init__(self, var_name, config, num_replicas, mesh_axis="data",
+                 layout=None, extra_axes=()):
+        super().__init__(var_name, config, num_replicas, mesh_axis, layout,
+                         extra_axes)
         self.reduction_destination = getattr(config, "reduction_destination", "")
         self.local_replication = getattr(config, "local_replication", False)
         self.sync_mode = getattr(config, "sync", True)
@@ -45,6 +47,6 @@ class PSSynchronizer(Synchronizer):
 
     def sync(self, grad, state):
         if self.layout is not None and self.layout.partitioned:
-            local = self.layout.reduce_scatter_grad(grad)
+            local = self.psum_extra(self.layout.reduce_scatter_grad(grad))
             return local / self.num_replicas, state
         return self.psum(grad) / self.num_replicas, state

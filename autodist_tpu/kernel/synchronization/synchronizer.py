@@ -21,15 +21,24 @@ from autodist_tpu import const
 
 class Synchronizer(ABC):
     def __init__(self, var_name: str, config, num_replicas: int,
-                 mesh_axis: str = const.DATA_AXIS, layout=None):
+                 mesh_axis: str = const.DATA_AXIS, layout=None,
+                 extra_axes: tuple = ()):
         self.var_name = var_name
         self.config = config
-        self.num_replicas = num_replicas
-        self.mesh_axis = mesh_axis
+        self.num_replicas = num_replicas  # TOTAL devices reducing this grad
+        self.mesh_axis = mesh_axis        # axis carrying partitioned shards
+        self.extra_axes = tuple(extra_axes)  # further axes (seq, ...) to reduce
         self.layout = layout  # VarLayout
 
     def psum(self, x):
-        return jax.lax.psum(x, self.mesh_axis)
+        return jax.lax.psum(x, (self.mesh_axis,) + self.extra_axes)
+
+    def psum_extra(self, x):
+        """Reduce over the non-data axes only (after a data-axis
+        reduce-scatter has handled the data axis)."""
+        if not self.extra_axes:
+            return x
+        return jax.lax.psum(x, self.extra_axes)
 
     @abstractmethod
     def sync(self, grad, state):

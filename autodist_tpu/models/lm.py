@@ -44,18 +44,25 @@ class LMConfig:
 class TransformerLM(nn.Module):
     config: LMConfig
     attn_fn: Optional[Any] = None
+    seq_parallel: bool = False  # offset positions by the seq-shard index
 
     @nn.compact
     def __call__(self, input_ids):
         cfg = self.config
-        seq_len = input_ids.shape[-1]
+        seq_len = input_ids.shape[-1]  # LOCAL length under seq sharding
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                      name="embed")(input_ids)
         x = x * np.sqrt(cfg.d_model)
+        positions = jnp.arange(seq_len)
+        if self.seq_parallel:
+            from autodist_tpu.parallel import sequence
+            positions = positions + sequence.position_offset(seq_len)
         pos = nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
-                       name="pos_embed")(jnp.arange(seq_len)[None])
+                       name="pos_embed")(positions[None])
         x = x + pos
-        mask = causal_mask(seq_len)
+        # with an injected SP attention the causal structure is handled
+        # inside the op; the local mask would be wrong and is skipped
+        mask = None if self.attn_fn is not None else causal_mask(seq_len)
         for i in range(cfg.num_layers):
             x = TransformerBlock(cfg.num_heads, cfg.d_model // cfg.num_heads,
                                  cfg.mlp_dim, dtype=cfg.dtype,
@@ -84,5 +91,45 @@ def make_train_setup(config: Optional[LMConfig] = None, seq_len: int = 128,
     npr = np.random.RandomState(seed)
     example_batch = {"tokens": npr.randint(
         0, cfg.vocab_size, (batch_size, seq_len + 1)).astype(np.int32)}
+    apply_fn = lambda p, ids: model.apply(p, ids)  # noqa: E731
+    return loss_fn, dict(variables), example_batch, apply_fn
+
+
+def make_sp_train_setup(config: Optional[LMConfig] = None, seq_len: int = 128,
+                        batch_size: int = 32, seed: int = 0,
+                        attention: str = "ring"):
+    """Sequence-parallel train setup: tokens arrive [B, S] with S sharded
+    over the ``seq`` mesh axis; attention runs ring/Ulysses; next-token
+    targets cross shard boundaries via ``sequence.shift_left``; the final
+    global position is masked out with an SP-exact weighted mean."""
+    from autodist_tpu import const
+    from autodist_tpu.ops.attention import make_attn_fn
+    from autodist_tpu.parallel import sequence
+
+    cfg = config or LMConfig()
+    attn_fn = make_attn_fn(attention, const.SEQUENCE_AXIS, causal=True)
+    model = TransformerLM(cfg, attn_fn=None, seq_parallel=True)  # init w/o axis
+    rng = jax.random.PRNGKey(seed)
+    variables = model.init(rng, jnp.zeros((1, seq_len), jnp.int32))
+    sp_model = TransformerLM(cfg, attn_fn=attn_fn, seq_parallel=True)
+
+    def loss_fn(params, batch):
+        tokens = batch["tokens"]          # local chunk [B, C]
+        local_len = tokens.shape[1]
+        logits = sp_model.apply(params, tokens)
+        targets = sequence.shift_left(tokens, const.SEQUENCE_AXIS, axis=1)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        # mask the final GLOBAL position (its target wrapped around)
+        pos = jnp.arange(local_len) + sequence.position_offset(
+            local_len, const.SEQUENCE_AXIS)
+        total_len = local_len * sequence.axis_size(const.SEQUENCE_AXIS)
+        weights = (pos < total_len - 1).astype(nll.dtype)[None, :]
+        weights = jnp.broadcast_to(weights, nll.shape)
+        return sequence.global_weighted_mean(nll, weights, const.SEQUENCE_AXIS)
+
+    npr = np.random.RandomState(seed)
+    example_batch = {"tokens": npr.randint(
+        0, cfg.vocab_size, (batch_size, seq_len)).astype(np.int32)}
     apply_fn = lambda p, ids: model.apply(p, ids)  # noqa: E731
     return loss_fn, dict(variables), example_batch, apply_fn

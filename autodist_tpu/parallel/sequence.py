@@ -1,0 +1,91 @@
+"""Sequence/context-parallel helpers.
+
+Long-context training support (absent in the reference — SURVEY §5): models
+run inside the lowering's shard_map with the sequence dimension sharded over
+the ``seq`` mesh axis, attending globally via ring or Ulysses attention
+(``ops/attention.py``). These helpers give SP-aware models the pieces the
+sharding takes away:
+
+- ``position_offset``: global position of the local chunk's first token.
+- ``shift_left``: the next chunk's first element, for next-token targets
+  that cross shard boundaries.
+- ``global_mean`` / ``global_weighted_mean``: loss reductions that are
+  correct under sharding (a weighted mean of shard-weighted-means is NOT the
+  global weighted mean; these psum numerator and denominator).
+
+Each helper no-ops gracefully when the axis is not bound (single-device or
+non-SP lowering), so one model definition serves both paths.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu import const
+
+
+def axis_bound(axis_name: str) -> bool:
+    """True when running inside shard_map with this axis in scope."""
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+def axis_size(axis_name: str) -> int:
+    return jax.lax.psum(1, axis_name) if axis_bound(axis_name) else 1
+
+
+def position_offset(local_seq_len: int, axis_name: str = const.SEQUENCE_AXIS):
+    """Global position of local position 0 on this shard."""
+    if not axis_bound(axis_name):
+        return jnp.zeros((), jnp.int32)
+    return jax.lax.axis_index(axis_name) * local_seq_len
+
+
+def shift_left(x, axis_name: str = const.SEQUENCE_AXIS, axis: int = 1):
+    """Shift a seq-sharded tensor left by one GLOBAL position: element i gets
+    element i+1, with the boundary element fetched from the next shard (the
+    last global position wraps; mask it out in the loss)."""
+    local = jnp.roll(x, -1, axis=axis)
+    if not axis_bound(axis_name):
+        return local
+    n = jax.lax.psum(1, axis_name)
+    # next shard's first element arrives from rank r+1
+    first = jax.lax.slice_in_dim(x, 0, 1, axis=axis)
+    perm = [(i, (i - 1) % n) for i in range(n)]  # r receives from r+1
+    incoming = jax.lax.ppermute(first, axis_name, perm)
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(-1, None)
+    return jax.lax.dynamic_update_slice_in_dim(
+        local, incoming, local.shape[axis] - 1, axis=axis)
+
+
+def global_mean(x, axis_name: str = const.SEQUENCE_AXIS):
+    """True global mean across shards — for METRICS. Do not use as a loss:
+    the lowering already averages device losses/grads, so a loss should
+    return the plain local ``jnp.mean`` (whose device-mean is the global
+    mean for equal shards)."""
+    if not axis_bound(axis_name):
+        return jnp.mean(x)
+    return jax.lax.pmean(jnp.mean(x), axis_name)
+
+
+def global_weighted_mean(values, weights,
+                         axis_name: str = const.SEQUENCE_AXIS):
+    """SP-exact weighted-mean LOSS term: sum(v*w) / global_sum(w).
+
+    Returns the device-local contribution scaled by the axis size, so that
+    the lowering's mean-over-devices recovers exactly
+    ``sum_all(v*w) / sum_all(w)`` — both the loss value (after the metrics
+    pmean) and the gradients (after the grad psum/N) come out globally
+    correct. (Returning an already-psum'd global value here would make the
+    lowering's /N under-scale gradients by the shard count.)"""
+    num = jnp.sum(values * weights)
+    den = jnp.sum(weights)
+    if not axis_bound(axis_name):
+        return num / jnp.maximum(den, 1e-9)
+    n = jax.lax.psum(1, axis_name)
+    den_global = jax.lax.psum(den, axis_name)
+    return n * num / jnp.maximum(den_global, 1e-9)

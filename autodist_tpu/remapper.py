@@ -23,10 +23,12 @@ from autodist_tpu.utils import logging
 
 
 class Remapper:
-    def __init__(self, mesh, mesh_axis: str):
+    def __init__(self, mesh, mesh_axis: str, seq_axis: str = None):
         self.mesh = mesh
         self.mesh_axis = mesh_axis
+        self.seq_axis = seq_axis
         self.num_replicas = mesh.shape[mesh_axis]
+        self.seq_shards = mesh.shape[seq_axis] if seq_axis else 1
 
     # ------------------------------------------------------------------ feed
 
@@ -38,15 +40,21 @@ class Remapper:
         """Split the global batch across replicas along dim 0."""
         def place(leaf):
             arr = np.asarray(leaf)
-            if arr.ndim >= 1:
-                if arr.shape[0] % self.num_replicas != 0:
+            if arr.ndim == 0:
+                return self._place(arr, P())
+            if arr.shape[0] % self.num_replicas != 0:
+                raise ValueError(
+                    "global batch dim %d is not divisible by the %d "
+                    "replicas; pad or resize the batch (TPU programs "
+                    "need static, even shards)" % (arr.shape[0],
+                                                   self.num_replicas))
+            if self.seq_axis and arr.ndim >= 2:
+                if arr.shape[1] % self.seq_shards != 0:
                     raise ValueError(
-                        "global batch dim %d is not divisible by the %d "
-                        "replicas; pad or resize the batch (TPU programs "
-                        "need static, even shards)" % (arr.shape[0],
-                                                       self.num_replicas))
-                return self._place(arr, P(self.mesh_axis))
-            return self._place(arr, P())
+                        "sequence dim %d is not divisible by the %d "
+                        "sequence shards" % (arr.shape[1], self.seq_shards))
+                return self._place(arr, P(self.mesh_axis, self.seq_axis))
+            return self._place(arr, P(self.mesh_axis))
         return jax.tree_util.tree_map(place, batch)
 
     # ----------------------------------------------------------------- fetch

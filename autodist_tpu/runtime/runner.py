@@ -26,7 +26,9 @@ class Runner:
 
     def __init__(self, distributed_step, tracing: bool = False):
         self._dstep = distributed_step
-        self._remapper = Remapper(distributed_step.mesh, distributed_step.mesh_axis)
+        self._remapper = Remapper(distributed_step.mesh,
+                                  distributed_step.mesh_axis,
+                                  seq_axis=distributed_step.seq_axis)
         self._tracing = tracing
         self._trace_started = False
         self.state: Optional[TrainState] = None

@@ -157,13 +157,19 @@ class GraphConfig:
     replicas: List[str] = dataclasses.field(default_factory=list)
     # extension axes beyond the reference (tensor/pipeline/sequence/expert)
     mesh_shape: Optional[Dict[str, int]] = None
+    # when set, batch leaves of rank >= 2 shard their dim 1 (the sequence
+    # dim) over this mesh axis — set by sequence-parallel builders
+    seq_axis: Optional[str] = None
 
     def to_dict(self):
-        return {"replicas": list(self.replicas), "mesh_shape": self.mesh_shape}
+        return {"replicas": list(self.replicas), "mesh_shape": self.mesh_shape,
+                "seq_axis": self.seq_axis}
 
     @classmethod
     def from_dict(cls, d):
-        return cls(replicas=list(d.get("replicas", [])), mesh_shape=d.get("mesh_shape"))
+        return cls(replicas=list(d.get("replicas", [])),
+                   mesh_shape=d.get("mesh_shape"),
+                   seq_axis=d.get("seq_axis"))
 
 
 # ----------------------------------------------------------------- strategy

@@ -1,0 +1,39 @@
+"""Sequence-parallel strategy builder (beyond the reference).
+
+Extends the AllReduce data-parallel strategy with a second mesh axis over
+which the *sequence* dimension of the batch is sharded — the strategy axis
+the reference's proto anticipated but never grew
+(reference ``proto/strategy.proto:36-41``; SURVEY §5 long-context note).
+
+The model must be SP-aware: attention via ``ops.attention.make_attn_fn``
+(ring or Ulysses) and positions/losses via ``parallel/sequence.py`` helpers.
+``models/lm.py`` / ``models/bert.py`` support this through their
+``attn_fn`` / position-ids plumbing.
+"""
+from autodist_tpu import const
+from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+from autodist_tpu.strategy.base import Strategy
+
+
+class SequenceParallelAR(AllReduce):
+    def __init__(self, seq_shards: int, attention: str = "ring",
+                 chunk_size: int = 128, all_reduce_spec: str = "AUTO",
+                 compressor: str = "NoneCompressor"):
+        super().__init__(chunk_size, all_reduce_spec, compressor)
+        if seq_shards < 1:
+            raise ValueError("seq_shards must be >= 1")
+        self.seq_shards = seq_shards
+        self.attention = attention  # metadata: which attn the model should use
+
+    def build(self, model_item, resource_spec) -> Strategy:
+        strategy = super().build(model_item, resource_spec)
+        n_devices = len(strategy.graph_config.replicas)
+        if n_devices % self.seq_shards != 0:
+            raise ValueError("%d devices not divisible by seq_shards=%d"
+                             % (n_devices, self.seq_shards))
+        strategy.graph_config.mesh_shape = {
+            const.DATA_AXIS: n_devices // self.seq_shards,
+            const.SEQUENCE_AXIS: self.seq_shards,
+        }
+        strategy.graph_config.seq_axis = const.SEQUENCE_AXIS
+        return strategy

@@ -1,0 +1,134 @@
+"""Ring / Ulysses attention correctness on an 8-device seq-sharded mesh.
+
+Exactness tests: sequence-parallel attention must reproduce the
+single-device reference bit-for-bit-ish (fp32 tolerance), full and causal.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from autodist_tpu.ops.attention import (reference_attention, ring_attention,
+                                        ulysses_attention)
+
+B, S, H, D = 2, 64, 8, 16
+
+
+def _qkv(seed=0):
+    rng = np.random.RandomState(seed)
+    return [jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.3)
+            for _ in range(3)]
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]), ("seq",))
+
+
+def _run_sharded(fn, q, k, v):
+    mesh = _mesh()
+    spec = P(None, "seq")
+    sharded = jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False))
+    return sharded(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+def test_ring_attention_exact(causal):
+    q, k, v = _qkv()
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None] if causal else None
+    expected = reference_attention(q, k, v, mask)
+    got = _run_sharded(
+        lambda a, b, c: ring_attention(a, b, c, "seq", causal=causal), q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+def test_ulysses_attention_exact(causal):
+    q, k, v = _qkv(1)
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))[None, None] if causal else None
+    expected = reference_attention(q, k, v, mask)
+    got = _run_sharded(
+        lambda a, b, c: ulysses_attention(a, b, c, "seq", causal=causal),
+        q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_sp_lm_one_step_matches_dp():
+    """Full stack: tiny LM trained one step on a 2(data)x4(seq) mesh via
+    SequenceParallelAR must match the equivalent non-SP model's step."""
+    import optax
+    import autodist_tpu
+    from autodist_tpu import strategy as St
+    from autodist_tpu.models import lm
+
+    cfg = lm.LMConfig.tiny()
+    seq_len, batch = 32, 8
+    sp_loss, params, ex_batch, _ = lm.make_sp_train_setup(
+        cfg, seq_len=seq_len, batch_size=batch, attention="ring")
+
+    # single-device reference: same params, causal-mask model, same objective
+    ref_model = lm.TransformerLM(cfg, attn_fn=None, seq_parallel=True)
+
+    def ref_loss(p, b):
+        tokens = b["tokens"]
+        logits = ref_model.apply(p, tokens)
+        targets = jnp.roll(tokens, -1, axis=1)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        w = (jnp.arange(seq_len) < seq_len - 1).astype(nll.dtype)[None, :]
+        w = jnp.broadcast_to(w, nll.shape)
+        return jnp.sum(nll * w) / jnp.sum(w)
+
+    opt = optax.sgd(0.1)
+    g = jax.grad(ref_loss)(params, ex_batch)
+    updates, _ = opt.update(g, opt.init(params), params)
+    import optax as _o
+    expected = _o.apply_updates(params, updates)
+
+    ad = autodist_tpu.AutoDist(
+        strategy_builder=St.SequenceParallelAR(seq_shards=4))
+    runner = ad.build(sp_loss, opt, params, ex_batch)
+    runner.init(params)
+    m = runner.run(ex_batch)
+    assert np.isfinite(m["loss"])
+    got = runner.gather_params()
+    flat_e, _ = jax.tree_util.tree_flatten_with_path(expected)
+    flat_g, _ = jax.tree_util.tree_flatten_with_path(got)
+    for (path, e), (_, gv) in zip(flat_e, flat_g):
+        np.testing.assert_allclose(np.asarray(gv), np.asarray(e), rtol=2e-4,
+                                   atol=2e-5, err_msg=str(path))
+    autodist_tpu.reset()
+
+
+def test_ring_attention_grads_match():
+    """Differentiability: grads through ring attention == reference grads."""
+    q, k, v = _qkv(2)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(reference_attention(q, k, v) ** 2)
+
+    expected = jax.grad(ref_loss)(q, k, v)
+
+    mesh = _mesh()
+    spec = P(None, "seq")
+
+    def ring_loss_local(q, k, v):
+        # local term of the global sum-loss; cross-device grad contributions
+        # to k/v flow back through the ppermute transpose
+        out = ring_attention(q, k, v, "seq")
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def sharded_grad(q, k, v):
+        g = jax.grad(ring_loss_local)(q, k, v)
+        return g
+
+    f = jax.jit(jax.shard_map(sharded_grad, mesh=mesh,
+                              in_specs=(spec, spec, spec), out_specs=spec,
+                              check_vma=False))
+    got = f(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-3, atol=2e-4)
