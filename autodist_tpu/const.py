@@ -18,7 +18,8 @@ DEFAULT_CHECKPOINT_DIR = os.path.join(DEFAULT_WORKING_DIR, "checkpoints")
 # Port range for the coordination service (analog of the reference's TF
 # server ports 15000-16000, reference autodist/const.py:36-38).
 DEFAULT_PORT_RANGE = iter(range(15000, 16000))
-DEFAULT_COORDINATOR_PORT = 15999
+DEFAULT_COORDINATOR_PORT = 15999   # jax.distributed coordination
+DEFAULT_COORDSVC_PORT = 15998      # native coordination service (barriers/staleness)
 
 # Naming prefixes (analog of replica name-scope prefixes,
 # reference autodist/const.py:40-44).

@@ -386,12 +386,16 @@ class GraphTransformer:
         step_fn = jax.jit(sharded, donate_argnums=(0,) if self._donate else ())
         step_fn_nodonate = jax.jit(sharded) if self._donate else step_fn
 
+        ps_syncs = [s for s in syncs.values()
+                    if s.__class__.__name__ == "PSSynchronizer"]
         metadata = {
-            "ps_assignments": {
-                n: s.reduction_destination for n, s in syncs.items()
-                if s.__class__.__name__ == "PSSynchronizer"},
+            "ps_assignments": {s.var_name: s.reduction_destination
+                               for s in ps_syncs},
             "buckets": [b.key for b in buckets],
             "per_var_compressors": per_var_comp,
+            # staleness window for the runner's cross-process pacing
+            "staleness": max((s.staleness for s in ps_syncs), default=0),
+            "async": any(not s.sync_mode for s in ps_syncs),
         }
         logging.info("GraphTransformer: lowered %d vars (%d partitioned, "
                      "%d buckets) over %d replicas",

@@ -20,11 +20,13 @@ surgery). The reference's machinery maps onto TPU as follows:
   ``parallel/ps.py``.
 - *Proxy variables* (``common/proxy_variable.py``): worker-local caches —
   see ``kernel/common/proxy_variable.py``.
-- *Staleness / async* (``:388-458``): bounded-staleness execution is a
-  runtime-scheduling property, not a graph property, on TPU; it belongs to
-  the runner's dispatch layer coordinated by the host coordination service.
-  NOT IMPLEMENTED YET — requesting it logs a warning and trains
-  synchronously.
+- *Staleness* (``:388-458``): bounded staleness is a runtime-scheduling
+  property on TPU, implemented by the Runner's cross-process pacing
+  through the native coordination service
+  (``runtime/coordination.py``): each process reports its step and blocks
+  while more than ``staleness`` steps ahead of the slowest worker — the
+  semantics the reference built from size-``s`` token queues. Fully-async
+  PS (``sync=False``) is not implemented and logs a warning.
 """
 from autodist_tpu.kernel.synchronization.synchronizer import Synchronizer
 
@@ -38,12 +40,12 @@ class PSSynchronizer(Synchronizer):
         self.local_replication = getattr(config, "local_replication", False)
         self.sync_mode = getattr(config, "sync", True)
         self.staleness = getattr(config, "staleness", 0)
-        if not self.sync_mode or self.staleness > 0:
+        if not self.sync_mode:
             from autodist_tpu.utils import logging
             logging.warning(
-                "var %s: async/bounded-staleness PS (sync=%s, staleness=%d) "
-                "is not implemented yet; executing fully synchronously",
-                var_name, self.sync_mode, self.staleness)
+                "var %s: fully-async PS (sync=False) is not implemented; "
+                "executing synchronously (bounded staleness IS supported — "
+                "set staleness>0 for cross-process slack)", var_name)
 
     def sync(self, grad, state):
         if self.layout is not None and self.layout.partitioned:

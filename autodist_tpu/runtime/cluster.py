@@ -78,11 +78,23 @@ class Cluster:
     # ------------------------------------------------------------ lifecycle
 
     def start(self):
-        """Initialize the distributed runtime on the chief. Workers join via
-        ``server_starter.maybe_init_distributed`` when their (relaunched)
-        script constructs AutoDist."""
+        """Initialize the distributed runtime on the chief: bring up the
+        native coordination service (barriers/staleness/heartbeats — the
+        reference's per-node TF server role) and join jax.distributed.
+        Workers join via ``server_starter.maybe_init_distributed`` when their
+        (relaunched) script constructs AutoDist."""
         if self._started:
             return
+        if const.is_chief() and not const.ENV.ADT_DEBUG_REMOTE.val:
+            from autodist_tpu.runtime.coordination import CoordinationServer
+            try:
+                self._coordsvc = CoordinationServer(const.DEFAULT_COORDSVC_PORT)
+                self._coordsvc.start()
+                atexit.register(self._coordsvc.stop)
+            except (RuntimeError, TimeoutError, OSError,
+                    subprocess.CalledProcessError) as e:
+                logging.warning("coordination service unavailable: %s", e)
+                self._coordsvc = None
         from autodist_tpu.runtime import server_starter
         server_starter.init_distributed(
             coordinator_address=self.coordinator_address,

@@ -1,0 +1,143 @@
+"""Client + lifecycle for the native coordination service.
+
+The C++ service (``native/coordination/coordination_service.cc``) is the
+TPU-native control plane replacing the reference's per-node TF gRPC servers
+and its C++ queue/accumulator sync kernels (SURVEY §2.0). This module:
+
+- builds the binary on demand (g++, cached under ``native/build/``),
+- starts/stops it (chief-side, the reference's ``server_starter`` role),
+- exposes a blocking client: kv, counters, barriers, bounded-staleness
+  step windows, heartbeats + dead-worker queries.
+
+The bounded-staleness window is the real implementation of the strategy's
+``staleness`` knob across *processes*: each process reports its step and
+blocks in ``wait_staleness`` while it is more than ``staleness`` steps ahead
+of the slowest worker — the semantics the reference built from size-``s``
+token queues (reference ``ps_synchronizer.py:388-458``).
+"""
+import os
+import socket
+import subprocess
+import time
+from typing import List, Optional
+
+from autodist_tpu import const
+from autodist_tpu.utils import logging
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_BINARY = os.path.join(_NATIVE_DIR, "build", "coordination_service")
+
+
+def build_binary(force: bool = False) -> str:
+    """Compile the service with make (cached)."""
+    src = os.path.join(_NATIVE_DIR, "coordination", "coordination_service.cc")
+    if not force and os.path.exists(_BINARY) and \
+            os.path.getmtime(_BINARY) >= os.path.getmtime(src):
+        return _BINARY
+    logging.info("building coordination service (%s)", src)
+    subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                   capture_output=True)
+    return _BINARY
+
+
+class CoordinationServer:
+    """Owns a service process (chief-side)."""
+
+    def __init__(self, port: int = const.DEFAULT_COORDINATOR_PORT):
+        self.port = port
+        self._proc: Optional[subprocess.Popen] = None
+
+    def start(self, wait: float = 5.0):
+        binary = build_binary()
+        self._proc = subprocess.Popen([binary, str(self.port)],
+                                      stderr=subprocess.DEVNULL)
+        deadline = time.time() + wait
+        while time.time() < deadline:
+            try:
+                CoordinationClient("127.0.0.1", self.port).ping()
+                return self
+            except OSError:
+                if self._proc.poll() is not None:
+                    raise RuntimeError(
+                        "coordination service exited with %s (port %d busy?)"
+                        % (self._proc.returncode, self.port))
+                time.sleep(0.05)
+        raise TimeoutError("coordination service did not come up")
+
+    def stop(self):
+        if self._proc and self._proc.poll() is None:
+            try:
+                CoordinationClient("127.0.0.1", self.port).shutdown()
+                self._proc.wait(timeout=2)
+            except (OSError, subprocess.TimeoutExpired):
+                self._proc.kill()
+        self._proc = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class CoordinationClient:
+    def __init__(self, host: str = "127.0.0.1",
+                 port: int = const.DEFAULT_COORDINATOR_PORT,
+                 timeout: Optional[float] = None):
+        self._sock = socket.create_connection((host, port), timeout=5)
+        self._sock.settimeout(timeout)
+        self._buf = b""
+
+    def _cmd(self, line: str) -> str:
+        self._sock.sendall(line.encode() + b"\n")
+        while b"\n" not in self._buf:
+            chunk = self._sock.recv(4096)
+            if not chunk:
+                raise OSError("coordination service closed connection")
+            self._buf += chunk
+        resp, self._buf = self._buf.split(b"\n", 1)
+        return resp.decode().strip()
+
+    # ----------------------------------------------------------------- api
+
+    def ping(self) -> bool:
+        return self._cmd("PING") == "PONG"
+
+    def put(self, key: str, value: str):
+        assert self._cmd("PUT %s %s" % (key, value)) == "OK"
+
+    def get(self, key: str) -> Optional[str]:
+        resp = self._cmd("GET %s" % key)
+        return None if resp == "NONE" else resp[4:]
+
+    def incr(self, name: str) -> int:
+        return int(self._cmd("INC %s" % name)[4:])
+
+    def barrier(self, name: str, num_workers: int):
+        """Block until ``num_workers`` processes reach this barrier."""
+        assert self._cmd("BARRIER %s %d" % (name, num_workers)) == "OK"
+
+    def report_step(self, worker: str, step: int):
+        assert self._cmd("STEP %s %d" % (worker, step)) == "OK"
+
+    def min_step(self) -> int:
+        return int(self._cmd("MINSTEP")[4:])
+
+    def wait_staleness(self, my_step: int, staleness: int):
+        """Block while my_step > min_step + staleness (the bounded-staleness
+        window; with staleness=0 this is lockstep sync)."""
+        assert self._cmd("WAITMIN %d %d" % (my_step, staleness)) == "OK"
+
+    def heartbeat(self, worker: str):
+        assert self._cmd("HEARTBEAT %s" % worker) == "OK"
+
+    def dead_workers(self, timeout_s: float) -> List[str]:
+        resp = self._cmd("DEADLIST %s" % timeout_s)
+        return [] if resp == "NONE" else resp[4:].split(",")
+
+    def shutdown(self):
+        self._cmd("SHUTDOWN")
+
+    def close(self):
+        self._sock.close()

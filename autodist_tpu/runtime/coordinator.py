@@ -20,11 +20,40 @@ from autodist_tpu.utils import logging
 
 
 class Coordinator:
-    def __init__(self, strategy, cluster: Cluster):
+    def __init__(self, strategy, cluster: Cluster,
+                 heartbeat_timeout: float = 60.0):
         self._strategy = strategy
         self._cluster = cluster
         self._threads: List[threading.Thread] = []
+        self._heartbeat_timeout = heartbeat_timeout
+        self._stop_watchdog = threading.Event()
         atexit.register(self.join)
+
+    def start_watchdog(self):
+        """Heartbeat-based failure detection via the coordination service
+        (augments the process-exit watcher): a worker that stops heartbeating
+        for ``heartbeat_timeout`` seconds fails the job fast."""
+        from autodist_tpu.runtime.coordination import CoordinationClient
+
+        def watch():
+            import time as _time
+            try:
+                client = CoordinationClient("127.0.0.1",
+                                            const.DEFAULT_COORDSVC_PORT)
+            except OSError:
+                return
+            while not self._stop_watchdog.wait(self._heartbeat_timeout / 4):
+                try:
+                    dead = client.dead_workers(self._heartbeat_timeout)
+                except OSError:
+                    return
+                if dead:
+                    logging.error("workers %s missed heartbeats — aborting",
+                                  dead)
+                    os._exit(1)
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        self._threads.append(t)
 
     def launch_clients(self):
         """Relaunch this script on every non-chief host."""

@@ -32,6 +32,27 @@ class Runner:
         self._tracing = tracing
         self._trace_started = False
         self.state: Optional[TrainState] = None
+        self._step_count = 0
+        self._coord = None
+        self._staleness = int(distributed_step.metadata.get("staleness", 0))
+        # bounded-staleness pacing is a cross-process property; within one
+        # SPMD program all replicas are already lockstep
+        if self._staleness > 0 and const.ENV.ADT_NUM_PROCESSES.val > 1:
+            self._coord = self._connect_coordination()
+
+    def _connect_coordination(self):
+        from autodist_tpu.runtime.coordination import CoordinationClient
+        host = (const.ENV.ADT_COORDINATOR_ADDR.val.split(":")[0]
+                or "127.0.0.1")
+        try:
+            client = CoordinationClient(host, const.DEFAULT_COORDSVC_PORT)
+            logging.info("staleness pacing active (window=%d) via %s",
+                         self._staleness, host)
+            return client
+        except OSError as e:
+            logging.warning("coordination service unreachable (%s); "
+                            "staleness pacing disabled", e)
+            return None
 
     @property
     def distributed_step(self):
@@ -63,6 +84,16 @@ class Runner:
         new_state, metrics = self._dstep(st, sharded_batch, donate=state is None)
         if state is None:
             self.state = new_state
+        self._step_count += 1
+        if self._coord is not None:
+            # bounded staleness across processes (the reference's size-s
+            # token-queue semantics, ps_synchronizer.py:388-458): report our
+            # step, then block while more than `staleness` ahead of the
+            # slowest worker
+            worker = const.ENV.ADT_WORKER.val or "chief"
+            self._coord.report_step(worker, self._step_count)
+            self._coord.heartbeat(worker)
+            self._coord.wait_staleness(self._step_count, self._staleness)
         if self._tracing and self._trace_started:
             jax.block_until_ready(metrics)
             jax.profiler.stop_trace()

@@ -1,0 +1,319 @@
+// Coordination service — the native control-plane runtime.
+//
+// TPU-native replacement for the native surfaces the reference borrows from
+// TensorFlow's C++ runtime (SURVEY §2.0): the per-node distributed gRPC
+// server (reference autodist/utils/server_starter.py launches tf.Server),
+// and the C++ ConditionalAccumulator / token-FIFOQueue kernels that
+// implement PS sync barriers and bounded staleness
+// (reference kernel/synchronization/ps_synchronizer.py:335-458).
+//
+// XLA owns the data plane (ICI/DCN collectives); what training jobs still
+// need from a host-side service is exactly what those queues provided:
+//   - job-wide named barriers            (sync PS step boundary)
+//   - a key/value board                  (strategy-id / address exchange)
+//   - per-worker step reports + MINSTEP  (bounded-staleness window:
+//                                         proceed while my_step <= min+s)
+//   - heartbeats + dead-worker detection (the Coordinator's fail-fast
+//                                         watcher, reference coordinator.py:98-110)
+//
+// Design: single-threaded poll(2) event loop, newline-delimited text
+// protocol, no dependencies. Blocking ops (BARRIER, WAITMIN) are handled by
+// parking the reply until the condition fires — no server-side threads.
+//
+// Protocol (one command per line, space-separated):
+//   PING                      -> PONG
+//   PUT <key> <value>         -> OK
+//   GET <key>                 -> VAL <value> | NONE
+//   INC <name>                -> VAL <n>              (atomic counter)
+//   BARRIER <name> <n>        -> OK                   (blocks until n arrive)
+//   STEP <worker> <step>      -> OK                   (report progress)
+//   MINSTEP                   -> VAL <min over workers>
+//   WAITMIN <step> <stale>    -> OK                   (blocks until
+//                                                      step <= minstep+stale)
+//   HEARTBEAT <worker>        -> OK
+//   DEADLIST <timeout_s>      -> VAL <w1,w2,...> | NONE
+//   SHUTDOWN                  -> OK (then exits)
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+double NowSeconds() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+struct Waiter {
+  int fd;
+  // barrier waiter
+  std::string barrier;
+  // staleness waiter: proceed when step <= minstep + staleness
+  bool is_waitmin = false;
+  long step = 0;
+  long staleness = 0;
+};
+
+struct Conn {
+  int fd;
+  std::string inbuf;
+  std::string outbuf;
+};
+
+class Server {
+ public:
+  explicit Server(int port) : port_(port) {}
+
+  int Run() {
+    listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) { perror("socket"); return 1; }
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+      perror("bind");
+      return 1;
+    }
+    if (listen(listen_fd_, 128) < 0) { perror("listen"); return 1; }
+    fprintf(stderr, "[coordination_service] listening on :%d\n", port_);
+    fflush(stderr);
+    EventLoop();
+    return 0;
+  }
+
+ private:
+  void EventLoop() {
+    while (!shutdown_) {
+      std::vector<pollfd> fds;
+      fds.push_back({listen_fd_, POLLIN, 0});
+      for (auto& [fd, conn] : conns_) {
+        short events = POLLIN;
+        if (!conn.outbuf.empty()) events |= POLLOUT;
+        fds.push_back({fd, events, 0});
+      }
+      int rc = poll(fds.data(), fds.size(), 1000);
+      if (rc < 0 && errno != EINTR) { perror("poll"); break; }
+      if (fds[0].revents & POLLIN) Accept();
+      std::vector<int> closed;
+      for (size_t i = 1; i < fds.size(); ++i) {
+        int fd = fds[i].fd;
+        auto it = conns_.find(fd);
+        if (it == conns_.end()) continue;
+        if (fds[i].revents & (POLLERR | POLLHUP)) {
+          closed.push_back(fd);
+          continue;
+        }
+        if (fds[i].revents & POLLIN) {
+          if (!ReadFrom(it->second)) closed.push_back(fd);
+        }
+        if (fds[i].revents & POLLOUT) Flush(it->second);
+      }
+      for (int fd : closed) CloseConn(fd);
+    }
+  }
+
+  void Accept() {
+    int fd = accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) return;
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+    conns_[fd] = Conn{fd, "", ""};
+  }
+
+  bool ReadFrom(Conn& conn) {
+    char buf[4096];
+    while (true) {
+      ssize_t n = recv(conn.fd, buf, sizeof(buf), 0);
+      if (n > 0) {
+        conn.inbuf.append(buf, n);
+      } else if (n == 0) {
+        return false;  // peer closed
+      } else {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        return false;
+      }
+    }
+    size_t pos;
+    while ((pos = conn.inbuf.find('\n')) != std::string::npos) {
+      std::string line = conn.inbuf.substr(0, pos);
+      conn.inbuf.erase(0, pos + 1);
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      Handle(conn, line);
+    }
+    Flush(conn);
+    return true;
+  }
+
+  static std::vector<std::string> Split(const std::string& s) {
+    std::vector<std::string> out;
+    size_t i = 0;
+    while (i < s.size()) {
+      size_t j = s.find(' ', i);
+      if (j == std::string::npos) j = s.size();
+      if (j > i) out.push_back(s.substr(i, j - i));
+      i = j + 1;
+    }
+    return out;
+  }
+
+  void Reply(Conn& conn, const std::string& msg) {
+    conn.outbuf += msg;
+    conn.outbuf += '\n';
+  }
+
+  void ReplyFd(int fd, const std::string& msg) {
+    auto it = conns_.find(fd);
+    if (it != conns_.end()) {
+      Reply(it->second, msg);
+      Flush(it->second);
+    }
+  }
+
+  void Flush(Conn& conn) {
+    while (!conn.outbuf.empty()) {
+      ssize_t n = send(conn.fd, conn.outbuf.data(), conn.outbuf.size(),
+                       MSG_NOSIGNAL);
+      if (n > 0) {
+        conn.outbuf.erase(0, n);
+      } else {
+        break;  // EAGAIN or error; poll will retry / detect close
+      }
+    }
+  }
+
+  void Handle(Conn& conn, const std::string& line) {
+    auto parts = Split(line);
+    if (parts.empty()) return;
+    const std::string& cmd = parts[0];
+    if (cmd == "PING") {
+      Reply(conn, "PONG");
+    } else if (cmd == "PUT" && parts.size() >= 3) {
+      // value may contain spaces: everything after the key
+      size_t vpos = line.find(parts[1]) + parts[1].size() + 1;
+      kv_[parts[1]] = line.substr(vpos);
+      Reply(conn, "OK");
+    } else if (cmd == "GET" && parts.size() == 2) {
+      auto it = kv_.find(parts[1]);
+      if (it == kv_.end()) Reply(conn, "NONE");
+      else Reply(conn, "VAL " + it->second);
+    } else if (cmd == "INC" && parts.size() == 2) {
+      long v = ++counters_[parts[1]];
+      Reply(conn, "VAL " + std::to_string(v));
+    } else if (cmd == "BARRIER" && parts.size() == 3) {
+      const std::string& name = parts[1];
+      long want = atol(parts[2].c_str());
+      barrier_waiters_[name].push_back(conn.fd);
+      if (static_cast<long>(barrier_waiters_[name].size()) >= want) {
+        for (int fd : barrier_waiters_[name]) ReplyFd(fd, "OK");
+        barrier_waiters_.erase(name);
+      }
+    } else if (cmd == "STEP" && parts.size() == 3) {
+      steps_[parts[1]] = atol(parts[2].c_str());
+      Reply(conn, "OK");
+      WakeStaleWaiters();
+    } else if (cmd == "MINSTEP") {
+      Reply(conn, "VAL " + std::to_string(MinStep()));
+    } else if (cmd == "WAITMIN" && parts.size() == 3) {
+      long step = atol(parts[1].c_str());
+      long stale = atol(parts[2].c_str());
+      if (step <= MinStep() + stale) {
+        Reply(conn, "OK");
+      } else {
+        stale_waiters_.push_back(Waiter{conn.fd, "", true, step, stale});
+      }
+    } else if (cmd == "HEARTBEAT" && parts.size() == 2) {
+      heartbeats_[parts[1]] = NowSeconds();
+      Reply(conn, "OK");
+    } else if (cmd == "DEADLIST" && parts.size() == 2) {
+      double timeout = atof(parts[1].c_str());
+      double now = NowSeconds();
+      std::string dead;
+      for (auto& [w, t] : heartbeats_) {
+        if (now - t > timeout) {
+          if (!dead.empty()) dead += ",";
+          dead += w;
+        }
+      }
+      Reply(conn, dead.empty() ? "NONE" : "VAL " + dead);
+    } else if (cmd == "SHUTDOWN") {
+      Reply(conn, "OK");
+      Flush(conn);
+      shutdown_ = true;
+    } else {
+      Reply(conn, "ERR unknown command");
+    }
+  }
+
+  long MinStep() {
+    long m = 0;
+    bool first = true;
+    for (auto& [w, s] : steps_) {
+      if (first || s < m) { m = s; first = false; }
+    }
+    return m;
+  }
+
+  void WakeStaleWaiters() {
+    long m = MinStep();
+    std::vector<Waiter> still;
+    for (auto& w : stale_waiters_) {
+      if (w.step <= m + w.staleness) ReplyFd(w.fd, "OK");
+      else still.push_back(w);
+    }
+    stale_waiters_.swap(still);
+  }
+
+  void CloseConn(int fd) {
+    // drop from any barrier/staleness wait lists
+    for (auto& [name, fds] : barrier_waiters_) {
+      fds.erase(std::remove(fds.begin(), fds.end(), fd), fds.end());
+    }
+    std::vector<Waiter> still;
+    for (auto& w : stale_waiters_)
+      if (w.fd != fd) still.push_back(w);
+    stale_waiters_.swap(still);
+    close(fd);
+    conns_.erase(fd);
+  }
+
+  int port_;
+  int listen_fd_ = -1;
+  bool shutdown_ = false;
+  std::map<int, Conn> conns_;
+  std::map<std::string, std::string> kv_;
+  std::map<std::string, long> counters_;
+  std::map<std::string, std::vector<int>> barrier_waiters_;
+  std::vector<Waiter> stale_waiters_;
+  std::map<std::string, long> steps_;
+  std::map<std::string, double> heartbeats_;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  signal(SIGPIPE, SIG_IGN);
+  int port = argc > 1 ? atoi(argv[1]) : 15999;
+  Server server(port);
+  return server.Run();
+}

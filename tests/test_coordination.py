@@ -1,0 +1,93 @@
+"""Native coordination-service tests: build the C++ binary, drive it over
+TCP from multiple threads (barriers, staleness windows, heartbeats)."""
+import threading
+import time
+
+import pytest
+
+from autodist_tpu.runtime.coordination import (CoordinationClient,
+                                               CoordinationServer)
+
+PORT = 15913
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = CoordinationServer(port=PORT)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _client(**kw):
+    return CoordinationClient("127.0.0.1", PORT, **kw)
+
+
+def test_ping_kv_counter(server):
+    c = _client()
+    assert c.ping()
+    c.put("strategy_id", "20260729T0001 with spaces")
+    assert c.get("strategy_id") == "20260729T0001 with spaces"
+    assert c.get("missing") is None
+    assert c.incr("n") == 1
+    assert c.incr("n") == 2
+    c.close()
+
+
+def test_barrier_releases_all(server):
+    results = []
+
+    def worker(i):
+        c = _client()
+        c.barrier("b1", 3)
+        results.append(i)
+        c.close()
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    assert results == []  # nobody through until the third arrives
+    c = _client()
+    c.barrier("b1", 3)
+    for t in threads:
+        t.join(timeout=5)
+    assert sorted(results) == [0, 1]
+    c.close()
+
+
+def test_staleness_window_blocks_fast_worker(server):
+    c_fast, c_slow = _client(), _client()
+    c_slow.report_step("slow", 0)
+    c_fast.report_step("fast", 3)
+    assert c_fast.min_step() == 0
+    # fast worker at step 3 with staleness 1 must block until slow reaches 2
+    released = threading.Event()
+
+    def fast_wait():
+        c = _client()
+        c.wait_staleness(3, 1)
+        released.set()
+        c.close()
+
+    t = threading.Thread(target=fast_wait)
+    t.start()
+    time.sleep(0.2)
+    assert not released.is_set()
+    c_slow.report_step("slow", 2)
+    t.join(timeout=5)
+    assert released.is_set()
+    # staleness 0 == lockstep: step equal to min passes immediately
+    c_fast.wait_staleness(2, 0)
+    c_fast.close()
+    c_slow.close()
+
+
+def test_heartbeat_dead_detection(server):
+    c = _client()
+    c.heartbeat("w0")
+    assert c.dead_workers(5.0) == []
+    time.sleep(0.3)
+    dead = c.dead_workers(0.1)
+    assert "w0" in dead
+    c.close()
