@@ -1,0 +1,46 @@
+"""Strategy simulator — ranks candidate strategies by estimated step time.
+
+The realized version of the reference's absent AutoSync simulator
+(``autodist/simulator/`` stub; its dataset README describes learned
+<resource_spec, strategy> -> runtime models). Interface mirrors what the
+AutoSync paper's pipeline needs: ``simulate`` one strategy, ``rank`` many.
+"""
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from autodist_tpu.simulator.cost_model import CostBreakdown, CostModel
+from autodist_tpu.strategy.base import Strategy
+from autodist_tpu.utils import logging
+
+
+@dataclasses.dataclass
+class SimulationResult:
+    strategy: Strategy
+    breakdown: CostBreakdown
+    label: str = ""
+
+    @property
+    def step_time_s(self) -> float:
+        return self.breakdown.step_time_s
+
+
+class Simulator:
+    def __init__(self, model_item, resource_spec, **cost_model_kwargs):
+        self._cost_model = CostModel(model_item, resource_spec,
+                                     **cost_model_kwargs)
+
+    def simulate(self, strategy: Strategy, label: str = "") -> SimulationResult:
+        return SimulationResult(strategy, self._cost_model.estimate(strategy),
+                                label)
+
+    def rank(self, candidates: Sequence[Tuple[str, Strategy]]
+             ) -> List[SimulationResult]:
+        results = [self.simulate(s, label) for label, s in candidates]
+        results.sort(key=lambda r: r.step_time_s)
+        for r in results:
+            logging.debug("simulated %-28s step=%.3fms (compute=%.3f ar=%.3f "
+                          "ps=%.3f)", r.label, r.step_time_s * 1e3,
+                          r.breakdown.compute_s * 1e3,
+                          r.breakdown.allreduce_s * 1e3,
+                          r.breakdown.ps_s * 1e3)
+        return results

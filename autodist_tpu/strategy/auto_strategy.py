@@ -1,0 +1,61 @@
+"""AutoStrategy — cost-model-driven strategy selection.
+
+The reference only *describes* automatic strategy optimization
+(``docs/design/rationale.rst``; its simulator is an empty stub). Here it is
+implemented: build every candidate strategy from the standard builders (plus
+chunk-size/compressor variants), rank them with the analytic simulator
+(``simulator/simulator.py``), and return the cheapest — a pure function of
+(ModelItem, ResourceSpec) like every other builder, so chief and workers
+agree deterministically.
+"""
+from typing import List, Optional, Tuple
+
+from autodist_tpu.strategy.base import Strategy, StrategyBuilder
+from autodist_tpu.utils import logging
+
+
+def default_candidates() -> List[Tuple[str, StrategyBuilder]]:
+    from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+    from autodist_tpu.strategy.parallax_strategy import Parallax
+    from autodist_tpu.strategy.partitioned_all_reduce_strategy import PartitionedAR
+    from autodist_tpu.strategy.partitioned_ps_strategy import PartitionedPS
+    from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing
+    from autodist_tpu.strategy.ps_strategy import PS
+    return [
+        ("PS", PS()),
+        ("PSLoadBalancing", PSLoadBalancing()),
+        ("PartitionedPS", PartitionedPS()),
+        ("AllReduce/128", AllReduce(chunk_size=128)),
+        ("AllReduce/512", AllReduce(chunk_size=512)),
+        ("AllReduce/bf16", AllReduce(compressor="HorovodCompressor")),
+        ("PartitionedAR", PartitionedAR()),
+        ("Parallax", Parallax()),
+        ("Parallax/bf16", Parallax(compressor="HorovodCompressor")),
+    ]
+
+
+class AutoStrategy(StrategyBuilder):
+    def __init__(self, candidates: Optional[List[Tuple[str, StrategyBuilder]]] = None,
+                 **cost_model_kwargs):
+        self._candidates = candidates
+        self._cm_kwargs = cost_model_kwargs
+        self.last_ranking = None  # exposed for inspection/tests
+
+    def build(self, model_item, resource_spec) -> Strategy:
+        from autodist_tpu.simulator.simulator import Simulator
+        candidates = self._candidates or default_candidates()
+        built = []
+        for label, builder in candidates:
+            try:
+                built.append((label, builder.build(model_item, resource_spec)))
+            except Exception as e:  # noqa: BLE001 — skip inapplicable builders
+                logging.debug("AutoStrategy: candidate %s failed (%s)", label, e)
+        sim = Simulator(model_item, resource_spec, **self._cm_kwargs)
+        ranking = sim.rank(built)
+        self.last_ranking = ranking
+        best = ranking[0]
+        logging.info("AutoStrategy picked %s (est %.3f ms/step; next: %s)",
+                     best.label, best.step_time_s * 1e3,
+                     ", ".join("%s=%.3fms" % (r.label, r.step_time_s * 1e3)
+                               for r in ranking[1:3]))
+        return best.strategy

@@ -1,0 +1,89 @@
+"""Cost model / simulator / AutoStrategy tests."""
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import autodist_tpu
+from autodist_tpu import strategy as S
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.simulator.simulator import Simulator
+from autodist_tpu.strategy.auto_strategy import AutoStrategy
+
+
+def _item(dense_dim=512, vocab=4096):
+    params = {"emb": jnp.zeros((vocab, 64)),
+              "w1": jnp.zeros((64, dense_dim)),
+              "w2": jnp.zeros((dense_dim, 1))}
+
+    def loss_fn(p, batch):
+        e = jnp.take(p["emb"], batch["ids"], axis=0)
+        h = jnp.tanh(e @ p["w1"])
+        return jnp.mean((h @ p["w2"] - batch["y"]) ** 2)
+
+    batch = {"ids": np.zeros((32,), np.int32),
+             "y": np.zeros((32, 1), np.float32)}
+    return ModelItem(loss_fn=loss_fn, optimizer=optax.sgd(0.1), params=params,
+                     example_batch=batch).prepare()
+
+
+def _spec(n_nodes=4, tpus=4):
+    nodes = [{"address": "10.0.0.%d" % (i + 1), "tpus": tpus,
+              "chief": i == 0, "network_bandwidth": 25}
+             for i in range(n_nodes)]
+    return ResourceSpec.from_dict({"nodes": nodes,
+                                   "slice": {"type": "v5e", "ici_bandwidth": 400}})
+
+
+def test_breakdown_positive_and_ordered():
+    item, spec = _item(), _spec()
+    sim = Simulator(item, spec)
+    r_ar = sim.simulate(S.AllReduce().build(item, spec), "ar")
+    r_ps = sim.simulate(S.PS().build(item, spec), "ps")
+    assert r_ar.step_time_s > 0 and r_ps.step_time_s > 0
+    # a single PS server's NIC carries everything; ICI all-reduce must win
+    assert r_ar.step_time_s < r_ps.step_time_s
+
+
+def test_lb_beats_single_ps():
+    item, spec = _item(), _spec()
+    sim = Simulator(item, spec)
+    r_ps = sim.simulate(S.PS().build(item, spec), "ps")
+    r_lb = sim.simulate(S.PSLoadBalancing().build(item, spec), "lb")
+    assert r_lb.breakdown.ps_s <= r_ps.breakdown.ps_s
+
+
+def test_compression_reduces_ar_cost():
+    item, spec = _item(), _spec()
+    sim = Simulator(item, spec)
+    plain = sim.simulate(S.AllReduce().build(item, spec), "plain")
+    bf16 = sim.simulate(
+        S.AllReduce(compressor="HorovodCompressor").build(item, spec), "bf16")
+    assert bf16.breakdown.allreduce_s < plain.breakdown.allreduce_s
+
+
+def test_auto_strategy_picks_and_runs():
+    """AutoStrategy must return a lowerable strategy that trains."""
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(16, 4).astype(np.float32))}
+    loss = lambda p, b: jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+    batch = {"x": rng.randn(16, 16).astype(np.float32),
+             "y": rng.randn(16, 4).astype(np.float32)}
+    builder = AutoStrategy()
+    ad = autodist_tpu.AutoDist(strategy_builder=builder)
+    step = ad.function(loss, optimizer=optax.sgd(0.1), params=params)
+    losses = [step(batch)["loss"] for _ in range(5)]
+    assert losses[-1] < losses[0]
+    assert builder.last_ranking is not None
+    assert len(builder.last_ranking) >= 5
+    autodist_tpu.reset()
+
+
+def test_auto_strategy_deterministic():
+    item, spec = _item(), _spec()
+    s1 = AutoStrategy().build(item, spec)
+    s2 = AutoStrategy().build(item, spec)
+    d1, d2 = s1.to_dict(), s2.to_dict()
+    d1.pop("id"), d2.pop("id")
+    assert d1 == d2
