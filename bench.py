@@ -2,24 +2,33 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Runs on whatever devices the runtime exposes (the real TPU chip under the
-driver; CPU elsewhere). vs_baseline is framework-throughput / plain-pjit-DP
+driver; CPU elsewhere). vs_baseline is framework-throughput / plain-jit-DP
 throughput on the identical model+batch (>= 1.0 means we match or beat the
 hand-written JAX data-parallel step).
+
+Methodology notes (the device may sit behind a high-latency tunnel and
+throttle under sustained load, so naive one-shot loops are biased):
+- the batch is device-resident for BOTH paths (the framework's Remapper
+  places it once; the baseline gets a device_put) — feeding numpy to one
+  path would bill host->device transfer to that path only;
+- both paths donate their state buffers;
+- measurement alternates short baseline/framework phases and scores each
+  path by its best phase, so slow windows (throttling, tunnel hiccups)
+  hit both paths equally.
 """
+import functools
 import json
 import time
 
 import numpy as np
 
 
-def _timeit(fn, *args, warmup=3, iters=20):
+def _phase_rate(fn, iters):
     import jax
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
     t0 = time.perf_counter()
+    out = None
     for _ in range(iters):
-        out = fn(*args)
+        out = fn()
     jax.block_until_ready(out)
     return iters / (time.perf_counter() - t0)
 
@@ -50,44 +59,58 @@ def main():
         pred = h @ p["l3"]["k"] + p["l3"]["b"]
         return jnp.mean((pred - batch["y"]) ** 2)
 
-    batch = {"x": rng.randn(batch_size, d_in).astype(np.float32),
-             "y": rng.randn(batch_size, d_out).astype(np.float32)}
+    batch_np = {"x": rng.randn(batch_size, d_in).astype(np.float32),
+                "y": rng.randn(batch_size, d_out).astype(np.float32)}
     opt = optax.adam(1e-3)
 
-    # ---- baseline: plain jit data-parallel step (XLA-inserted collectives)
-    opt_state = opt.init(params)
-
-    @jax.jit
+    # ---- baseline: plain jit data-parallel step, donated state,
+    #      device-resident batch
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def baseline_step(p, s, b):
         loss, g = jax.value_and_grad(loss_fn)(p, b)
         updates, s = opt.update(g, s, p)
         return optax.apply_updates(p, updates), s, loss
 
-    def run_baseline(p, s, b):
-        p, s, loss = baseline_step(p, s, b)
+    # real copies: baseline_step donates these, and `params` is reused below
+    base_batch = jax.device_put(batch_np)
+    base_box = [jax.device_put(jax.device_get(params)),
+                jax.device_put(jax.device_get(opt.init(params)))]
+
+    def run_baseline():
+        p, s, loss = baseline_step(base_box[0], base_box[1], base_batch)
+        base_box[0], base_box[1] = p, s
         return loss
-    base_sps = _timeit(lambda: run_baseline(params, opt_state, batch))
 
     # ---- framework: AllReduce strategy through the full stack
     adt.reset()
     ad = adt.AutoDist(strategy_builder=strategy.AllReduce())
-    runner = ad.build(loss_fn, opt, params, batch)
+    runner = ad.build(loss_fn, opt, params, batch_np)
     runner.init(params)
-    sharded = runner.remapper.remap_feed(batch)
+    sharded = runner.remapper.remap_feed(batch_np)
     state_box = [runner.state]
 
     def run_fw():
         st, m = runner.distributed_step(state_box[0], sharded)
         state_box[0] = st
         return m["loss"]
-    fw_sps = _timeit(run_fw)
 
-    examples_per_sec = fw_sps * batch_size
+    # warmup (compile + a few steps each)
+    for _ in range(5):
+        run_baseline()
+        run_fw()
+    jax.block_until_ready((base_box[0], state_box[0].params))
+
+    base_best, fw_best = 0.0, 0.0
+    for _ in range(6):
+        base_best = max(base_best, _phase_rate(run_baseline, 30))
+        fw_best = max(fw_best, _phase_rate(run_fw, 30))
+
+    examples_per_sec = fw_best * batch_size
     print(json.dumps({
         "metric": "mlp_train_examples_per_sec",
         "value": round(examples_per_sec, 2),
         "unit": "examples/s",
-        "vs_baseline": round(fw_sps / base_sps, 4),
+        "vs_baseline": round(fw_best / base_best, 4),
     }))
 
 
