@@ -179,7 +179,25 @@ class GraphTransformer:
         (reference ``graph_transformer.py:94-130``)."""
         syncs = {}
         for node in self._strategy.node_config:
-            if node.var_name not in self._item.var_infos:
+            info = self._item.var_infos.get(node.var_name)
+            if info is None:
+                continue
+            if not info.trainable:
+                # frozen vars never sync (their grads are zeroed in the
+                # step); their node may still carry an mp_axes layout
+                continue
+            if layouts[node.var_name].mp_axes:
+                # model-parallel vars (resolved layout — a size-1 model axis
+                # degenerates to replicated and takes the normal path) sync
+                # via the complement-axes psum in the lowering, not a
+                # synchronizer kernel; a configured compressor cannot apply
+                # to them — say so rather than silently dropping it
+                comp = getattr(node.synchronizer, "compressor", "NoneCompressor")
+                if comp != "NoneCompressor":
+                    logging.warning(
+                        "var %s: compressor %s ignored — model-parallel "
+                        "(mp_axes) gradients reduce uncompressed over the "
+                        "complement axes", node.var_name, comp)
                 continue
             cfg = node.synchronizer
             if cfg is None and node.part_configs:
@@ -210,11 +228,23 @@ class GraphTransformer:
             visualization_util.log_jaxpr("0-original-loss", item.loss_fn,
                                          item.params, item.example_batch)
         layouts = VariablePartitioner.apply(
-            self._strategy, var_infos, self.num_replicas, self._axis)
+            self._strategy, var_infos, self.num_replicas, self._axis,
+            mesh_axis_sizes={a: int(self._mesh.shape[a]) for a in self._axes})
 
         names, _, treedef = variable_utils.flatten_named(item.params)
         layout_tree = variable_utils.unflatten_named(
             treedef, [layouts[n] for n in names])
+
+        # Model-parallel vars (tensor/pipeline/expert sharded storage) bypass
+        # the synchronizer machinery: their gradient reduces only over the
+        # complement mesh axes (the forward's own collectives — psum in a
+        # row-parallel matmul, ppermute in a pipeline, all_to_all in MoE —
+        # already account for the model-parallel axes).
+        mp_names = frozenset(n for n, l in layouts.items() if l.mp_axes)
+        mp_complement = {
+            n: tuple(a for a in self._axes
+                     if a not in set(layouts[n].mp_axis_names))
+            for n in mp_names}
 
         syncs = self._build_synchronizers(layouts)
         # Route unpartitioned AllReduce vars with an *active* compressor into
@@ -225,6 +255,7 @@ class GraphTransformer:
         ar_unpart = {n: s for n, s in syncs.items()
                      if s.__class__.__name__ == "AllReduceSynchronizer"
                      and not layouts[n].partitioned
+                     and n not in mp_names
                      and s.compressor.name != "NoneCompressor"}
         buckets, per_var_comp = collectives.make_buckets(ar_unpart, var_infos)
         bucketed_names = {n for b in buckets for n in b.var_names}
@@ -240,7 +271,7 @@ class GraphTransformer:
                     st["bucket"][b.key] = np.broadcast_to(
                         np.asarray(s)[None], (N,) + np.asarray(s).shape).copy()
             for n, s in syncs.items():
-                if n in bucketed_names:
+                if n in bucketed_names or n in mp_names:
                     continue
                 if layouts[n].partitioned:
                     continue  # partitioned vars reduce-scatter; no compressor state
@@ -289,6 +320,18 @@ class GraphTransformer:
                 synced = {n: (jnp.zeros_like(v) if n in frozen_names else v)
                           for n, v in g.items()}
 
+            # model-parallel vars: mean over the complement axes only; the /N
+            # (total devices) normalization is exact — shard_map AD transposes
+            # the forward psum/all_to_all into a sum over the model axes, and
+            # that inflation cancels against the model-axis factor in N
+            # (verified numerically in tests/test_tensor_parallel.py)
+            for n in (mp_names if N > 1 else ()):
+                if n in frozen_names:
+                    synced[n] = jnp.zeros_like(g[n])
+                    continue
+                comp = mp_complement[n]
+                synced[n] = (jax.lax.psum(g[n], comp) if comp else g[n]) / N
+
             for b in (buckets if N > 1 else []):
                 bst = new_bucket_state.get(b.key)
                 bst_local = bst[0] if bst is not None else None
@@ -297,7 +340,7 @@ class GraphTransformer:
                 if nst is not None:
                     new_bucket_state[b.key] = jnp.expand_dims(nst, 0)
             for n, s in (syncs.items() if N > 1 else ()):
-                if n in bucketed_names:
+                if n in bucketed_names or n in synced:
                     continue
                 vst = new_var_state.get(n)
                 vst_local = jax.tree_util.tree_map(lambda a: a[0], vst) if vst is not None else None

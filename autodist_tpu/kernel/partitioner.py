@@ -35,7 +35,18 @@ from autodist_tpu.utils import logging
 
 @dataclasses.dataclass(frozen=True)
 class VarLayout:
-    """Storage layout of one variable on the mesh."""
+    """Storage layout of one variable on the mesh.
+
+    Two orthogonal sharding mechanisms:
+
+    - ``partitioned`` (the reference's ``PartitionedVariable``): storage
+      sharded over the data axis; compute all-gathers the full value and the
+      gradient comes back via reduce-scatter (ZeRO-style).
+    - ``mp_axes`` (dim -> mesh axis, beyond the reference): model-parallel
+      storage for tensor/pipeline/expert parallelism; compute consumes the
+      LOCAL shard directly, and gradients reduce only over the *complement*
+      mesh axes.
+    """
     name: str
     partitioned: bool = False
     axis: int = 0                 # split axis
@@ -44,13 +55,26 @@ class VarLayout:
     padded_dim: int = 0           # padded size (multiple of mesh axis size)
     mesh_axis: str = const.DATA_AXIS
     shard_sizes: Optional[Tuple[int, ...]] = None  # uneven metadata
+    mp_axes: Tuple[Tuple[int, str], ...] = ()      # ((dim, mesh_axis), ...)
+
+    @property
+    def mp_axis_names(self) -> Tuple[str, ...]:
+        return tuple(a for _, a in self.mp_axes)
 
     @property
     def pspec(self) -> P:
-        if not self.partitioned:
+        ndims = [self.axis] if self.partitioned else []
+        ndims += [d for d, _ in self.mp_axes]
+        if not ndims:
             return P()
-        spec = [None] * (self.axis + 1)
-        spec[self.axis] = self.mesh_axis
+        spec = [None] * (max(ndims) + 1)
+        if self.partitioned:
+            spec[self.axis] = self.mesh_axis
+        for d, a in self.mp_axes:
+            if spec[d] is not None:
+                raise ValueError("var %s: dim %d sharded by both %s and %s"
+                                 % (self.name, d, spec[d], a))
+            spec[d] = a
         return P(*spec)
 
     def pad(self, arr: jax.Array) -> jax.Array:
@@ -69,7 +93,9 @@ class VarLayout:
     # ---- inside-shard_map helpers ----
 
     def gather_full(self, local: jax.Array) -> jax.Array:
-        """all-gather the local shard into the full (unpadded) array."""
+        """all-gather the data-axis shard into the full (unpadded) array.
+        ``mp_axes`` shards are NOT gathered — model-parallel compute consumes
+        the local shard."""
         if not self.partitioned:
             return local
         full = jax.lax.all_gather(local, self.mesh_axis, axis=self.axis, tiled=True)
@@ -94,18 +120,47 @@ class VariablePartitioner(Kernel):
     """
 
     def __init__(self, key, strategy: Strategy, var_infos, mesh_axis_size: int,
-                 mesh_axis: str = const.DATA_AXIS):
+                 mesh_axis: str = const.DATA_AXIS,
+                 mesh_axis_sizes: Optional[Dict[str, int]] = None):
         super().__init__(key)
         self._strategy = strategy
         self._var_infos = var_infos
         self._axis_size = mesh_axis_size
         self._mesh_axis = mesh_axis
+        self._mesh_axis_sizes = mesh_axis_sizes or {mesh_axis: mesh_axis_size}
+
+    def _mp_layout(self, node, info) -> VarLayout:
+        """Model-parallel storage layout from a VarConfig.mp_axes spec.
+        Requires exact divisibility (no padding: the consuming compute is
+        written against the local shard shape)."""
+        mp = []
+        for dim, ax_name in sorted(node.mp_axes.items()):
+            size = self._mesh_axis_sizes.get(ax_name)
+            if size is None:
+                raise ValueError("var %s: mp axis %r not in mesh %s"
+                                 % (node.var_name, ax_name,
+                                    self._mesh_axis_sizes))
+            if dim >= len(info.shape) or info.shape[dim] % size != 0:
+                raise ValueError(
+                    "var %s: dim %d (shape %s) not divisible by mesh axis "
+                    "%r size %d" % (node.var_name, dim, tuple(info.shape),
+                                    ax_name, size))
+            if size > 1:
+                mp.append((dim, ax_name))
+        if node.partitioner is not None:
+            logging.warning("var %s: mp_axes and partitioner both set; "
+                            "mp_axes wins (ZeRO+MP on one var unsupported)",
+                            node.var_name)
+        return VarLayout(name=node.var_name, mp_axes=tuple(mp))
 
     def _apply(self) -> Dict[str, VarLayout]:
         layouts: Dict[str, VarLayout] = {}
         for node in self._strategy.node_config:
             info = self._var_infos.get(node.var_name)
             if info is None:
+                continue
+            if node.mp_axes:
+                layouts[node.var_name] = self._mp_layout(node, info)
                 continue
             axis = node.partition_axis
             if node.partitioner is None or axis is None or self._axis_size <= 1:

@@ -1,0 +1,225 @@
+"""Tensor-parallel decoder-only transformer LM (the flagship model).
+
+A functional (pure-pytree) causal transformer whose every op is
+shape-polymorphic: the SAME code runs full-size on one device and sharded
+inside the lowering's shard_map, consuming whatever parameter shards the
+strategy assigned. Model parallelism follows Megatron (arXiv 1909.08053),
+built from ``parallel/tensor.py`` primitives:
+
+- attention QKV: column-parallel (heads sharded over ``model``), out-proj
+  row-parallel (one psum);
+- MLP: up-proj column-parallel, down-proj row-parallel (one psum);
+- embedding: vocab-parallel, tied with the output head
+  (``vocab_parallel_logits`` + ``vocab_parallel_xent``).
+
+Composes with sequence parallelism: pass ``attention='ring'|'ulysses'`` and
+the seq-sharded batch attends globally (``ops/attention.py``) while heads
+stay model-sharded — the TP x SP composition the reference never had
+(reference is data-parallel only, ``docs/design/architecture.rst:46-48``).
+
+``tp_rules()`` exports the regex -> {dim: mesh-axis} map the
+``TensorParallel`` strategy builder uses to shard storage.
+"""
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu import const
+from autodist_tpu.parallel import sequence, tensor
+
+
+@dataclasses.dataclass
+class TPLMConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    num_layers: int = 6
+    num_heads: int = 8
+    mlp_dim: int = 2048
+    max_seq_len: int = 256
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 64)
+        kw.setdefault("d_model", 32)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("mlp_dim", 64)
+        kw.setdefault("max_seq_len", 64)
+        return cls(**kw)
+
+    @classmethod
+    def flagship(cls, **kw):
+        """GPT-2-medium-ish: the benchmark configuration."""
+        kw.setdefault("vocab_size", 32768)
+        kw.setdefault("d_model", 1024)
+        kw.setdefault("num_layers", 12)
+        kw.setdefault("num_heads", 16)
+        kw.setdefault("mlp_dim", 4096)
+        kw.setdefault("max_seq_len", 1024)
+        kw.setdefault("dtype", jnp.bfloat16)
+        return cls(**kw)
+
+
+def init_params(cfg: TPLMConfig, seed: int = 0) -> Dict:
+    """Full (unsharded) parameter pytree; the strategy shards storage."""
+    rng = np.random.RandomState(seed)
+    d, h, hd, f = cfg.d_model, cfg.num_heads, cfg.head_dim, cfg.mlp_dim
+
+    def normal(*shape, scale):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    params = {
+        "embed": normal(cfg.vocab_size, d, scale=0.02),
+        "pos_embed": normal(cfg.max_seq_len, d, scale=0.02),
+        "final_ln": {"scale": np.ones((d,), np.float32),
+                     "bias": np.zeros((d,), np.float32)},
+    }
+    for i in range(cfg.num_layers):
+        params["layer_%d" % i] = {
+            "ln1": {"scale": np.ones((d,), np.float32),
+                    "bias": np.zeros((d,), np.float32)},
+            "attn": {
+                "wq": normal(d, h, hd, scale=0.02),
+                "wk": normal(d, h, hd, scale=0.02),
+                "wv": normal(d, h, hd, scale=0.02),
+                "wo": normal(h, hd, d, scale=0.02 / np.sqrt(2 * cfg.num_layers)),
+                "bo": np.zeros((d,), np.float32),
+            },
+            "ln2": {"scale": np.ones((d,), np.float32),
+                    "bias": np.zeros((d,), np.float32)},
+            "mlp": {
+                "w1": normal(d, f, scale=0.02),
+                "b1": np.zeros((f,), np.float32),
+                "w2": normal(f, d, scale=0.02 / np.sqrt(2 * cfg.num_layers)),
+                "b2": np.zeros((d,), np.float32),
+            },
+        }
+    return params
+
+
+def tp_rules(model_axis: str = const.MODEL_AXIS) -> List[Tuple[str, Dict[int, str]]]:
+    """Regex -> {dim: mesh axis} storage-sharding rules for TensorParallel.
+
+    QKV kernels shard dim 1 (heads); the out-projection and MLP down-proj
+    shard their input dim (row-parallel); MLP up-proj + bias shard the hidden
+    dim (column-parallel); the tied embedding shards the vocab dim.
+    LayerNorms / pos_embed / biases-after-reduce stay replicated (no rule).
+    """
+    return [
+        (r".*/attn/w[qkv]$", {1: model_axis}),
+        (r".*/attn/wo$", {0: model_axis}),
+        (r".*/mlp/w1$", {1: model_axis}),
+        (r".*/mlp/b1$", {0: model_axis}),
+        (r".*/mlp/w2$", {0: model_axis}),
+        (r"^embed$", {0: model_axis}),
+    ]
+
+
+def _layer_norm(x, p, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _causal_attention(q, k, v):
+    """Plain causal attention, [B, S, H_local, D] -> [B, S, H_local, D]."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s = q.shape[1]
+    mask = jnp.tril(jnp.ones((s, s), jnp.bool_))[None, None]
+    logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    w = jax.nn.softmax(logits.astype(jnp.float32)).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+def forward(params, input_ids, cfg: TPLMConfig,
+            attn_fn=None, seq_parallel: bool = False,
+            model_axis: str = const.MODEL_AXIS):
+    """Logits over the (possibly vocab-sharded) vocabulary.
+
+    ``attn_fn(q, k, v)`` overrides attention (ring/ulysses for SP; pallas
+    flash for TPU); default is plain causal. ``input_ids`` is the LOCAL
+    sequence chunk under SP.
+    """
+    dt = cfg.dtype
+    seq_len = input_ids.shape[-1]
+    x = tensor.vocab_parallel_embed(params["embed"], input_ids, model_axis)
+    x = (x * np.sqrt(cfg.d_model)).astype(dt)
+    positions = jnp.arange(seq_len)
+    if seq_parallel:
+        positions = positions + sequence.position_offset(seq_len)
+    x = x + params["pos_embed"].astype(dt)[positions][None]
+    for i in range(cfg.num_layers):
+        lp = params["layer_%d" % i]
+        h = _layer_norm(x, lp["ln1"])
+        q = tensor.column_parallel_dense(h, lp["attn"]["wq"].astype(dt))
+        k = tensor.column_parallel_dense(h, lp["attn"]["wk"].astype(dt))
+        v = tensor.column_parallel_dense(h, lp["attn"]["wv"].astype(dt))
+        o = attn_fn(q, k, v) if attn_fn is not None else _causal_attention(q, k, v)
+        o = tensor.row_parallel_dense(o, lp["attn"]["wo"].astype(dt),
+                                      lp["attn"]["bo"].astype(dt),
+                                      model_axis, contract_dims=2)
+        x = x + o
+        h = _layer_norm(x, lp["ln2"])
+        h = tensor.column_parallel_dense(h, lp["mlp"]["w1"].astype(dt),
+                                         lp["mlp"]["b1"].astype(dt))
+        h = jax.nn.gelu(h)
+        h = tensor.row_parallel_dense(h, lp["mlp"]["w2"].astype(dt),
+                                      lp["mlp"]["b2"].astype(dt), model_axis)
+        x = x + h
+    x = _layer_norm(x, params["final_ln"])
+    return tensor.vocab_parallel_logits(x, params["embed"].astype(dt))
+
+
+def make_train_setup(cfg: Optional[TPLMConfig] = None, seq_len: int = 128,
+                     batch_size: int = 8, seed: int = 0,
+                     attention: Optional[str] = None,
+                     model_axis: str = const.MODEL_AXIS):
+    """(loss_fn, params, example_batch, apply_fn) for the AutoDist stack.
+
+    ``attention``: None (plain causal) or 'ring'/'ulysses' for
+    sequence-parallel runs — then tokens arrive seq-sharded, next-token
+    targets cross shard boundaries, and the final global position is masked.
+    """
+    cfg = cfg or TPLMConfig()
+    params = init_params(cfg, seed)
+    seq_parallel = attention in ("ring", "ulysses")
+    attn_fn = None
+    if seq_parallel:
+        from autodist_tpu.ops.attention import make_attn_fn
+        sp_attn = make_attn_fn(attention, const.SEQUENCE_AXIS, causal=True)
+        attn_fn = lambda q, k, v: sp_attn(q, k, v, None)  # noqa: E731
+
+    def loss_fn(p, batch):
+        tokens = batch["tokens"]
+        if seq_parallel:
+            logits = forward(p, tokens, cfg, attn_fn=attn_fn,
+                             seq_parallel=True, model_axis=model_axis)
+            targets = sequence.shift_left(tokens, const.SEQUENCE_AXIS, axis=1)
+            nll = tensor.vocab_parallel_xent(logits, targets, model_axis)
+            local_len = tokens.shape[1]
+            pos = jnp.arange(local_len) + sequence.position_offset(local_len)
+            total = local_len * sequence.axis_size(const.SEQUENCE_AXIS)
+            w = jnp.broadcast_to(
+                (pos < total - 1).astype(nll.dtype)[None, :], nll.shape)
+            return sequence.global_weighted_mean(nll, w)
+        logits = forward(p, tokens[:, :-1], cfg, model_axis=model_axis)
+        nll = tensor.vocab_parallel_xent(logits, tokens[:, 1:], model_axis)
+        return jnp.mean(nll)
+
+    npr = np.random.RandomState(seed)
+    extra = 0 if seq_parallel else 1
+    example_batch = {"tokens": npr.randint(
+        0, cfg.vocab_size, (batch_size, seq_len + extra)).astype(np.int32)}
+    apply_fn = lambda p, ids: forward(p, ids, cfg, model_axis=model_axis)  # noqa: E731
+    return loss_fn, params, example_batch, apply_fn

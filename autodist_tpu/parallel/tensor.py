@@ -1,0 +1,120 @@
+"""Tensor (model) parallelism primitives — Megatron-style sharded compute.
+
+Beyond the reference, which shards only *storage* (its ``VariablePartitioner``
+re-concatenates the full value for every consumer — reference
+``docs/design/kernels.md:10-17`` "consumers read the re-concatenated value,
+so compute is not model-parallel"). Here compute itself is sharded over the
+``model`` mesh axis: column-parallel matmuls produce sharded activations with
+no communication, row-parallel matmuls reduce partial products with one
+``psum`` that XLA lowers to an ICI all-reduce, and embedding/softmax run
+vocab-parallel (Shoeybi et al., Megatron-LM, arXiv 1909.08053).
+
+All helpers are shape-polymorphic and no-op gracefully when the axis is not
+bound, so ONE model definition serves single-device execution, tracing
+outside shard_map (ModelItem capture), and sharded execution inside the
+lowering — the same one-definition property ``parallel/sequence.py`` gives
+sequence parallelism.
+
+Gradient correctness: under ``shard_map`` the transpose of ``psum`` is
+``psum``, so local autodiff computes exact derivatives of the
+summed-over-devices loss; the lowering's uniform ``psum(complement)/N``
+synchronization for mp-sharded variables (``kernel/graph_transformer.py``)
+is exact against that convention — no f/g custom-vjp tricks needed.
+"""
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu import const
+from autodist_tpu.parallel.sequence import axis_bound
+
+
+def reduce_model_parallel(x, axis_name: str = const.MODEL_AXIS):
+    """All-reduce partial products over the model axis (the Megatron "g"
+    in forward). No-op when unbound."""
+    if not axis_bound(axis_name):
+        return x
+    return jax.lax.psum(x, axis_name)
+
+
+def column_parallel_dense(x, kernel, bias=None):
+    """Column-parallel matmul: kernel's OUTPUT dim is sharded over the model
+    axis; the caller passes the local kernel shard and gets local (sharded)
+    output columns. Pure local compute — no communication.
+
+    kernel may have >2 dims ([d_model, heads_local, head_dim] for fused QKV
+    projections); contraction is over x's last dim and kernel's first.
+    """
+    y = jnp.tensordot(x, kernel, axes=((x.ndim - 1,), (0,)))
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def row_parallel_dense(x, kernel, bias=None,
+                       axis_name: str = const.MODEL_AXIS,
+                       contract_dims: int = 1):
+    """Row-parallel matmul: kernel's INPUT dim(s) are sharded over the model
+    axis and x is the matching sharded activation; partial products are
+    psum-reduced so every rank holds the full output. Bias is added AFTER the
+    reduce (it is stored replicated).
+
+    ``contract_dims``: how many leading kernel dims to contract (2 for
+    attention out-projections [heads_local, head_dim, d_model]).
+    """
+    x_dims = tuple(range(x.ndim - contract_dims, x.ndim))
+    k_dims = tuple(range(contract_dims))
+    y = jnp.tensordot(x, kernel, axes=(x_dims, k_dims))
+    y = reduce_model_parallel(y, axis_name)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def vocab_parallel_embed(table, ids, axis_name: str = const.MODEL_AXIS):
+    """Embedding lookup with the vocab dim of ``table`` sharded over the
+    model axis: each rank looks up the ids it owns, others contribute zeros,
+    one psum assembles the full embedding (Megatron VocabParallelEmbedding).
+    """
+    if not axis_bound(axis_name):
+        return jnp.take(table, ids, axis=0)
+    rank = jax.lax.axis_index(axis_name)
+    v_local = table.shape[0]
+    local_ids = ids - rank * v_local
+    ok = (local_ids >= 0) & (local_ids < v_local)
+    emb = jnp.take(table, jnp.clip(local_ids, 0, v_local - 1), axis=0)
+    emb = jnp.where(ok[..., None], emb, 0)
+    return jax.lax.psum(emb, axis_name)
+
+
+def vocab_parallel_logits(x, table):
+    """Output projection onto a vocab-sharded (tied) embedding table:
+    logits columns stay sharded; pair with ``vocab_parallel_xent``."""
+    return jnp.tensordot(x, table, axes=((x.ndim - 1,), (1,)))
+
+
+def vocab_parallel_xent(logits, targets,
+                        axis_name: str = const.MODEL_AXIS):
+    """Per-token negative log-likelihood with the vocab (last) dim of
+    ``logits`` sharded over the model axis. Numerically-stable global softmax
+    via pmax/psum; the target logit is fetched from whichever rank owns it
+    (Megatron vocab_parallel_cross_entropy). Returns nll with targets' shape.
+    """
+    if not axis_bound(axis_name):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # the max offset cancels analytically in softmax, so it carries no
+    # gradient (and pmax has no differentiation rule anyway) — stop the
+    # gradient at the OPERAND so the pmax sees a zero tangent
+    m = jax.lax.pmax(
+        jnp.max(jax.lax.stop_gradient(logits), axis=-1), axis_name)
+    e = jnp.exp(logits.astype(jnp.float32) - m[..., None])
+    denom = jax.lax.psum(jnp.sum(e, axis=-1), axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    v_local = logits.shape[-1]
+    local_t = targets - rank * v_local
+    ok = (local_t >= 0) & (local_t < v_local)
+    picked = jnp.take_along_axis(
+        logits, jnp.clip(local_t, 0, v_local - 1)[..., None], axis=-1)[..., 0]
+    picked = jnp.where(ok, picked.astype(jnp.float32), 0.0)
+    target_logit = jax.lax.psum(picked, axis_name)
+    return m + jnp.log(denom) - target_logit

@@ -102,12 +102,21 @@ class VarConfig:
     ``partitioner`` is a comma-joined per-axis shard-count string like
     ``"4,1"`` (reference ``kernel/partitioner.py:38-150`` PartitionerConfig);
     when set, ``part_configs`` holds one VarConfig per shard. ``shard_sizes``
-    supports uneven partitioning (sizes along the split axis)."""
+    supports uneven partitioning (sizes along the split axis).
+
+    ``mp_axes`` (TPU-native extension beyond the reference, which is
+    data-parallel only — reference ``docs/design/architecture.rst:46-48``)
+    maps tensor dim -> mesh axis name for *model-parallel* storage: the
+    variable is stored sharded over that mesh axis and the compute consumes
+    the LOCAL shard directly (tensor/pipeline/expert parallelism), unlike
+    ``partitioner`` sharding which all-gathers the full value for compute
+    (ZeRO-style storage sharding)."""
     var_name: str
     synchronizer: Optional[Synchronizer] = None
     partitioner: Optional[str] = None
     part_configs: List["VarConfig"] = dataclasses.field(default_factory=list)
     shard_sizes: Optional[List[int]] = None
+    mp_axes: Optional[Dict[int, str]] = None
 
     @property
     def partition_axis(self) -> Optional[int]:
@@ -136,6 +145,8 @@ class VarConfig:
             "partitioner": self.partitioner,
             "part_configs": [p.to_dict() for p in self.part_configs],
             "shard_sizes": self.shard_sizes,
+            "mp_axes": ({str(k): v for k, v in self.mp_axes.items()}
+                        if self.mp_axes else None),
         }
 
     @classmethod
@@ -146,6 +157,8 @@ class VarConfig:
             partitioner=d.get("partitioner"),
             part_configs=[cls.from_dict(p) for p in d.get("part_configs", [])],
             shard_sizes=d.get("shard_sizes"),
+            mp_axes=({int(k): v for k, v in d["mp_axes"].items()}
+                     if d.get("mp_axes") else None),
         )
 
 
@@ -242,8 +255,10 @@ class StrategyBuilder(ABC):
 class StrategyCompiler:
     """Resolves a Strategy against concrete cluster devices
     (reference ``strategy/base.py:120-168`` + ``kernel/device/resolver.py``):
-    prunes configs for variables that no longer exist / aren't trainable and
-    resolves device name strings."""
+    prunes configs for variables that no longer exist, checks every trainable
+    variable has one, and resolves device name strings. Frozen vars keep
+    their configs — they may carry mp_axes storage layouts (their
+    synchronizers are ignored by the lowering)."""
 
     def __init__(self, model_item, resource_spec):
         self._item = model_item
@@ -252,7 +267,10 @@ class StrategyCompiler:
     def compile(self, strategy: Strategy) -> Strategy:
         from autodist_tpu.kernel.device.resolver import DeviceResolver
         resolver = DeviceResolver(self._spec)
-        known = set(self._item.trainable_var_names)
+        # keep configs for every known var (frozen vars may carry mp_axes
+        # storage layouts); only require one per *trainable* var below
+        known = set(self._item.var_infos)
+        trainable = set(self._item.trainable_var_names)
         pruned = []
         for node in strategy.node_config:
             if node.var_name not in known:
@@ -268,7 +286,7 @@ class StrategyCompiler:
             pruned.append(node)
         strategy.node_config = pruned
         strategy.graph_config.replicas = [resolver.resolve(r) for r in strategy.graph_config.replicas]
-        missing = known - {n.var_name for n in pruned}
+        missing = trainable - {n.var_name for n in pruned}
         if missing:
             raise ValueError("strategy has no config for trainable vars: %s" % sorted(missing))
         return strategy

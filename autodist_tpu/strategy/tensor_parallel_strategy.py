@@ -1,0 +1,88 @@
+"""Tensor-parallel strategy builder (beyond the reference).
+
+The reference's strategy space ends at data parallelism with sharded
+*storage* (``docs/design/architecture.rst:46-48``; its proto anticipated
+more, ``proto/strategy.proto:36-41``). This builder adds the ``model`` mesh
+axis: variables matching the model's partition rules are stored AND consumed
+sharded (``VarConfig.mp_axes``), compute synchronizes itself with Megatron
+psums (``parallel/tensor.py``), and the remaining variables ride the normal
+AllReduce data-parallel path. Optionally composes a ``seq`` axis for
+TP x SP long-context runs.
+"""
+import re
+from typing import Dict, List, Optional, Tuple
+
+from autodist_tpu import const
+from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+from autodist_tpu.strategy.base import Strategy
+from autodist_tpu.utils import logging
+
+# rule list: (regex matched against the full var name, {dim: mesh axis})
+MpRules = List[Tuple[str, Dict[int, str]]]
+
+
+def apply_mp_rules(strategy: Strategy, rules: MpRules) -> int:
+    """Set ``mp_axes`` on every node whose var name matches a rule (first
+    match wins). Returns the number of sharded vars."""
+    compiled = [(re.compile(pat), mp) for pat, mp in rules]
+    n = 0
+    for node in strategy.node_config:
+        for pat, mp in compiled:
+            if pat.search(node.var_name):
+                node.mp_axes = dict(mp)
+                n += 1
+                break
+    return n
+
+
+class TensorParallel(AllReduce):
+    """dp x tp (x sp) mesh with Megatron-sharded compute.
+
+    ``mp_rules`` comes from the model family (e.g.
+    ``models.tp_lm.tp_rules()``); unmatched variables stay replicated with
+    AllReduce gradient sync. ``seq_shards`` adds sequence parallelism — the
+    model must then use ring/Ulysses attention (``attention`` is carried as
+    metadata the same way ``SequenceParallelAR`` does).
+    """
+
+    def __init__(self, tp_shards: int, mp_rules: MpRules,
+                 seq_shards: int = 1, attention: str = "ring",
+                 chunk_size: int = 128, all_reduce_spec: str = "AUTO",
+                 compressor: str = "NoneCompressor"):
+        super().__init__(chunk_size, all_reduce_spec, compressor)
+        if tp_shards < 1 or seq_shards < 1:
+            raise ValueError("tp_shards/seq_shards must be >= 1")
+        self.tp_shards = tp_shards
+        self.seq_shards = seq_shards
+        self.mp_rules = list(mp_rules)
+        self.attention = attention
+
+    def build(self, model_item, resource_spec) -> Strategy:
+        strategy = super().build(model_item, resource_spec)
+        n_devices = len(strategy.graph_config.replicas)
+        denom = self.tp_shards * self.seq_shards
+        if n_devices % denom != 0:
+            raise ValueError("%d devices not divisible by tp*sp=%d"
+                             % (n_devices, denom))
+        # axis order outer->inner: data, seq, model — the model axis gets the
+        # innermost (fastest, nearest-neighbor ICI) mesh dimension, where the
+        # per-layer psums live
+        mesh_shape = {const.DATA_AXIS: n_devices // denom}
+        if self.seq_shards > 1:
+            mesh_shape[const.SEQUENCE_AXIS] = self.seq_shards
+            strategy.graph_config.seq_axis = const.SEQUENCE_AXIS
+        mesh_shape[const.MODEL_AXIS] = self.tp_shards
+        strategy.graph_config.mesh_shape = mesh_shape
+        # frozen vars matching an mp rule still need sharded storage (the TP
+        # compute consumes local shards regardless of trainability) — emit
+        # layout-only nodes for them
+        from autodist_tpu.strategy.base import VarConfig
+        have = {n.var_name for n in strategy.node_config}
+        for name, info in model_item.var_infos.items():
+            if name not in have and not info.trainable:
+                strategy.node_config.append(VarConfig(var_name=name))
+        n = apply_mp_rules(strategy, self.mp_rules)
+        logging.info("TensorParallel: %d/%d vars model-sharded over %d-way "
+                     "tp (mesh %s)", n, len(strategy.node_config),
+                     self.tp_shards, mesh_shape)
+        return strategy
