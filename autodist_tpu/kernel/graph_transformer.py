@@ -54,6 +54,7 @@ class DistributedStep:
         self.mesh_axis = mesh_axis
         self.all_axes = tuple(mesh.axis_names)
         self.seq_axis = strategy.graph_config.seq_axis
+        self.batch_axes = tuple(strategy.graph_config.batch_axes or (mesh_axis,))
         self._step_fn = step_fn
         self._step_fn_nodonate = step_fn_nodonate or step_fn
         self.layouts = layouts
@@ -148,8 +149,8 @@ class DistributedStep:
         """Place a host-global batch onto the mesh, split along the data axis
         (delegates to the Remapper's validated feed path)."""
         from autodist_tpu.remapper import Remapper
-        return Remapper(self.mesh, self.mesh_axis,
-                        seq_axis=self.seq_axis).remap_feed(batch)
+        return Remapper(self.mesh, self.mesh_axis, seq_axis=self.seq_axis,
+                        batch_axes=self.batch_axes).remap_feed(batch)
 
 
 class GraphTransformer:
@@ -399,14 +400,15 @@ class GraphTransformer:
         state_specs = TrainState(step=P(), params=param_specs,
                                  opt_state=opt_specs, sync_state=sync_specs)
         seq_axis = self._seq_axis
+        batch_axes = tuple(self._strategy.graph_config.batch_axes or (axis,))
 
         def batch_pspec(leaf):
             nd = np.ndim(leaf)
             if nd == 0:
                 return P()
             if seq_axis and nd >= 2:
-                return P(axis, seq_axis)
-            return P(axis)
+                return P(batch_axes, seq_axis)
+            return P(batch_axes)
         batch_specs = jax.tree_util.tree_map(batch_pspec, item.example_batch)
 
         # metrics out-structure from an abstract eval of the loss (may fail

@@ -1,0 +1,144 @@
+"""Mixture-of-Experts transformer LM (expert-parallel flagship).
+
+Transformer blocks whose feed-forward is a top-1-routed MoE
+(``parallel/expert.py``): expert-stacked FFN weights shard over the
+``expert`` mesh axis, tokens route with one all_to_all each way, and the
+Switch load-balance auxiliary loss keeps routing even. Attention and
+everything else stays dense — the standard Switch-Transformer shape
+(arXiv 2101.03961). Expert parallelism is an axis the reference's
+data-parallel-only strategy space never had
+(reference ``docs/design/architecture.rst:46-48``).
+"""
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu import const
+from autodist_tpu.models.tp_lm import _layer_norm, _causal_attention
+from autodist_tpu.parallel import expert, tensor
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    num_layers: int = 4
+    num_heads: int = 8
+    num_experts: int = 8
+    expert_dim: int = 1024
+    max_seq_len: int = 256
+    capacity_factor: float = 2.0
+    aux_coef: float = 0.01
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 64)
+        kw.setdefault("d_model", 32)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("num_experts", 4)
+        kw.setdefault("expert_dim", 64)
+        kw.setdefault("max_seq_len", 64)
+        return cls(**kw)
+
+
+def init_params(cfg: MoEConfig, seed: int = 0) -> Dict:
+    rng = np.random.RandomState(seed)
+    d, h, hd = cfg.d_model, cfg.num_heads, cfg.head_dim
+    E, f = cfg.num_experts, cfg.expert_dim
+
+    def normal(*shape, scale):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    out_scale = 0.02 / np.sqrt(2 * cfg.num_layers)
+    params = {
+        "embed": normal(cfg.vocab_size, d, scale=0.02),
+        "pos_embed": normal(cfg.max_seq_len, d, scale=0.02),
+        "final_ln": {"scale": np.ones((d,), np.float32),
+                     "bias": np.zeros((d,), np.float32)},
+    }
+    for i in range(cfg.num_layers):
+        params["layer_%d" % i] = {
+            "ln1": {"scale": np.ones((d,), np.float32),
+                    "bias": np.zeros((d,), np.float32)},
+            "attn": {"wq": normal(d, h, hd, scale=0.02),
+                     "wk": normal(d, h, hd, scale=0.02),
+                     "wv": normal(d, h, hd, scale=0.02),
+                     "wo": normal(h, hd, d, scale=out_scale),
+                     "bo": np.zeros((d,), np.float32)},
+            "ln2": {"scale": np.ones((d,), np.float32),
+                    "bias": np.zeros((d,), np.float32)},
+            "moe": {"router": normal(d, E, scale=0.02),
+                    "w1": normal(E, d, f, scale=0.02),
+                    "b1": np.zeros((E, f), np.float32),
+                    "w2": normal(E, f, d, scale=out_scale),
+                    "b2": np.zeros((E, d), np.float32)},
+        }
+    return params
+
+
+def ep_rules(expert_axis: str = const.EXPERT_AXIS) -> List[Tuple[str, Dict[int, str]]]:
+    """Expert-stacked FFN weights shard dim 0 over the expert axis; the
+    router (and everything else) stays replicated."""
+    return [(r".*/moe/[wb][12]$", {0: expert_axis})]
+
+
+def forward(params, input_ids, cfg: MoEConfig):
+    """Logits plus the summed Switch aux loss across layers."""
+    dt = cfg.dtype
+    seq_len = input_ids.shape[-1]
+    x = jnp.take(params["embed"], input_ids, axis=0)
+    x = (x * np.sqrt(cfg.d_model)).astype(dt)
+    x = x + params["pos_embed"].astype(dt)[jnp.arange(seq_len)][None]
+    aux_total = jnp.zeros((), jnp.float32)
+    for i in range(cfg.num_layers):
+        lp = params["layer_%d" % i]
+        h = _layer_norm(x, lp["ln1"])
+        q = tensor.column_parallel_dense(h, lp["attn"]["wq"].astype(dt))
+        k = tensor.column_parallel_dense(h, lp["attn"]["wk"].astype(dt))
+        v = tensor.column_parallel_dense(h, lp["attn"]["wv"].astype(dt))
+        o = _causal_attention(q, k, v)
+        o = tensor.row_parallel_dense(o, lp["attn"]["wo"].astype(dt),
+                                      lp["attn"]["bo"].astype(dt),
+                                      contract_dims=2)
+        x = x + o
+        h = _layer_norm(x, lp["ln2"])
+        moe_out, aux = expert.moe_ffn(
+            h, lp["moe"]["router"], lp["moe"]["w1"], lp["moe"]["b1"],
+            lp["moe"]["w2"], lp["moe"]["b2"],
+            capacity_factor=cfg.capacity_factor, dtype=dt)
+        aux_total = aux_total + aux
+        x = x + moe_out
+    x = _layer_norm(x, params["final_ln"])
+    logits = jnp.tensordot(x, params["embed"].astype(dt),
+                           axes=((x.ndim - 1,), (1,)))
+    return logits, aux_total
+
+
+def make_train_setup(cfg: Optional[MoEConfig] = None, seq_len: int = 128,
+                     batch_size: int = 8, seed: int = 0,
+                     aux_coef: Optional[float] = None):
+    cfg = cfg or MoEConfig()
+    coef = cfg.aux_coef if aux_coef is None else aux_coef
+    params = init_params(cfg, seed)
+
+    def loss_fn(p, batch):
+        tokens = batch["tokens"]
+        logits, aux = forward(p, tokens[:, :-1], cfg)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        nll = -jnp.take_along_axis(logp, tokens[:, 1:][..., None], -1)[..., 0]
+        return jnp.mean(nll) + coef * aux
+
+    npr = np.random.RandomState(seed)
+    example_batch = {"tokens": npr.randint(
+        0, cfg.vocab_size, (batch_size, seq_len + 1)).astype(np.int32)}
+    apply_fn = lambda p, ids: forward(p, ids, cfg)[0]  # noqa: E731
+    return loss_fn, params, example_batch, apply_fn

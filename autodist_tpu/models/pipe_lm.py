@@ -1,0 +1,132 @@
+"""Pipeline-parallel (optionally x tensor-parallel) transformer LM.
+
+The stacked-blocks variant of ``models/tp_lm.py``: all transformer blocks'
+parameters carry a leading layer dim, sharded over the ``pipe`` mesh axis
+(``mp_axes = {0: 'pipe'}``) and streamed with the GPipe schedule of
+``parallel/pipeline.py``; head/hidden dims can simultaneously shard over the
+``model`` axis with Megatron compute (``parallel/tensor.py``), giving
+dp x pp x tp meshes — parallelism axes the reference never had
+(reference ``docs/design/architecture.rst:46-48``). Embedding and the tied
+output head run replicated on every pipe rank; the pipeline covers the
+uniform-shape block stack.
+"""
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu import const
+from autodist_tpu.models.tp_lm import TPLMConfig, _layer_norm, _causal_attention
+from autodist_tpu.parallel import pipeline, tensor
+
+
+def init_params(cfg: TPLMConfig, seed: int = 0) -> Dict:
+    """Full (unsharded) params with layer-stacked blocks."""
+    rng = np.random.RandomState(seed)
+    d, h, hd, f, L = (cfg.d_model, cfg.num_heads, cfg.head_dim, cfg.mlp_dim,
+                      cfg.num_layers)
+
+    def normal(*shape, scale):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    out_scale = 0.02 / np.sqrt(2 * L)
+    return {
+        "embed": normal(cfg.vocab_size, d, scale=0.02),
+        "pos_embed": normal(cfg.max_seq_len, d, scale=0.02),
+        "blocks": {
+            "ln1": {"scale": np.ones((L, d), np.float32),
+                    "bias": np.zeros((L, d), np.float32)},
+            "attn": {"wq": normal(L, d, h, hd, scale=0.02),
+                     "wk": normal(L, d, h, hd, scale=0.02),
+                     "wv": normal(L, d, h, hd, scale=0.02),
+                     "wo": normal(L, h, hd, d, scale=out_scale),
+                     "bo": np.zeros((L, d), np.float32)},
+            "ln2": {"scale": np.ones((L, d), np.float32),
+                    "bias": np.zeros((L, d), np.float32)},
+            "mlp": {"w1": normal(L, d, f, scale=0.02),
+                    "b1": np.zeros((L, f), np.float32),
+                    "w2": normal(L, f, d, scale=out_scale),
+                    "b2": np.zeros((L, d), np.float32)},
+        },
+        "final_ln": {"scale": np.ones((d,), np.float32),
+                     "bias": np.zeros((d,), np.float32)},
+    }
+
+
+def pp_rules(pipe_axis: str = const.PIPELINE_AXIS,
+             model_axis: Optional[str] = None) -> List[Tuple[str, Dict[int, str]]]:
+    """mp_axes rules: layer stack over ``pipe``; with ``model_axis`` set,
+    heads/hidden additionally shard Megatron-style (dims shifted +1 for the
+    stack dim vs. ``tp_lm.tp_rules``)."""
+    if model_axis is None:
+        return [(r"^blocks/", {0: pipe_axis})]
+    return [
+        (r"^blocks/attn/w[qkv]$", {0: pipe_axis, 2: model_axis}),
+        (r"^blocks/attn/wo$", {0: pipe_axis, 1: model_axis}),
+        (r"^blocks/mlp/w1$", {0: pipe_axis, 2: model_axis}),
+        (r"^blocks/mlp/b1$", {0: pipe_axis, 1: model_axis}),
+        (r"^blocks/mlp/w2$", {0: pipe_axis, 1: model_axis}),
+        (r"^blocks/", {0: pipe_axis}),
+        (r"^embed$", {0: model_axis}),
+    ]
+
+
+def _block(p, x, dt, model_axis):
+    h = _layer_norm(x, p["ln1"])
+    q = tensor.column_parallel_dense(h, p["attn"]["wq"].astype(dt))
+    k = tensor.column_parallel_dense(h, p["attn"]["wk"].astype(dt))
+    v = tensor.column_parallel_dense(h, p["attn"]["wv"].astype(dt))
+    o = _causal_attention(q, k, v)
+    o = tensor.row_parallel_dense(o, p["attn"]["wo"].astype(dt),
+                                  p["attn"]["bo"].astype(dt),
+                                  model_axis, contract_dims=2)
+    x = x + o
+    h = _layer_norm(x, p["ln2"])
+    h = tensor.column_parallel_dense(h, p["mlp"]["w1"].astype(dt),
+                                     p["mlp"]["b1"].astype(dt))
+    h = jax.nn.gelu(h)
+    h = tensor.row_parallel_dense(h, p["mlp"]["w2"].astype(dt),
+                                  p["mlp"]["b2"].astype(dt), model_axis)
+    return x + h
+
+
+def forward(params, input_ids, cfg: TPLMConfig, n_microbatches: int = 1,
+            pipe_axis: str = const.PIPELINE_AXIS,
+            model_axis: str = const.MODEL_AXIS):
+    dt = cfg.dtype
+    seq_len = input_ids.shape[-1]
+    x = tensor.vocab_parallel_embed(params["embed"], input_ids, model_axis)
+    x = (x * np.sqrt(cfg.d_model)).astype(dt)
+    x = x + params["pos_embed"].astype(dt)[jnp.arange(seq_len)][None]
+
+    def stage_fn(blocks_local, h):
+        return pipeline.stacked_scan(
+            lambda p, hh: _block(p, hh, dt, model_axis), blocks_local, h)
+
+    x = pipeline.pipeline_apply(stage_fn, params["blocks"], x,
+                                n_microbatches, pipe_axis)
+    x = _layer_norm(x, params["final_ln"])
+    return tensor.vocab_parallel_logits(x, params["embed"].astype(dt))
+
+
+def make_train_setup(cfg: Optional[TPLMConfig] = None, seq_len: int = 128,
+                     batch_size: int = 8, seed: int = 0,
+                     n_microbatches: int = 1,
+                     model_axis: str = const.MODEL_AXIS):
+    cfg = cfg or TPLMConfig()
+    params = init_params(cfg, seed)
+
+    def loss_fn(p, batch):
+        tokens = batch["tokens"]
+        logits = forward(p, tokens[:, :-1], cfg, n_microbatches,
+                         model_axis=model_axis)
+        nll = tensor.vocab_parallel_xent(logits, tokens[:, 1:], model_axis)
+        return jnp.mean(nll)
+
+    npr = np.random.RandomState(seed)
+    example_batch = {"tokens": npr.randint(
+        0, cfg.vocab_size, (batch_size, seq_len + 1)).astype(np.int32)}
+    apply_fn = lambda p, ids: forward(p, ids, cfg, n_microbatches,  # noqa: E731
+                                      model_axis=model_axis)
+    return loss_fn, params, example_batch, apply_fn

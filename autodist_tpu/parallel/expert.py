@@ -1,0 +1,118 @@
+"""Expert parallelism — Mixture-of-Experts with all_to_all token routing.
+
+Beyond the reference (data-parallel only, reference
+``docs/design/architecture.rst:46-48``). Experts are stacked on a leading
+dim sharded over the ``expert`` mesh axis (``VarConfig.mp_axes = {0:
+'expert'}``); tokens are routed to their expert's owning device with one
+``lax.all_to_all`` each way (GShard, arXiv 2006.16668; Switch Transformer,
+arXiv 2101.03961). Static shapes throughout — the MXU-hostile part of MoE
+(data-dependent routing) is expressed as dense one-hot dispatch/combine
+einsums with a fixed per-expert capacity, which is the idiomatic TPU
+formulation (dynamic scatter would defeat XLA tiling).
+
+All helpers degrade gracefully when the axis is unbound: single-device
+execution computes every expert locally — one model definition for both
+paths, as with ``parallel/tensor.py`` / ``parallel/pipeline.py``.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu import const
+from autodist_tpu.parallel.sequence import axis_bound
+
+
+def top1_dispatch(router_probs, capacity: int):
+    """Top-1 gating with capacity (Switch). router_probs [T, E] ->
+    (dispatch [T, E, C] one-hot, combine [T, E, C] gated, aux_loss scalar).
+
+    Tokens beyond an expert's capacity are dropped (their combine weights
+    are zero -> they pass through the residual connection only).
+    """
+    T, E = router_probs.shape
+    expert_idx = jnp.argmax(router_probs, axis=-1)               # [T]
+    gate = jnp.take_along_axis(router_probs, expert_idx[:, None], 1)[:, 0]
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=router_probs.dtype)  # [T, E]
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0              # [T, E]
+    keep = (pos >= 0) & (pos < capacity)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                            dtype=router_probs.dtype)            # [T, E, C]
+    dispatch = pos_oh * keep.astype(router_probs.dtype)[..., None]
+    combine = dispatch * gate[:, None, None]
+    # Switch aux load-balance loss: E * sum_e fraction_dispatched * mean_prob
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(router_probs, axis=0)
+    aux = E * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def _dispatch_a2a(x_ecd, axis_name):
+    """[E, C, d] (inputs for every global expert, from local tokens) ->
+    [E_local, N*C, d] (this rank's experts' inputs from every rank)."""
+    n = jax.lax.psum(1, axis_name)
+    E, C, d = x_ecd.shape
+    x = x_ecd.reshape(n, E // n, C, d)
+    # tiled a2a on dim 0: rank r keeps expert-group r from EVERY source
+    # rank; dim 0 of the result indexes the source rank
+    x = jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                           tiled=True)                       # [n, E_local, C, d]
+    x = x.transpose(1, 0, 2, 3)                              # [E_local, n, C, d]
+    return x.reshape(E // n, n * C, d)
+
+
+def _combine_a2a(y_elcd, axis_name, E: int):
+    """Inverse of ``_dispatch_a2a``: [E_local, N*C, d] -> [E, C, d]."""
+    n = jax.lax.psum(1, axis_name)
+    E_local, NC, d = y_elcd.shape
+    C = NC // n
+    y = y_elcd.reshape(E_local, n, C, d).transpose(1, 0, 2, 3)  # [n, E_local, C, d]
+    y = jax.lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
+                           tiled=True)                       # [n, E_local, C, d]
+    return y.reshape(E, C, d)
+
+
+def moe_ffn(x, router_w, w1, b1, w2, b2,
+            capacity_factor: float = 2.0,
+            axis_name: str = const.EXPERT_AXIS,
+            dtype=None) -> Tuple[jax.Array, jax.Array]:
+    """Top-1 MoE feed-forward. Returns (output with x's shape, aux loss).
+
+    - ``x``: [..., d] local activations; flattened to tokens internally.
+    - ``router_w``: [d, E] (replicated).
+    - ``w1``/``b1``/``w2``/``b2``: expert-stacked [E(, ...)] — pass the LOCAL
+      shard inside the lowering ([E_local, ...]) or the full stack outside.
+    - capacity C = ceil(T_local/E * capacity_factor) tokens per expert per
+      rank (static).
+    """
+    dt = dtype or x.dtype
+    d = x.shape[-1]
+    lead = x.shape[:-1]
+    tokens = x.reshape(-1, d)
+    T = tokens.shape[0]
+    bound = axis_bound(axis_name)
+    n = jax.lax.psum(1, axis_name) if bound else 1
+    E_local = w1.shape[0]
+    E = E_local * n
+    capacity = int(np.ceil(T / E * capacity_factor))
+
+    logits = tokens.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits)
+    dispatch, combine, aux = top1_dispatch(probs, capacity)
+    dispatch = dispatch.astype(dt)
+    combine = combine.astype(dt)
+
+    x_ecd = jnp.einsum("td,tec->ecd", tokens, dispatch)      # [E, C, d]
+    if bound:
+        x_in = _dispatch_a2a(x_ecd, axis_name)               # [E_local, nC, d]
+    else:
+        x_in = x_ecd
+    h = jnp.einsum("ecd,edf->ecf", x_in, w1.astype(dt)) + b1.astype(dt)[:, None]
+    h = jax.nn.gelu(h)
+    y = jnp.einsum("ecf,efd->ecd", h, w2.astype(dt)) + b2.astype(dt)[:, None]
+    if bound:
+        y = _combine_a2a(y, axis_name, E)                    # [E, C, d]
+    out = jnp.einsum("tec,ecd->td", combine, y)
+    return out.reshape(lead + (d,)), aux.astype(jnp.float32)

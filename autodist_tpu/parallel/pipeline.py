@@ -1,0 +1,103 @@
+"""Pipeline parallelism — GPipe-style SPMD microbatch pipelining.
+
+Beyond the reference, which has no pipeline axis ("Currently, AutoDist only
+supports data-parallel distribution", reference
+``docs/design/architecture.rst:46-48``). On TPU the pipeline is expressed
+INSIDE the lowering's shard_map: layer-stacked parameters are sharded over
+the ``pipe`` mesh axis (``VarConfig.mp_axes = {0: 'pipe'}``), every pipe
+rank runs the same program, and activations flow rank-to-rank with
+``lax.ppermute`` over nearest-neighbor ICI links. The schedule is GPipe
+(Huang et al., arXiv 1811.06965): M microbatches stream through S stages in
+M + S - 1 ticks, implemented as one ``lax.scan`` so XLA compiles a single
+fused loop; reverse-mode AD through ppermute/scan yields the exact backward
+schedule automatically.
+
+Gradient correctness needs no special-casing: the loss is made uniform
+across pipe ranks with a psum broadcast, whose transpose gives every rank
+the summed cotangent; the lowering's ``psum(complement)/N`` sync for
+pipe-sharded vars and ``psum(all)/N`` for replicated vars are exact against
+that convention (same algebra as tensor parallelism — see
+``parallel/tensor.py`` and ``kernel/graph_transformer.py``).
+
+Composes with tensor parallelism: stack dim 0 over ``pipe`` and head/hidden
+dims over ``model`` in the same ``mp_axes`` spec, and use
+``parallel/tensor.py`` ops inside the stage body.
+"""
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu import const
+from autodist_tpu.parallel.sequence import axis_bound
+
+
+def num_stages(axis_name: str = const.PIPELINE_AXIS) -> int:
+    return jax.lax.psum(1, axis_name) if axis_bound(axis_name) else 1
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x,
+                   n_microbatches: int,
+                   axis_name: str = const.PIPELINE_AXIS):
+    """Run ``x`` through the full layer stack, pipelined over ``axis_name``.
+
+    - ``stage_fn(stage_params, h) -> h``: applies this rank's layer chunk;
+      ``stage_params`` leaves are stacked [stages_per_device, ...] shards
+      (apply them sequentially inside). Activation shape must be uniform
+      across stages (the transformer-block invariant).
+    - ``x``: local activations [B, ...] (replicated over the pipe axis; B is
+      the per-data-shard batch). Split into ``n_microbatches`` along dim 0.
+    - Returns the final stage's output for the whole batch, broadcast to all
+      pipe ranks (so the loss/head computes identically everywhere).
+
+    Outside shard_map (single device / capture tracing) this degenerates to
+    a plain sequential apply — one model definition serves both paths.
+    """
+    if not axis_bound(axis_name):
+        return stage_fn(stage_params, x)
+
+    S = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    M = n_microbatches
+    B = x.shape[0]
+    if B % M != 0:
+        raise ValueError("batch %d not divisible by %d microbatches" % (B, M))
+    x_mb = x.reshape((M, B // M) + x.shape[1:])
+
+    # stage r receives from r-1; rank 0 reads microbatches, rank S-1's
+    # output is collected (no wraparound send)
+    perm = [(i, i + 1) for i in range(S - 1)]
+    state0 = jnp.zeros_like(x_mb[0])
+    outs0 = jnp.zeros_like(x_mb)
+
+    def tick(carry, t):
+        state, outs = carry
+        inp = jnp.where(rank == 0,
+                        jax.lax.dynamic_index_in_dim(
+                            x_mb, jnp.clip(t, 0, M - 1), 0, keepdims=False),
+                        state)
+        out = stage_fn(stage_params, inp)
+        # the last rank finishes microbatch t-(S-1) at tick t
+        idx = t - (S - 1)
+        valid = (idx >= 0) & (rank == S - 1)
+        written = jax.lax.dynamic_update_slice_in_dim(
+            outs, out[None], jnp.clip(idx, 0, M - 1), 0)
+        outs = jnp.where(valid, written, outs)
+        state = jax.lax.ppermute(out, axis_name, perm)
+        return (state, outs), None
+
+    (_, outs), _ = jax.lax.scan(tick, (state0, outs0), jnp.arange(M + S - 1))
+    # broadcast the last rank's collected outputs to every pipe rank
+    outs = jax.lax.psum(
+        jnp.where(rank == S - 1, outs, jnp.zeros_like(outs)), axis_name)
+    return outs.reshape((B,) + x.shape[1:])
+
+
+def stacked_scan(block_fn: Callable, stacked_params, h):
+    """Apply ``block_fn(params_i, h) -> h`` for each leading-dim slice of
+    ``stacked_params`` via ``lax.scan`` (compile-time-friendly for deep
+    stacks; the standard stage body for ``pipeline_apply``)."""
+    def body(carry, p):
+        return block_fn(p, carry), None
+    out, _ = jax.lax.scan(body, h, stacked_params)
+    return out

@@ -23,11 +23,17 @@ from autodist_tpu.utils import logging
 
 
 class Remapper:
-    def __init__(self, mesh, mesh_axis: str, seq_axis: str = None):
+    def __init__(self, mesh, mesh_axis: str, seq_axis: str = None,
+                 batch_axes=None):
         self.mesh = mesh
         self.mesh_axis = mesh_axis
         self.seq_axis = seq_axis
-        self.num_replicas = mesh.shape[mesh_axis]
+        # axes the batch dim shards over (expert-parallel strategies add the
+        # expert axis so every device sees distinct tokens)
+        self.batch_axes = tuple(batch_axes) if batch_axes else (mesh_axis,)
+        self.num_replicas = 1
+        for a in self.batch_axes:
+            self.num_replicas *= int(mesh.shape[a])
         self.seq_shards = mesh.shape[seq_axis] if seq_axis else 1
 
     # ------------------------------------------------------------------ feed
@@ -53,8 +59,8 @@ class Remapper:
                     raise ValueError(
                         "sequence dim %d is not divisible by the %d "
                         "sequence shards" % (arr.shape[1], self.seq_shards))
-                return self._place(arr, P(self.mesh_axis, self.seq_axis))
-            return self._place(arr, P(self.mesh_axis))
+                return self._place(arr, P(self.batch_axes, self.seq_axis))
+            return self._place(arr, P(self.batch_axes))
         return jax.tree_util.tree_map(place, batch)
 
     # ----------------------------------------------------------------- fetch

@@ -28,7 +28,8 @@ class Runner:
         self._dstep = distributed_step
         self._remapper = Remapper(distributed_step.mesh,
                                   distributed_step.mesh_axis,
-                                  seq_axis=distributed_step.seq_axis)
+                                  seq_axis=distributed_step.seq_axis,
+                                  batch_axes=distributed_step.batch_axes)
         self._tracing = tracing
         self._trace_started = False
         self.state: Optional[TrainState] = None

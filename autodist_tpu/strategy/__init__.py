@@ -13,10 +13,13 @@ from autodist_tpu.strategy.random_axis_partition_all_reduce_strategy import (
 from autodist_tpu.strategy.parallax_strategy import Parallax
 from autodist_tpu.strategy.sequence_parallel_strategy import SequenceParallelAR
 from autodist_tpu.strategy.tensor_parallel_strategy import TensorParallel
+from autodist_tpu.strategy.pipeline_parallel_strategy import PipelineParallel
+from autodist_tpu.strategy.expert_parallel_strategy import ExpertParallel
 from autodist_tpu.strategy.auto_strategy import AutoStrategy
 
 __all__ = ["Strategy", "StrategyBuilder", "StrategyCompiler", "VarConfig",
            "GraphConfig", "PSSynchronizer", "AllReduceSynchronizer",
            "PS", "PSLoadBalancing", "PartitionedPS", "UnevenPartitionedPS",
            "AllReduce", "PartitionedAR", "RandomAxisPartitionAR", "Parallax",
-           "SequenceParallelAR", "TensorParallel", "AutoStrategy"]
+           "SequenceParallelAR", "TensorParallel", "PipelineParallel",
+           "ExpertParallel", "AutoStrategy"]

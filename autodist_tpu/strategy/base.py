@@ -173,16 +173,21 @@ class GraphConfig:
     # when set, batch leaves of rank >= 2 shard their dim 1 (the sequence
     # dim) over this mesh axis — set by sequence-parallel builders
     seq_axis: Optional[str] = None
+    # mesh axes the batch dim (dim 0) shards over; None -> just the data
+    # axis. Expert-parallel strategies set ['data', 'expert'] so every
+    # device sees distinct tokens
+    batch_axes: Optional[List[str]] = None
 
     def to_dict(self):
         return {"replicas": list(self.replicas), "mesh_shape": self.mesh_shape,
-                "seq_axis": self.seq_axis}
+                "seq_axis": self.seq_axis, "batch_axes": self.batch_axes}
 
     @classmethod
     def from_dict(cls, d):
         return cls(replicas=list(d.get("replicas", [])),
                    mesh_shape=d.get("mesh_shape"),
-                   seq_axis=d.get("seq_axis"))
+                   seq_axis=d.get("seq_axis"),
+                   batch_axes=d.get("batch_axes"))
 
 
 # ----------------------------------------------------------------- strategy

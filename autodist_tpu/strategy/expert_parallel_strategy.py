@@ -1,0 +1,44 @@
+"""Expert-parallel (MoE) strategy builder (beyond the reference).
+
+Adds the ``expert`` mesh axis: expert-stacked variables matching the
+model's rules shard their stack dim over it and tokens route with
+all_to_all (``parallel/expert.py``). The batch dim shards over
+data x expert jointly (``GraphConfig.batch_axes``) so every device holds
+distinct tokens — the expert axis doubles as extra data parallelism for the
+dense layers, the standard MoE-EP arrangement (GShard, arXiv 2006.16668).
+"""
+from autodist_tpu import const
+from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+from autodist_tpu.strategy.base import Strategy
+from autodist_tpu.strategy.tensor_parallel_strategy import (
+    MpRules, add_frozen_nodes, apply_mp_rules)
+from autodist_tpu.utils import logging
+
+
+class ExpertParallel(AllReduce):
+    """(data x expert) mesh with all_to_all token routing."""
+
+    def __init__(self, ep_shards: int, mp_rules: MpRules,
+                 chunk_size: int = 128, all_reduce_spec: str = "AUTO",
+                 compressor: str = "NoneCompressor"):
+        super().__init__(chunk_size, all_reduce_spec, compressor)
+        if ep_shards < 1:
+            raise ValueError("ep_shards must be >= 1")
+        self.ep_shards = ep_shards
+        self.mp_rules = list(mp_rules)
+
+    def build(self, model_item, resource_spec) -> Strategy:
+        strategy = super().build(model_item, resource_spec)
+        n_devices = len(strategy.graph_config.replicas)
+        if n_devices % self.ep_shards != 0:
+            raise ValueError("%d devices not divisible by ep_shards=%d"
+                             % (n_devices, self.ep_shards))
+        mesh_shape = {const.DATA_AXIS: n_devices // self.ep_shards,
+                      const.EXPERT_AXIS: self.ep_shards}
+        strategy.graph_config.mesh_shape = mesh_shape
+        strategy.graph_config.batch_axes = [const.DATA_AXIS, const.EXPERT_AXIS]
+        add_frozen_nodes(strategy, model_item)
+        n = apply_mp_rules(strategy, self.mp_rules)
+        logging.info("ExpertParallel: %d/%d vars expert-sharded, mesh %s",
+                     n, len(strategy.node_config), mesh_shape)
+        return strategy

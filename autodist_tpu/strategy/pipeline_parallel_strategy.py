@@ -1,0 +1,60 @@
+"""Pipeline-parallel strategy builder (beyond the reference).
+
+Adds the ``pipe`` mesh axis: layer-stacked variables matching the model's
+rules shard their stack dim over it (``VarConfig.mp_axes``) and the model
+streams microbatches through the stages with the GPipe schedule
+(``parallel/pipeline.py``). Composes with tensor parallelism (``tp_shards``)
+on the innermost mesh dim — the reference's strategy space stops at data
+parallelism (``docs/design/architecture.rst:46-48``).
+"""
+from autodist_tpu import const
+from autodist_tpu.strategy.all_reduce_strategy import AllReduce
+from autodist_tpu.strategy.base import Strategy
+from autodist_tpu.strategy.tensor_parallel_strategy import (
+    MpRules, add_frozen_nodes, apply_mp_rules)
+from autodist_tpu.utils import logging
+
+
+class PipelineParallel(AllReduce):
+    """pipe x dp (x tp) mesh with GPipe microbatch pipelining.
+
+    ``mp_rules`` comes from the model family (e.g.
+    ``models.pipe_lm.pp_rules(model_axis=...)``); ``n_microbatches`` is
+    carried as metadata — the model's ``pipeline_apply`` call must use the
+    same value.
+    """
+
+    def __init__(self, pp_shards: int, mp_rules: MpRules,
+                 n_microbatches: int = 4, tp_shards: int = 1,
+                 chunk_size: int = 128, all_reduce_spec: str = "AUTO",
+                 compressor: str = "NoneCompressor"):
+        super().__init__(chunk_size, all_reduce_spec, compressor)
+        if pp_shards < 1 or tp_shards < 1:
+            raise ValueError("pp_shards/tp_shards must be >= 1")
+        if n_microbatches < 1:
+            raise ValueError("n_microbatches must be >= 1")
+        self.pp_shards = pp_shards
+        self.tp_shards = tp_shards
+        self.n_microbatches = n_microbatches
+        self.mp_rules = list(mp_rules)
+
+    def build(self, model_item, resource_spec) -> Strategy:
+        strategy = super().build(model_item, resource_spec)
+        n_devices = len(strategy.graph_config.replicas)
+        denom = self.pp_shards * self.tp_shards
+        if n_devices % denom != 0:
+            raise ValueError("%d devices not divisible by pp*tp=%d"
+                             % (n_devices, denom))
+        # outer->inner: pipe (rank-to-rank ppermute, tolerant of distance),
+        # data, model (per-layer psums want the fastest links)
+        mesh_shape = {const.PIPELINE_AXIS: self.pp_shards,
+                      const.DATA_AXIS: n_devices // denom}
+        if self.tp_shards > 1:
+            mesh_shape[const.MODEL_AXIS] = self.tp_shards
+        strategy.graph_config.mesh_shape = mesh_shape
+        add_frozen_nodes(strategy, model_item)
+        n = apply_mp_rules(strategy, self.mp_rules)
+        logging.info("PipelineParallel: %d/%d vars pipe-sharded, mesh %s, "
+                     "%d microbatches", n, len(strategy.node_config),
+                     mesh_shape, self.n_microbatches)
+        return strategy

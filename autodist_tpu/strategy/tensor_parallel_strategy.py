@@ -35,6 +35,17 @@ def apply_mp_rules(strategy: Strategy, rules: MpRules) -> int:
     return n
 
 
+def add_frozen_nodes(strategy: Strategy, model_item) -> None:
+    """Emit layout-only nodes for frozen vars so mp rules can shard their
+    storage (the TP/PP/EP compute consumes local shards regardless of
+    trainability). Shared by every model-parallel builder."""
+    from autodist_tpu.strategy.base import VarConfig
+    have = {n.var_name for n in strategy.node_config}
+    for name, info in model_item.var_infos.items():
+        if name not in have and not info.trainable:
+            strategy.node_config.append(VarConfig(var_name=name))
+
+
 class TensorParallel(AllReduce):
     """dp x tp (x sp) mesh with Megatron-sharded compute.
 
@@ -73,14 +84,7 @@ class TensorParallel(AllReduce):
             strategy.graph_config.seq_axis = const.SEQUENCE_AXIS
         mesh_shape[const.MODEL_AXIS] = self.tp_shards
         strategy.graph_config.mesh_shape = mesh_shape
-        # frozen vars matching an mp rule still need sharded storage (the TP
-        # compute consumes local shards regardless of trainability) — emit
-        # layout-only nodes for them
-        from autodist_tpu.strategy.base import VarConfig
-        have = {n.var_name for n in strategy.node_config}
-        for name, info in model_item.var_infos.items():
-            if name not in have and not info.trainable:
-                strategy.node_config.append(VarConfig(var_name=name))
+        add_frozen_nodes(strategy, model_item)
         n = apply_mp_rules(strategy, self.mp_rules)
         logging.info("TensorParallel: %d/%d vars model-sharded over %d-way "
                      "tp (mesh %s)", n, len(strategy.node_config),
