@@ -136,6 +136,10 @@ def make_attn_fn(kind: str = "ring", axis_name: str = "seq",
     if kind == "ulysses":
         return lambda q, k, v, mask=None: ulysses_attention(
             q, k, v, axis_name, causal=causal)
+    if kind == "flash":
+        # single-device fused pallas kernel (no mesh axis involved)
+        from autodist_tpu.ops.flash_attention import make_flash_attn_fn
+        return make_flash_attn_fn(causal=causal)
     if kind == "reference":
         return lambda q, k, v, mask=None: reference_attention(q, k, v, mask)
     raise ValueError("unknown attention kind %r" % kind)
