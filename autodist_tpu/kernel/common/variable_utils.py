@@ -27,18 +27,28 @@ def unflatten_named(treedef, leaves):
     return tree_unflatten(treedef, leaves)
 
 
-def match_state_to_var(state_name: str, state_shape, var_infos) -> str:
+def match_state_to_var(state_name: str, state_shape, var_infos,
+                       var_layouts: Dict[str, Any] = None) -> str:
     """Map an optimizer-state leaf to the variable it tracks.
 
     A state leaf (e.g. ``0/mu/dense/kernel`` for adam's first moment of
     ``dense/kernel``) matches a variable when the variable's name is a
-    path-suffix of the state leaf's name and the shapes agree. Returns the
-    variable name or '' when the leaf is variable-independent (step counts,
-    scalars). This replaces the reference's deletion/rebuild of entire
-    optimizer name scopes (``kernel/partitioner.py:376-426``)."""
+    path-suffix of the state leaf's name and the shapes agree — either the
+    variable's original shape, or (when ``var_layouts`` is given) its
+    partition-padded shape, so state already placed on the mesh still
+    matches. Returns the variable name or '' when the leaf is
+    variable-independent (step counts, scalars). This replaces the
+    reference's deletion/rebuild of entire optimizer name scopes
+    (``kernel/partitioner.py:376-426``)."""
     best = ""
     for var_name, info in var_infos.items():
-        if tuple(state_shape) != tuple(info.shape):
+        shapes = [tuple(info.shape)]
+        lay = (var_layouts or {}).get(var_name)
+        if lay is not None and getattr(lay, "partitioned", False):
+            padded = list(info.shape)
+            padded[lay.axis] = lay.padded_dim
+            shapes.append(tuple(padded))
+        if tuple(state_shape) not in shapes:
             continue
         if state_name == var_name or state_name.endswith("/" + var_name):
             if len(var_name) > len(best):
@@ -54,7 +64,7 @@ def map_state_layouts(state_tree, var_infos, var_layouts: Dict[str, Any], defaul
     for path, leaf in flat:
         name = _normalize_path(path)
         shape = getattr(leaf, "shape", ())
-        var = match_state_to_var(name, shape, var_infos)
+        var = match_state_to_var(name, shape, var_infos, var_layouts)
         out.append(var_layouts.get(var, default) if var else default)
     return tree_unflatten(treedef, out)
 

@@ -98,7 +98,9 @@ class DistributedStep:
         # pad + place params
         def place_var(leaf, lay: VarLayout):
             arr = np.asarray(leaf)
-            if lay.partitioned:
+            # already-padded leaves (state re-initialized from a live placed
+            # TrainState) must not be padded a second time
+            if lay.partitioned and arr.shape[lay.axis] == lay.orig_dim:
                 pad = [(0, 0)] * arr.ndim
                 pad[lay.axis] = (0, lay.padded_dim - lay.orig_dim)
                 arr = np.pad(arr, pad)
