@@ -140,7 +140,6 @@ def test_case_trains_under_strategy(cname, make_case, bname, make_builder):
     losses = [step(batch)["loss"] for _ in range(6)]
     assert all(np.isfinite(l) for l in losses), (cname, bname, losses)
     assert losses[-1] < losses[0], (cname, bname, losses)
-    autodist_tpu.reset()
 
 
 @pytest.mark.parametrize("cname,make_case", CASES, ids=[c[0] for c in CASES])
@@ -166,7 +165,6 @@ def test_case_numeric_vs_single_device(cname, make_case):
     for (n, e), (_, g) in zip(flat, flat_got):
         np.testing.assert_allclose(np.asarray(g), np.asarray(e),
                                    rtol=1e-5, atol=1e-5, err_msg=str(n))
-    autodist_tpu.reset()
 
 
 # ------------------------------------------------------- c9 / c10 analogs
@@ -180,7 +178,6 @@ def test_staleness_accepted():
     step = ad.function(loss_fn, optimizer=optax.adam(2e-2), params=params)
     losses = [step(batch)["loss"] for _ in range(4)]
     assert losses[-1] < losses[0]
-    autodist_tpu.reset()
 
 
 @pytest.mark.parametrize("bname,make_builder",
@@ -200,7 +197,7 @@ def test_saver_roundtrip_under_strategy(tmp_path, bname, make_builder):
         m = runner.run(batch)
     saver = Saver(directory=str(tmp_path))
     path = saver.save(runner)
-    autodist_tpu.reset()
+    autodist_tpu.reset()  # mid-test: allow a second AutoDist instance
 
     # restore into a different strategy family
     ad2 = autodist_tpu.AutoDist(strategy_builder=S.AllReduce())
@@ -209,4 +206,3 @@ def test_saver_roundtrip_under_strategy(tmp_path, bname, make_builder):
     saver.restore(runner2, path)
     m2 = runner2.run(batch)
     assert m2["loss"] <= m["loss"] + 1e-5, (m, m2)
-    autodist_tpu.reset()
