@@ -145,9 +145,20 @@ _REGISTRY["BF16CompressorEF"] = HorovodCompressorEF
 
 
 def create(name: Optional[str], var_name: str = "") -> Compressor:
-    """Factory by class name (reference ``Compressor.create``)."""
+    """Factory by class name (reference ``Compressor.create``). PowerSGD's
+    rank rides in the serializable name: ``"PowerSGDCompressor:4"``."""
     if not name:
         return NoneCompressor(var_name)
-    if name not in _REGISTRY:
+    base, _, arg = name.partition(":")
+    if base not in _REGISTRY:
         raise ValueError("unknown compressor %r (have %s)" % (name, sorted(_REGISTRY)))
-    return _REGISTRY[name](var_name)
+    cls = _REGISTRY[base]
+    if arg:
+        if cls is not PowerSGDCompressor:
+            raise ValueError("compressor %r takes no argument" % name)
+        try:
+            rank = int(arg)
+        except ValueError:
+            raise ValueError("compressor %r: rank must be an integer" % name)
+        return cls(var_name, rank=rank)
+    return cls(var_name)

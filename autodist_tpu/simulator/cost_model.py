@@ -86,7 +86,9 @@ class CostModel:
         return self.flops_per_step() / max(num_devices, 1) / peak
 
     def _wire_bytes(self, info, sync) -> float:
-        factor = COMPRESSED_BYTES.get(getattr(sync, "compressor", ""), None)
+        # compressor names may carry an argument suffix ("PowerSGDCompressor:4")
+        name = getattr(sync, "compressor", "").partition(":")[0]
+        factor = COMPRESSED_BYTES.get(name, None)
         if factor is None:
             factor = WIRE_DTYPE_BYTES
         return info.num_elements * factor

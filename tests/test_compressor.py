@@ -1,0 +1,113 @@
+"""Gradient-compressor unit + e2e tests.
+
+The reference ships PowerSGD fully commented out
+(``kernel/synchronization/compressor.py:208-284``) and has no compressor
+unit tests; here the whole registry is live and covered: reconstruction
+exactness on low-rank gradients, error-feedback convergence (the arXiv
+1905.13727 EF guarantee), bf16 wire-format round-trips, and the full-stack
+mesh path with a warm-started Q carried in sync_state.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import autodist_tpu
+from autodist_tpu import strategy as S
+from autodist_tpu.kernel.synchronization import compressor as C
+
+IDENT_PSUM = lambda x: x  # single-worker reduction  # noqa: E731
+
+
+def test_registry_create_and_errors():
+    assert isinstance(C.create(None), C.NoneCompressor)
+    assert isinstance(C.create("BF16Compressor"), C.HorovodCompressor)
+    with pytest.raises(ValueError, match="unknown compressor"):
+        C.create("nope")
+    with pytest.raises(ValueError, match="takes no argument"):
+        C.create("HorovodCompressor:2")
+
+
+def test_powersgd_rank_from_name():
+    comp = C.create("PowerSGDCompressor:3", "w")
+    assert isinstance(comp, C.PowerSGDCompressor) and comp.rank == 3
+    state = comp.state_init((8, 6), jnp.float32)
+    assert state["q"].shape == (6, 3)
+
+
+def test_powersgd_exact_on_low_rank():
+    """A rank-r gradient is reconstructed exactly by rank-r PowerSGD in one
+    power iteration (P = MQ spans col(M) for generic Q)."""
+    rng = np.random.RandomState(0)
+    m = (rng.randn(10, 2) @ rng.randn(2, 7)).astype(np.float32)  # rank 2
+    comp = C.PowerSGDCompressor("w", rank=2)
+    state = comp.state_init(m.shape, jnp.float32)
+    approx, _ = comp.reduce(jnp.asarray(m), state, IDENT_PSUM)
+    np.testing.assert_allclose(np.asarray(approx), m, rtol=1e-4, atol=1e-4)
+
+
+def test_powersgd_error_feedback_converges():
+    """With a FIXED full-rank gradient, the EF residual keeps feeding the
+    unsent mass back, so the running mean of transmitted approximations
+    converges to the true gradient."""
+    rng = np.random.RandomState(1)
+    g = rng.randn(12, 9).astype(np.float32)
+    comp = C.PowerSGDCompressor("w", rank=2)
+    state = comp.state_init(g.shape, jnp.float32)
+    total = np.zeros_like(g)
+    steps = 60
+    for _ in range(steps):
+        approx, state = comp.reduce(jnp.asarray(g), state, IDENT_PSUM)
+        total += np.asarray(approx)
+    rel = np.linalg.norm(total / steps - g) / np.linalg.norm(g)
+    assert rel < 0.05, rel
+
+
+def test_powersgd_passthrough_for_vectors():
+    comp = C.PowerSGDCompressor("b", rank=2)
+    assert comp.state_init((8,), jnp.float32) is None
+    v = jnp.arange(8, dtype=jnp.float32)
+    out, state = comp.reduce(v, None, IDENT_PSUM)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(v))
+    assert state is None
+
+
+def test_horovod_ef_error_is_quantization_residual():
+    rng = np.random.RandomState(2)
+    g = rng.randn(32).astype(np.float32) * 1e-3
+    comp = C.HorovodCompressorEF("w")
+    state = comp.state_init(g.shape, jnp.float32)
+    out1, state = comp.reduce(jnp.asarray(g), state, IDENT_PSUM)
+    # residual + wire value == compensated gradient, exactly
+    np.testing.assert_allclose(np.asarray(out1) + np.asarray(state), g,
+                               rtol=0, atol=1e-8)
+    # two EF steps transmit (almost) the full 2g despite bf16 rounding
+    out2, state = comp.reduce(jnp.asarray(g), state, IDENT_PSUM)
+    np.testing.assert_allclose(np.asarray(out1 + out2), 2 * g, rtol=2e-2)
+
+
+def test_powersgd_e2e_on_mesh():
+    """Full stack on the 8-device mesh: PowerSGD syncs per-var (not
+    bucketed), carries Q + error in sync_state, and training converges.
+    Rank 4 == full rank for a 16x4 gradient, so compression is exact and
+    convergence matches plain SGD; lower ranks converge via EF (covered by
+    test_powersgd_error_feedback_converges)."""
+    rng = np.random.RandomState(3)
+    params = {"w": jnp.zeros((16, 4), jnp.float32)}
+    W = rng.randn(16, 4).astype(np.float32)
+    x = rng.randn(64, 16).astype(np.float32)
+    batch = {"x": x, "y": x @ W}
+
+    def loss_fn(p, b):
+        return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+    ad = autodist_tpu.AutoDist(
+        strategy_builder=S.AllReduce(compressor="PowerSGDCompressor:4"))
+    step = ad.function(loss_fn, optimizer=optax.sgd(2e-2), params=params)
+    losses = [float(step(batch)["loss"]) for _ in range(200)]
+    assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])
+    state = step.get_runner().state
+    q = state.sync_state["var"]["w"]["q"]
+    assert q.shape[-2:] == (4, 4)  # m x rank, warm-started across steps
+    assert state.sync_state["var"]["w"]["error"].shape[-2:] == (16, 4)
