@@ -144,21 +144,37 @@ _REGISTRY["BF16Compressor"] = HorovodCompressor
 _REGISTRY["BF16CompressorEF"] = HorovodCompressorEF
 
 
+def parse_name(name: str) -> "tuple[str, Optional[int]]":
+    """Split a serializable compressor name into (base, rank).
+
+    The one place that knows the ``"PowerSGDCompressor:4"`` wire format;
+    rank is None when the name carries no argument. Raises ValueError for a
+    dangling ``:``, a non-integer rank, a rank < 1, or an argument on a
+    compressor that takes none.
+    """
+    base, sep, arg = name.partition(":")
+    if not sep:
+        return base, None
+    if base not in _REGISTRY or _REGISTRY[base] is not PowerSGDCompressor:
+        raise ValueError("compressor %r takes no argument" % name)
+    try:
+        rank = int(arg)
+    except ValueError:
+        raise ValueError("compressor %r: rank must be an integer" % name)
+    if rank < 1:
+        raise ValueError("compressor %r: rank must be >= 1" % name)
+    return base, rank
+
+
 def create(name: Optional[str], var_name: str = "") -> Compressor:
     """Factory by class name (reference ``Compressor.create``). PowerSGD's
     rank rides in the serializable name: ``"PowerSGDCompressor:4"``."""
     if not name:
         return NoneCompressor(var_name)
-    base, _, arg = name.partition(":")
+    base, rank = parse_name(name)
     if base not in _REGISTRY:
         raise ValueError("unknown compressor %r (have %s)" % (name, sorted(_REGISTRY)))
     cls = _REGISTRY[base]
-    if arg:
-        if cls is not PowerSGDCompressor:
-            raise ValueError("compressor %r takes no argument" % name)
-        try:
-            rank = int(arg)
-        except ValueError:
-            raise ValueError("compressor %r: rank must be an integer" % name)
+    if rank is not None:
         return cls(var_name, rank=rank)
     return cls(var_name)

@@ -28,8 +28,7 @@ CHIP_PEAK_FLOPS = {
 DEFAULT_MXU_EFFICIENCY = 0.4      # achieved/peak for typical training steps
 WIRE_DTYPE_BYTES = 4              # gradients travel fp32 unless compressed
 COMPRESSED_BYTES = {"HorovodCompressor": 2, "HorovodCompressorEF": 2,
-                    "BF16Compressor": 2, "BF16CompressorEF": 2,
-                    "PowerSGDCompressor": 0.25}
+                    "BF16Compressor": 2, "BF16CompressorEF": 2}
 PER_COLLECTIVE_LATENCY_S = 5e-6   # launch overhead per collective/bucket
 
 
@@ -86,8 +85,19 @@ class CostModel:
         return self.flops_per_step() / max(num_devices, 1) / peak
 
     def _wire_bytes(self, info, sync) -> float:
-        # compressor names may carry an argument suffix ("PowerSGDCompressor:4")
-        name = getattr(sync, "compressor", "").partition(":")[0]
+        from autodist_tpu.kernel.synchronization import compressor as compressor_lib
+        try:
+            name, rank = compressor_lib.parse_name(getattr(sync, "compressor", ""))
+        except ValueError:
+            name, rank = getattr(sync, "compressor", ""), None
+        if name == "PowerSGDCompressor":
+            if len(info.shape) == 2:
+                # PowerSGD ships P (n x r) + Q (m x r) instead of the n x m
+                # gradient, so wire bytes scale with the configured rank
+                n, m = info.shape
+                return float(rank or 1) * (n + m) * WIRE_DTYPE_BYTES
+            # non-matrix tensors pass through PowerSGD uncompressed
+            return info.num_elements * WIRE_DTYPE_BYTES
         factor = COMPRESSED_BYTES.get(name, None)
         if factor is None:
             factor = WIRE_DTYPE_BYTES

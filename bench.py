@@ -100,10 +100,13 @@ def main():
         run_fw()
     jax.block_until_ready((base_box[0], state_box[0].params))
 
+    # device throughput under the tunnel swings >1.5x between adjacent
+    # windows (observed 140-220 steps/s across 4 back-to-back trials), so
+    # many short alternating phases are needed before best-of converges
     base_best, fw_best = 0.0, 0.0
-    for _ in range(6):
-        base_best = max(base_best, _phase_rate(run_baseline, 30))
-        fw_best = max(fw_best, _phase_rate(run_fw, 30))
+    for _ in range(12):
+        base_best = max(base_best, _phase_rate(run_baseline, 20))
+        fw_best = max(fw_best, _phase_rate(run_fw, 20))
 
     examples_per_sec = fw_best * batch_size
     print(json.dumps({
