@@ -16,6 +16,8 @@ Usage (the 3-line-change pattern of ``examples/linear_regression.py``):
         metrics = train_step(batch)
 """
 import contextlib
+import json
+import time
 from typing import Callable, Optional
 
 from autodist_tpu import const, patch
@@ -94,21 +96,67 @@ class AutoDist:
 
     def _build_or_load_strategy(self, model_item: ModelItem) -> Strategy:
         """Chief builds+serializes; workers load by id
-        (reference ``autodist.py:100-109``)."""
+        (reference ``autodist.py:100-109``).
+
+        Two handoff modes:
+
+        - chief-launched (reference behavior): the chief serializes to disk,
+          the Coordinator copies the file to each worker before launching it,
+          and workers load by ``ADT_STRATEGY_ID``;
+        - externally launched (``ADT_EXTERNAL_LAUNCH``, GKE/mpirun style —
+          all processes start simultaneously): the strategy travels over a
+          collective broadcast, which by construction cannot deliver a stale
+          file from a previous run sharing the same serialization dir. A
+          preset ``ADT_STRATEGY_ID`` pins the id for reproducibility.
+        """
+        external = (const.ENV.ADT_EXTERNAL_LAUNCH.val
+                    and const.ENV.ADT_NUM_PROCESSES.val > 1)
         if const.is_chief():
             strategy = self._strategy_builder.build(model_item, self._resource_spec)
+            preset_id = const.ENV.ADT_STRATEGY_ID.val
+            if preset_id:
+                strategy.id = preset_id
             path = strategy.serialize()
             logging.info("built strategy %s -> %s", strategy.id, path)
+            if external:
+                from autodist_tpu.runtime import server_starter
+                import jax
+                if jax.process_index() != 0:
+                    raise RuntimeError(
+                        "externally-launched jobs must start the chief (no "
+                        "ADT_WORKER) with ADT_PROCESS_ID=0; this chief is "
+                        "process %d" % jax.process_index())
+                server_starter.broadcast_bytes(
+                    json.dumps(strategy.to_dict()).encode())
             return strategy
+        if external:
+            from autodist_tpu.runtime import server_starter
+            data = server_starter.broadcast_bytes()
+            return Strategy.from_dict(json.loads(data.decode()))
         strategy_id = const.ENV.ADT_STRATEGY_ID.val
         if not strategy_id:
             raise RuntimeError("worker process missing ADT_STRATEGY_ID")
-        return Strategy.deserialize(strategy_id)
+        # the Coordinator copies the file before launching this process, but
+        # local-FS latency can still race the first read — wait bounded-time
+        deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                return Strategy.deserialize(strategy_id)
+            except (FileNotFoundError, json.JSONDecodeError):
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        "strategy %s not available after 60s; did the chief "
+                        "fail before serializing?" % strategy_id)
+                time.sleep(0.2)
 
     def _setup(self, strategy: Strategy):
         """Chief-only: bring up the cluster + launch worker clients
-        (reference ``autodist.py:120-128``). Single-node runs skip this."""
-        if self._resource_spec.is_single_node() or not const.is_chief():
+        (reference ``autodist.py:120-128``). Single-node runs skip this, as
+        do externally-launched jobs — their workers already exist, so
+        SSH-launching clients would register duplicate process ids with the
+        running jax.distributed job."""
+        if (self._resource_spec.is_single_node() or not const.is_chief()
+                or const.ENV.ADT_EXTERNAL_LAUNCH.val):
             return
         from autodist_tpu.runtime.coordinator import Coordinator
         from autodist_tpu.runtime.cluster import SSHCluster

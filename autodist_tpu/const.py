@@ -57,6 +57,10 @@ class ENV(Enum):
     ADT_COORDINATOR_ADDR = ("ADT_COORDINATOR_ADDR", str, "")  # host:port of chief coordination service
     ADT_NUM_PROCESSES = ("ADT_NUM_PROCESSES", int, 1)
     ADT_PROCESS_ID = ("ADT_PROCESS_ID", int, 0)
+    # set (on every process) by external launchers (GKE/mpirun style) that
+    # start all processes simultaneously; switches the strategy handoff from
+    # chief-writes-file-then-launches-workers to a collective broadcast
+    ADT_EXTERNAL_LAUNCH = ("ADT_EXTERNAL_LAUNCH", bool, False)
 
     @property
     def val(self):

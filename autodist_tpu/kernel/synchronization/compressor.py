@@ -155,7 +155,9 @@ def parse_name(name: str) -> "tuple[str, Optional[int]]":
     base, sep, arg = name.partition(":")
     if not sep:
         return base, None
-    if base not in _REGISTRY or _REGISTRY[base] is not PowerSGDCompressor:
+    if base not in _REGISTRY:
+        raise ValueError("unknown compressor %r (have %s)" % (name, sorted(_REGISTRY)))
+    if _REGISTRY[base] is not PowerSGDCompressor:
         raise ValueError("compressor %r takes no argument" % name)
     try:
         rank = int(arg)

@@ -26,6 +26,18 @@ def init_distributed(coordinator_address: str, num_processes: int,
     if _INITIALIZED or num_processes <= 1:
         return
     import jax
+    try:
+        from jax._src import xla_bridge
+        already = xla_bridge.backends_are_initialized()
+    except (ImportError, AttributeError):
+        already = False  # private probe unavailable; initialize() still fails loudly
+    if already:
+        raise RuntimeError(
+            "the XLA backend is already initialized, so this process cannot "
+            "join the %d-process distributed runtime. In multi-process jobs, "
+            "construct AutoDist() (or call server_starter.init_distributed) "
+            "BEFORE any JAX computation — including jnp array creation for "
+            "model parameters." % num_processes)
     logging.info("jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
                  coordinator_address, num_processes, process_id)
     jax.distributed.initialize(coordinator_address=coordinator_address,
@@ -41,6 +53,28 @@ def maybe_init_distributed():
     n = const.ENV.ADT_NUM_PROCESSES.val
     if addr and n > 1:
         init_distributed(addr, n, const.ENV.ADT_PROCESS_ID.val)
+
+
+def broadcast_bytes(payload=None) -> bytes:
+    """Collective broadcast of a byte string from process 0 to every process.
+
+    The strategy handoff for externally-launched jobs (all processes started
+    simultaneously): unlike a shared filesystem, the job's own collective
+    cannot deliver bytes from a *previous* run, so workers can never load a
+    stale strategy. Must be called by ALL processes; only process 0's
+    ``payload`` is used (others pass None).
+    """
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+    is_src = jax.process_index() == 0
+    if is_src and payload is None:
+        raise ValueError("process 0 must provide the payload")
+    length = int(multihost_utils.broadcast_one_to_all(
+        np.int64(len(payload) if is_src else 0)))
+    buf = (np.frombuffer(payload, np.uint8) if is_src
+           else np.zeros(length, np.uint8))
+    return bytes(np.asarray(multihost_utils.broadcast_one_to_all(buf)))
 
 
 def clean_stale_servers(script_name: str = "server_starter"):

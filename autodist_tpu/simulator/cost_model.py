@@ -91,12 +91,13 @@ class CostModel:
         except ValueError:
             name, rank = getattr(sync, "compressor", ""), None
         if name == "PowerSGDCompressor":
-            if len(info.shape) == 2:
-                # PowerSGD ships P (n x r) + Q (m x r) instead of the n x m
-                # gradient, so wire bytes scale with the configured rank
-                n, m = info.shape
+            if len(info.shape) >= 2:
+                # PowerSGD flattens trailing dims to an n x m matrix and
+                # ships P (n x r) + Q (m x r), so wire bytes scale with rank
+                n = info.shape[0]
+                m = info.num_elements // max(n, 1)
                 return float(rank or 1) * (n + m) * WIRE_DTYPE_BYTES
-            # non-matrix tensors pass through PowerSGD uncompressed
+            # rank-0/1 tensors pass through PowerSGD uncompressed
             return info.num_elements * WIRE_DTYPE_BYTES
         factor = COMPRESSED_BYTES.get(name, None)
         if factor is None:

@@ -219,8 +219,12 @@ class Strategy:
         if path is None:
             os.makedirs(const.DEFAULT_SERIALIZATION_DIR, exist_ok=True)
             path = os.path.join(const.DEFAULT_SERIALIZATION_DIR, self.id)
-        with open(path, "w") as f:
+        # write-then-rename: workers poll for this file and must never
+        # observe a half-written strategy
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        with open(tmp, "w") as f:
             json.dump(self.to_dict(), f, sort_keys=True, indent=1)
+        os.replace(tmp, path)
         return path
 
     @classmethod

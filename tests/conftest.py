@@ -21,6 +21,11 @@ os.environ.setdefault("ADT_IS_TESTING", "1")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "integration: multi-process tests gated by --run-integration")
+
+
 def pytest_addoption(parser):
     parser.addoption("--run-integration", action="store_true", default=False,
                      help="run multi-process integration tests")

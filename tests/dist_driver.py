@@ -1,0 +1,80 @@
+"""Driver script for the real multi-process distributed test.
+
+Run by ``tests/test_distributed.py`` once per process (chief + worker), the
+analog of the reference's two-machine ``tests/integration/test_dist.py``
+where each node executes the same user script (reference
+``docs/design/architecture.rst:43-47``). Both processes:
+
+- join one jax.distributed job (4 virtual CPU devices each, 8 global),
+- build/load the SAME strategy (chief builds under the preset
+  ``ADT_STRATEGY_ID``; the worker polls for the serialized file),
+- lower it independently and train in lockstep via global-mesh collectives,
+- dump their observed losses + gathered params for the parent to compare.
+
+Usage: dist_driver.py <resource_spec.yml> <out.json> <builder> <n_steps>
+"""
+import json
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import autodist_tpu as adt  # noqa: E402
+from autodist_tpu import strategy as S  # noqa: E402
+
+BUILDERS = {
+    "AllReduce": lambda: S.AllReduce(chunk_size=2),
+    "PartitionedAR": lambda: S.PartitionedAR(),
+    "PartitionedPS": lambda: S.PartitionedPS(),
+    "Parallax": lambda: S.Parallax(),
+}
+
+
+def make_case(seed=0):
+    """Small 2-layer MLP; dims chosen divisible by 8 so partitioners bite."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(seed)
+    params = {
+        "w1": jnp.asarray(rng.randn(8, 16) * 0.3, jnp.float32),
+        "b1": jnp.zeros((16,), jnp.float32),
+        "w2": jnp.asarray(rng.randn(16, 4) * 0.3, jnp.float32),
+    }
+
+    def loss_fn(p, batch):
+        h = jnp.tanh(batch["x"] @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - batch["y"]) ** 2)
+
+    batch = {"x": rng.randn(16, 8).astype(np.float32),
+             "y": rng.randn(16, 4).astype(np.float32)}
+    return params, loss_fn, batch
+
+
+def main():
+    spec_yaml, out_path, builder_name, n_steps = (
+        sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4]))
+    # AutoDist first: joining the distributed runtime must precede any JAX
+    # computation (make_case builds jnp params)
+    ad = adt.AutoDist(resource_spec_file=spec_yaml,
+                      strategy_builder=BUILDERS[builder_name]())
+    params, loss_fn, batch = make_case()
+    step = ad.function(loss_fn, optimizer=optax.sgd(0.1), params=params)
+    losses = [float(step(batch)["loss"]) for _ in range(n_steps)]
+    gathered = step.get_runner().gather_params()
+    result = {
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+        "losses": losses,
+        "params": {k: np.asarray(v).tolist() for k, v in gathered.items()},
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+    print("dist_driver done:", builder_name, losses[-1], flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,141 @@
+"""Real multi-process distributed training test.
+
+The analog of the reference's two-machine ``tests/integration/test_dist.py``
+— no fake backend (SURVEY §4.3): two OS processes each holding 4 virtual CPU
+devices join one jax.distributed job over a local coordinator, run the full
+AutoDist stack (chief builds + serializes the strategy, the worker loads it,
+both lower independently and train in lockstep over the 8-device global
+mesh), and the parent asserts both processes observed identical losses that
+match a single-process 8-device run of the same strategy bit-for-bit.
+
+SSH launching is exercised dry-run (``ADT_DEBUG_REMOTE``) elsewhere
+(tests/test_cluster.py); here the parent plays the external launcher so the
+data path — cross-process Gloo collectives, strategy file handoff, global
+mesh construction — is fully real.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DRIVER = os.path.join(HERE, "dist_driver.py")
+
+SPEC_YAML = """
+nodes:
+  - address: 127.0.0.1
+    chief: true
+    cpus: [0, 1, 2, 3]
+  - address: localhost
+    cpus: [0, 1, 2, 3]
+"""
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch_pair(tmp_path, builder, n_steps=6, external=False):
+    """Run chief+worker. ``external=False`` models the chief-launched flow
+    (file handoff by preset id — the parent stands in for the Coordinator's
+    fresh remote_copy by clearing any stale file); ``external=True`` models
+    GKE/mpirun-style simultaneous launch (collective-broadcast handoff)."""
+    spec = tmp_path / "spec.yml"
+    spec.write_text(SPEC_YAML)
+    port = _free_port()
+    strategy_id = "dist-test-%s-%d" % (builder, os.getpid())
+    from autodist_tpu import const
+    strategy_file = os.path.join(const.DEFAULT_SERIALIZATION_DIR, strategy_id)
+    if os.path.exists(strategy_file):
+        os.unlink(strategy_file)
+    outs, procs = [], []
+    for pid in range(2):
+        out = tmp_path / ("out%d.json" % pid)
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)  # driver forces cpu via jax.config
+        env.update({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "ADT_COORDINATOR_ADDR": "127.0.0.1:%d" % port,
+            "ADT_NUM_PROCESSES": "2",
+            "ADT_PROCESS_ID": str(pid),
+            "ADT_STRATEGY_ID": strategy_id,
+            "ADT_DEBUG_REMOTE": "1",   # parent already launched the worker
+            "PYTHONPATH": os.pathsep.join(
+                [os.path.dirname(HERE)] +
+                ([os.environ["PYTHONPATH"]] if os.environ.get("PYTHONPATH") else [])),
+        })
+        if external:
+            env["ADT_EXTERNAL_LAUNCH"] = "1"
+        if pid == 1:
+            env["ADT_WORKER"] = "localhost"
+        procs.append(subprocess.Popen(
+            [sys.executable, DRIVER, str(spec), str(out), builder, str(n_steps)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+        outs.append(out)
+    deadline = time.monotonic() + 240
+    logs = []
+    for p in procs:
+        try:
+            log, _ = p.communicate(timeout=max(5.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed pair timed out for %s" % builder)
+        logs.append(log)
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, "process failed:\n%s" % log
+    return [json.loads(o.read_text()) for o in outs]
+
+
+def _single_process_reference(builder, n_steps=6):
+    """Same strategy on this (8-device, single-process) runtime."""
+    import autodist_tpu as adt
+    from tests.dist_driver import BUILDERS, make_case
+    import optax
+    adt.reset()
+    params, loss_fn, batch = make_case()
+    ad = adt.AutoDist(strategy_builder=BUILDERS[builder]())
+    step = ad.function(loss_fn, optimizer=optax.sgd(0.1), params=params)
+    return [float(step(batch)["loss"]) for _ in range(n_steps)]
+
+
+def _assert_pair_matches_reference(chief, worker, builder):
+    for r in (chief, worker):
+        assert r["process_count"] == 2
+        assert r["local_devices"] == 4
+        assert r["global_devices"] == 8
+    # both processes ran the same lockstep program
+    np.testing.assert_array_equal(chief["losses"], worker["losses"])
+    for k in chief["params"]:
+        np.testing.assert_array_equal(chief["params"][k], worker["params"][k])
+    # and the distributed run computes the same math as one process
+    # holding all 8 devices
+    ref = _single_process_reference(builder)
+    np.testing.assert_allclose(chief["losses"], ref, rtol=1e-5, atol=1e-6)
+    assert chief["losses"][-1] < chief["losses"][0]
+
+
+# Deliberately NOT gated behind --run-integration: these two cases are the
+# only real (non-dry-run) coverage of the cross-process data path and must
+# stay green in every run. One exercises each strategy family and each
+# handoff mode with no redundancy; the wider matrix is opt-in below.
+@pytest.mark.parametrize("builder,external", [("AllReduce", False),
+                                              ("PartitionedPS", True)])
+def test_two_process_training_matches_single_process(tmp_path, builder, external):
+    chief, worker = _launch_pair(tmp_path, builder, external=external)
+    _assert_pair_matches_reference(chief, worker, builder)
+
+
+@pytest.mark.integration
+@pytest.mark.parametrize("builder", ["PartitionedAR", "Parallax"])
+def test_two_process_extended_matrix(tmp_path, builder):
+    chief, worker = _launch_pair(tmp_path, builder, external=True)
+    _assert_pair_matches_reference(chief, worker, builder)
