@@ -4,12 +4,16 @@ Every model exposes ``make_train_setup(...) -> (loss_fn, params,
 example_batch, apply_fn)``, plugging directly into
 ``AutoDist.build(loss_fn, optimizer, params, example_batch)``.
 """
-from autodist_tpu.models import bert, lm, ncf, resnet  # noqa: F401
+from autodist_tpu.models import bert, cnn, lm, ncf, resnet  # noqa: F401
 
 REGISTRY = {
     "resnet18": lambda **kw: resnet.make_train_setup(resnet.ResNet18, **kw),
     "resnet50": lambda **kw: resnet.make_train_setup(resnet.ResNet50, **kw),
     "resnet101": lambda **kw: resnet.make_train_setup(resnet.ResNet101, **kw),
+    "vgg16": lambda **kw: resnet.make_train_setup(cnn.VGG16, **kw),
+    "inceptionv3": lambda **kw: resnet.make_train_setup(
+        cnn.InceptionV3, **{"image_size": 299, **kw}),
+    "densenet121": lambda **kw: resnet.make_train_setup(cnn.DenseNet121, **kw),
     "bert_base": lambda **kw: bert.make_train_setup(bert.BertConfig.base(), **kw),
     "bert_large": lambda **kw: bert.make_train_setup(bert.BertConfig.large(), **kw),
     "lm": lambda **kw: lm.make_train_setup(**kw),

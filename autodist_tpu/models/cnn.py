@@ -1,0 +1,209 @@
+"""VGG16 / InceptionV3 / DenseNet121 — the rest of the reference's
+ImageNet benchmark family.
+
+The reference benchmarks four keras CNNs (``examples/benchmark/imagenet.py:
+150-182``: resnet101, vgg16, inceptionv3, densenet121); ResNet lives in
+``models/resnet.py``, these are the other three. Implemented from scratch
+in flax: NHWC layout (TPU conv-native), bfloat16 compute with float32
+params/batch-stats, static shapes. Each family ships a Tiny config so the
+strategy/transform path is testable on CPU.
+"""
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+def _norm(train: bool, name=None):
+    return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                        dtype=jnp.float32, name=name)
+
+
+# ---------------------------------------------------------------------- VGG
+
+
+class VGG(nn.Module):
+    """VGG with batch-norm (the reference's keras VGG16 analog; BN keeps
+    bf16 training stable). The default ``flatten`` classifier keeps the
+    giant 25088->4096 FC layers — ~102M of VGG16's ~138M params and the
+    whole reason vgg16 stresses gradient sync (the reference tunes its
+    all-reduce chunk_size down to 25 for it); ``classifier="gap"`` swaps in
+    global average pooling for image-size-agnostic uses."""
+    stage_sizes: Sequence[int] = (2, 2, 3, 3, 3)
+    num_filters: Sequence[int] = (64, 128, 256, 512, 512)
+    num_classes: int = 1000
+    dense_width: int = 4096
+    classifier: str = "flatten"  # "flatten" (reference head) | "gap"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for stage, (n, f) in enumerate(zip(self.stage_sizes, self.num_filters)):
+            for _ in range(n):
+                x = nn.Conv(f, (3, 3), padding="SAME", use_bias=False,
+                            dtype=self.dtype)(x)
+                x = _norm(train)(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        if self.classifier == "flatten":
+            x = x.reshape((x.shape[0], -1))
+        else:
+            x = jnp.mean(x, axis=(1, 2))
+        x = nn.relu(nn.Dense(self.dense_width, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(self.dense_width, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+VGG16 = partial(VGG)
+VGGTiny = partial(VGG, stage_sizes=(1, 1), num_filters=(8, 16), dense_width=32)
+
+
+# ----------------------------------------------------------------- Inception
+
+
+class ConvBN(nn.Module):
+    filters: int
+    kernel: Tuple[int, int] = (3, 3)
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.filters, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False, dtype=self.dtype)(x)
+        return nn.relu(_norm(train)(x))
+
+
+class InceptionBlock(nn.Module):
+    """Mixed block: parallel 1x1 / 5x5 / double-3x3 / pool towers
+    concatenated on channels (Szegedy et al. 2015, fig. 5-7 shapes)."""
+    b1x1: int
+    b5x5: Tuple[int, int]
+    b3x3dbl: Tuple[int, int]
+    pool: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(ConvBN, dtype=self.dtype)
+        t1 = conv(self.b1x1, (1, 1))(x, train)
+        t2 = conv(self.b5x5[0], (1, 1))(x, train)
+        t2 = conv(self.b5x5[1], (5, 5))(t2, train)
+        t3 = conv(self.b3x3dbl[0], (1, 1))(x, train)
+        t3 = conv(self.b3x3dbl[1], (3, 3))(t3, train)
+        t3 = conv(self.b3x3dbl[1], (3, 3))(t3, train)
+        t4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        t4 = conv(self.pool, (1, 1))(t4, train)
+        return jnp.concatenate([t1, t2, t3, t4], axis=-1)
+
+
+class InceptionReduction(nn.Module):
+    """Grid-size reduction block: strided 3x3 + double-3x3 + max-pool."""
+    b3x3: int
+    b3x3dbl: Tuple[int, int]
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(ConvBN, dtype=self.dtype)
+        t1 = conv(self.b3x3, (3, 3), (2, 2), "VALID")(x, train)
+        t2 = conv(self.b3x3dbl[0], (1, 1))(x, train)
+        t2 = conv(self.b3x3dbl[1], (3, 3))(t2, train)
+        t2 = conv(self.b3x3dbl[1], (3, 3), (2, 2), "VALID")(t2, train)
+        t3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([t1, t2, t3], axis=-1)
+
+
+class Inception(nn.Module):
+    """InceptionV3-shaped network (stem + 3 stages of mixed blocks with two
+    reductions). Channel counts follow the V3 paper's A/B/C stages; the
+    width multiplier scales everything for the Tiny test config."""
+    num_classes: int = 1000
+    width: float = 1.0
+    blocks_per_stage: Sequence[int] = (3, 4, 2)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        w = lambda f: max(8, int(f * self.width))  # noqa: E731
+        conv = partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # stem: 299x299 -> 35x35
+        x = conv(w(32), (3, 3), (2, 2), "VALID")(x, train)
+        x = conv(w(32), (3, 3), padding="VALID")(x, train)
+        x = conv(w(64), (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = conv(w(80), (1, 1))(x, train)
+        x = conv(w(192), (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        blk = partial(InceptionBlock, dtype=self.dtype)
+        for _ in range(self.blocks_per_stage[0]):
+            x = blk(w(64), (w(48), w(64)), (w(64), w(96)), w(64))(x, train)
+        x = InceptionReduction(w(384), (w(64), w(96)), dtype=self.dtype)(x, train)
+        for _ in range(self.blocks_per_stage[1]):
+            x = blk(w(192), (w(128), w(192)), (w(128), w(192)), w(192))(x, train)
+        x = InceptionReduction(w(320), (w(192), w(192)), dtype=self.dtype)(x, train)
+        for _ in range(self.blocks_per_stage[2]):
+            x = blk(w(320), (w(384), w(384)), (w(448), w(384)), w(192))(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+InceptionV3 = partial(Inception)
+InceptionTiny = partial(Inception, width=0.05, blocks_per_stage=(1, 1, 1))
+
+
+# ------------------------------------------------------------------ DenseNet
+
+
+class DenseLayer(nn.Module):
+    growth_rate: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = nn.relu(_norm(train)(x))
+        y = nn.Conv(4 * self.growth_rate, (1, 1), use_bias=False,
+                    dtype=self.dtype)(y)
+        y = nn.relu(_norm(train)(y))
+        y = nn.Conv(self.growth_rate, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(y)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class DenseNet(nn.Module):
+    """DenseNet (Huang et al. 2017): dense blocks with channel-concat
+    growth, 0.5-compression transitions."""
+    stage_sizes: Sequence[int] = (6, 12, 24, 16)  # DenseNet-121
+    growth_rate: int = 32
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(2 * self.growth_rate, (7, 7), (2, 2),
+                    padding=[(3, 3), (3, 3)], use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.relu(_norm(train)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n in enumerate(self.stage_sizes):
+            for _ in range(n):
+                x = DenseLayer(self.growth_rate, dtype=self.dtype)(x, train)
+            if i != len(self.stage_sizes) - 1:
+                x = nn.relu(_norm(train)(x))
+                x = nn.Conv(x.shape[-1] // 2, (1, 1), use_bias=False,
+                            dtype=self.dtype)(x)
+                x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(_norm(train)(x))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+DenseNet121 = partial(DenseNet)
+DenseNetTiny = partial(DenseNet, stage_sizes=(2, 2), growth_rate=8)

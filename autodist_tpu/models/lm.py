@@ -76,6 +76,10 @@ class TransformerLM(nn.Module):
 def make_train_setup(config: Optional[LMConfig] = None, seq_len: int = 128,
                      batch_size: int = 32, seed: int = 0):
     cfg = config or LMConfig()
+    if seq_len > cfg.max_seq_len:
+        # out-of-range position lookups would silently NaN (jnp.take fills)
+        raise ValueError("seq_len %d exceeds config.max_seq_len %d"
+                         % (seq_len, cfg.max_seq_len))
     model = TransformerLM(cfg)
     rng = jax.random.PRNGKey(seed)
     variables = model.init(rng, jnp.zeros((1, seq_len), jnp.int32))
@@ -107,6 +111,9 @@ def make_sp_train_setup(config: Optional[LMConfig] = None, seq_len: int = 128,
     from autodist_tpu.parallel import sequence
 
     cfg = config or LMConfig()
+    if seq_len > cfg.max_seq_len:
+        raise ValueError("seq_len %d exceeds config.max_seq_len %d"
+                         % (seq_len, cfg.max_seq_len))
     attn_fn = make_attn_fn(attention, const.SEQUENCE_AXIS, causal=True)
     model = TransformerLM(cfg, attn_fn=None, seq_parallel=True)  # init w/o axis
     rng = jax.random.PRNGKey(seed)

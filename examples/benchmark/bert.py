@@ -6,6 +6,12 @@ sequences, samples/sec metric.
 
   python examples/benchmark/bert.py --config base --autodist_strategy Parallax
 """
+
+if __package__ in (None, ""):  # direct invocation: put the repo root on sys.path
+    import os as _os
+    import sys as _sys
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+        _os.path.dirname(_os.path.abspath(__file__)))))
 import argparse
 
 import optax

@@ -1,13 +1,19 @@
 """ImageNet CNN benchmark harness.
 
 Mirror of reference ``examples/benchmark/imagenet.py``: model selected by
-``--model`` (resnet50/resnet101/resnet18), strategy by
+``--model`` (resnet18/50/101, vgg16, inceptionv3, densenet121), strategy by
 ``--autodist_strategy`` (``:160-182``), per-model all-reduce chunk sizes
 (``:150-158``), examples/sec logging. Synthetic ImageNet-shaped data.
 
   python examples/benchmark/imagenet.py --model resnet50 \
       --autodist_strategy AllReduce --batch_size 64 --steps 200
 """
+
+if __package__ in (None, ""):  # direct invocation: put the repo root on sys.path
+    import os as _os
+    import sys as _sys
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+        _os.path.dirname(_os.path.abspath(__file__)))))
 import argparse
 
 import jax.numpy as jnp
@@ -16,14 +22,17 @@ import optax
 
 import autodist_tpu as adt
 from autodist_tpu import strategy as S
-from autodist_tpu.models import resnet
+from autodist_tpu import models
 from examples.benchmark.utils.logs import BenchmarkLogger, ExamplesPerSecondHook
 
-# per-model chunk sizes, as tuned in the reference (imagenet.py:150-158)
-CHUNK_SIZES = {"resnet101": 200, "resnet50": 200, "resnet18": 512}
+# per-model chunk sizes, as tuned in the reference (imagenet.py:150-158:
+# vgg16=25, resnet101=200, inceptionv3=30, else 512)
+CHUNK_SIZES = {"resnet101": 200, "vgg16": 25, "inceptionv3": 30}
 
-MODELS = {"resnet18": resnet.ResNet18, "resnet50": resnet.ResNet50,
-          "resnet101": resnet.ResNet101}
+# ImageNet-shaped entries of the shared model registry (which also holds
+# bert/lm/ncf); per-model defaults like inceptionv3's 299px live there
+MODELS = ("resnet18", "resnet50", "resnet101", "vgg16", "inceptionv3",
+          "densenet121")
 
 
 def make_builder(name: str, chunk: int):
@@ -43,19 +52,22 @@ def main():
     p.add_argument("--model", default="resnet50", choices=sorted(MODELS))
     p.add_argument("--autodist_strategy", default="AllReduce")
     p.add_argument("--batch_size", type=int, default=64)
-    p.add_argument("--image_size", type=int, default=224)
+    p.add_argument("--image_size", type=int, default=None,
+                   help="default 224 (299 for inceptionv3)")
     p.add_argument("--steps", type=int, default=200)
     p.add_argument("--resource_spec", default=None)
-    p.add_argument("--bf16", action="store_true", default=True)
+    p.add_argument("--bf16", action=argparse.BooleanOptionalAction,
+                   default=True, help="bfloat16 compute (--no-bf16 for f32)")
     args = p.parse_args()
 
     chunk = CHUNK_SIZES.get(args.model, 512)
     ad = adt.AutoDist(resource_spec_file=args.resource_spec,
                       strategy_builder=make_builder(args.autodist_strategy, chunk))
-    loss_fn, params, batch, _ = resnet.make_train_setup(
-        MODELS[args.model], image_size=args.image_size,
-        batch_size=args.batch_size,
-        dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+    kw = dict(batch_size=args.batch_size,
+              dtype=jnp.bfloat16 if args.bf16 else jnp.float32)
+    if args.image_size is not None:
+        kw["image_size"] = args.image_size
+    loss_fn, params, batch, _ = models.make_train_setup(args.model, **kw)
     step = ad.function(loss_fn, optimizer=optax.sgd(0.1, momentum=0.9),
                        params=params)
     hook = ExamplesPerSecondHook(args.batch_size, every_n_steps=20,

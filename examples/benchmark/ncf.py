@@ -4,6 +4,12 @@ Mirror of reference ``examples/benchmark/ncf.py`` (MovieLens NeuMF):
 synthetic interactions, examples/sec metric; the four embedding tables
 stress the sparse/PS path.
 """
+
+if __package__ in (None, ""):  # direct invocation: put the repo root on sys.path
+    import os as _os
+    import sys as _sys
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+        _os.path.dirname(_os.path.abspath(__file__)))))
 import argparse
 
 import optax

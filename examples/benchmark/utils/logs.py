@@ -35,7 +35,14 @@ class ExamplesPerSecondHook:
 
     @property
     def average(self):
-        return sum(self.history) / len(self.history) if self.history else 0.0
+        if self.history:
+            return sum(self.history) / len(self.history)
+        # run shorter than one window: rate over whatever completed
+        # (excluding the first, compile-bearing step)
+        if self._t0 is not None and self._step > self._step0:
+            dt = time.perf_counter() - self._t0
+            return (self._step - self._step0) * self.batch_size / dt
+        return 0.0
 
 
 class BenchmarkLogger:

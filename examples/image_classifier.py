@@ -1,4 +1,9 @@
 """Tiny image classifier example (mirror of reference examples/image_classifier.py)."""
+
+if __package__ in (None, ""):  # direct invocation: put the repo root on sys.path
+    import os as _os
+    import sys as _sys
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 import jax.numpy as jnp
 import numpy as np
 import optax

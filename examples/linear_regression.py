@@ -6,6 +6,11 @@ with a resource spec, (2) wrapping the step with ``ad.function``, (3)
 feeding host batches. Run: ``python examples/linear_regression.py
 [resource_spec.yml]``.
 """
+
+if __package__ in (None, ""):  # direct invocation: put the repo root on sys.path
+    import os as _os
+    import sys as _sys
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 import sys
 
 import jax.numpy as jnp

@@ -5,7 +5,14 @@ batch x num_replicas x log_frequency / elapsed): a causal transformer LM on
 synthetic 1B-word-shaped data under PartitionedPS (the reference's lm1b
 config per BASELINE.md).
 """
+
+if __package__ in (None, ""):  # direct invocation: put the repo root on sys.path
+    import os as _os
+    import sys as _sys
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+        _os.path.dirname(_os.path.abspath(__file__)))))
 import argparse
+import dataclasses
 import time
 
 import optax
@@ -27,6 +34,8 @@ def main():
 
     cfg = {"tiny": lm.LMConfig.tiny, "default": lm.LMConfig,
            "lm1b": lm.LMConfig.lm1b}[args.config]()
+    if cfg.max_seq_len < args.seq_len:
+        cfg = dataclasses.replace(cfg, max_seq_len=args.seq_len)
     ad = adt.AutoDist(resource_spec_file=args.resource_spec,
                       strategy_builder=S.PartitionedPS())
     loss_fn, params, batch, _ = lm.make_train_setup(
@@ -34,13 +43,21 @@ def main():
     step = ad.function(loss_fn, optimizer=optax.adam(1e-3), params=params)
 
     t0, words = time.perf_counter(), 0
+    run_t0, run_words, m = None, 0, {"loss": float("nan")}
     for i in range(args.steps):
         m = step(batch)
         words += args.batch_size * args.seq_len
+        if run_t0 is None:
+            run_t0 = time.perf_counter()  # post-compile clock for the summary
+        else:
+            run_words += args.batch_size * args.seq_len
         if (i + 1) % args.log_frequency == 0:
             dt = time.perf_counter() - t0
             print("step %d loss %.4f wps %.1f" % (i + 1, m["loss"], words / dt))
             t0, words = time.perf_counter(), 0
+    wps = run_words / (time.perf_counter() - run_t0) if run_words else 0.0
+    print("lm1b done: %d steps, final loss %.4f, %.1f words/sec"
+          % (args.steps, m["loss"], wps))
 
 
 if __name__ == "__main__":

@@ -5,6 +5,11 @@ under PartitionedPS, ``:12,22-41``): mean-pooled word embeddings + dense
 head; the vocabulary table is sharded across parameter servers.
 Synthetic data (the reference downloads IMDB).
 """
+
+if __package__ in (None, ""):  # direct invocation: put the repo root on sys.path
+    import os as _os
+    import sys as _sys
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 import numpy as np

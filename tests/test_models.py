@@ -13,11 +13,20 @@ import pytest
 import autodist_tpu
 from autodist_tpu import strategy as S
 from autodist_tpu.model_item import ModelItem
-from autodist_tpu.models import bert, lm, ncf, resnet
+from autodist_tpu.models import bert, cnn, lm, ncf, resnet
 
 CASES = [
     ("resnet_tiny_ar", lambda: resnet.make_train_setup(
         resnet.ResNetTiny, num_classes=10, image_size=32, batch_size=16,
+        dtype=jnp.float32), S.AllReduce),
+    ("vgg_tiny_ar", lambda: resnet.make_train_setup(
+        cnn.VGGTiny, num_classes=10, image_size=32, batch_size=16,
+        dtype=jnp.float32), S.AllReduce),
+    ("inception_tiny_ps", lambda: resnet.make_train_setup(
+        cnn.InceptionTiny, num_classes=10, image_size=75, batch_size=16,
+        dtype=jnp.float32), S.PSLoadBalancing),
+    ("densenet_tiny_ar", lambda: resnet.make_train_setup(
+        cnn.DenseNetTiny, num_classes=10, image_size=32, batch_size=16,
         dtype=jnp.float32), S.AllReduce),
     ("bert_tiny_parallax", lambda: bert.make_train_setup(
         bert.BertConfig.tiny(), seq_len=32, batch_size=16), S.Parallax),
