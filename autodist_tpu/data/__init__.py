@@ -1,0 +1,18 @@
+"""Input pipeline: native record loading + device prefetch.
+
+The TPU-native replacement for the input-pipeline surface the reference
+borrows from TensorFlow's C++ runtime (tf.data iterators feeding the
+session's feed_dict through the Remapper). Two pieces:
+
+- ``RecordFileWriter`` / ``RecordFileDataset`` — fixed-shape binary record
+  files read by the native C++ loader (``native/dataloader/``): mmap'd IO,
+  per-epoch shuffling, and batch assembly on C++ threads that never touch
+  the GIL, delivering zero-copy numpy views.
+- ``DevicePrefetcher`` — wraps any host-batch iterator and keeps the next
+  batches' host->device transfers in flight (through the Remapper's
+  sharded placement) while the current step computes.
+"""
+from autodist_tpu.data.record_dataset import RecordFileDataset, RecordFileWriter
+from autodist_tpu.data.prefetch import DevicePrefetcher
+
+__all__ = ["RecordFileDataset", "RecordFileWriter", "DevicePrefetcher"]

@@ -1,0 +1,222 @@
+"""Fixed-shape record files + the ctypes binding to the native loader.
+
+Format "ADT1" (see ``native/dataloader/dataloader.cc``): a 20-byte header
+(magic, n_records, record_bytes) followed by packed fixed-size records; a
+``<path>.json`` sidecar describes the per-record field layout (name, dtype,
+shape) so batches slice into a dict of numpy arrays with zero copies.
+"""
+import ctypes
+import json
+import os
+import struct
+import subprocess
+from typing import Dict, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+from autodist_tpu.utils import logging
+
+# native sources live inside the package so installed copies can build too
+_NATIVE_DIR = os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "native")
+_LIB = os.path.join(_NATIVE_DIR, "build", "libadt_dataloader.so")
+
+_MAGIC = b"ADT1"
+_HEADER = struct.Struct("<4sQQ")
+
+
+def build_library(force: bool = False) -> str:
+    """Compile the native loader with make (cached), mirroring
+    runtime/coordination.py's build-on-demand pattern."""
+    src = os.path.join(_NATIVE_DIR, "dataloader", "dataloader.cc")
+    if not force and os.path.exists(_LIB) and \
+            os.path.getmtime(_LIB) >= os.path.getmtime(src):
+        return _LIB
+    logging.info("building native dataloader (%s)", src)
+    subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                   capture_output=True)
+    return _LIB
+
+
+_DLL = None
+
+
+def _dll():
+    global _DLL
+    if _DLL is None:
+        dll = ctypes.CDLL(build_library())
+        dll.adl_open.restype = ctypes.c_void_p
+        dll.adl_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                 ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
+                                 ctypes.c_uint64]
+        dll.adl_next_batch.restype = ctypes.POINTER(ctypes.c_uint8)
+        dll.adl_next_batch.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_uint64)]
+        dll.adl_release_batch.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        dll.adl_close.argtypes = [ctypes.c_void_p]
+        for f in (dll.adl_record_bytes, dll.adl_num_records,
+                  dll.adl_batches_per_epoch):
+            f.restype = ctypes.c_uint64
+            f.argtypes = [ctypes.c_void_p]
+        _DLL = dll
+    return _DLL
+
+
+class _Field:
+    def __init__(self, name: str, dtype, shape: Sequence[int]):
+        self.name = name
+        self.dtype = np.dtype(dtype)
+        self.shape = tuple(int(s) for s in shape)
+        self.nbytes = int(self.dtype.itemsize * np.prod(self.shape or (1,)))
+
+    def to_dict(self):
+        return {"name": self.name, "dtype": self.dtype.str,
+                "shape": list(self.shape)}
+
+
+class RecordFileWriter:
+    """Writes an ADT1 record file from dicts of fixed-shape arrays.
+
+    >>> with RecordFileWriter("/tmp/train.adt",
+    ...         fields=[("image", np.float32, (32, 32, 3)),
+    ...                 ("label", np.int32, ())]) as w:
+    ...     for image, label in samples:
+    ...         w.write({"image": image, "label": label})
+    """
+
+    def __init__(self, path: str, fields: Sequence[Tuple]):
+        self.path = path
+        self.fields = [_Field(*f) for f in fields]
+        self.record_bytes = sum(f.nbytes for f in self.fields)
+        self._n = 0
+        self._f = open(path, "wb")
+        self._f.write(_HEADER.pack(_MAGIC, 0, self.record_bytes))
+
+    def write(self, sample: Dict[str, np.ndarray]):
+        buf = bytearray()
+        for f in self.fields:
+            # asarray, not ascontiguousarray: the latter promotes 0-d
+            # scalars to 1-d and would fail the shape check; tobytes()
+            # handles non-contiguous inputs itself
+            arr = np.asarray(sample[f.name], dtype=f.dtype)
+            if arr.shape != f.shape:
+                raise ValueError("field %r: shape %s != declared %s"
+                                 % (f.name, arr.shape, f.shape))
+            buf += arr.tobytes()
+        self._f.write(buf)
+        self._n += 1
+
+    def close(self):
+        if self._f is None:
+            return
+        self._f.seek(0)
+        self._f.write(_HEADER.pack(_MAGIC, self._n, self.record_bytes))
+        self._f.close()
+        self._f = None
+        with open(self.path + ".json", "w") as f:
+            json.dump({"fields": [fl.to_dict() for fl in self.fields],
+                       "n_records": self._n}, f, indent=1)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter may be shutting down
+            pass
+
+
+class RecordFileDataset:
+    """Infinite shuffled batch stream over an ADT1 file, assembled by the
+    native loader's worker threads.
+
+    Batches are dicts of numpy arrays ``[batch, *field_shape]``. By default
+    each batch owns its memory (one cheap memcpy out of the native ring
+    slot — safe to hold across steps and to hand to async device
+    transfers). ``copy=False`` yields zero-copy views into the ring slot,
+    valid only until the NEXT ``__next__`` call and only for consumers that
+    finish reading the buffer synchronously before advancing.
+    """
+
+    def __init__(self, path: str, batch_size: int, shuffle: bool = True,
+                 seed: int = 0, num_threads: int = 2, ring_slots: int = 4,
+                 copy: bool = True):
+        with open(path + ".json") as f:
+            meta = json.load(f)
+        self.fields = [_Field(d["name"], d["dtype"], d["shape"])
+                       for d in meta["fields"]]
+        self.batch_size = int(batch_size)
+        self._handle = _dll().adl_open(path.encode(), self.batch_size,
+                                       int(shuffle), seed, num_threads,
+                                       ring_slots)
+        if not self._handle:
+            raise ValueError("could not open record file %s" % path)
+        self.num_records = int(_dll().adl_num_records(self._handle))
+        self.batches_per_epoch = int(_dll().adl_batches_per_epoch(self._handle))
+        self.record_bytes = int(_dll().adl_record_bytes(self._handle))
+        self._copy = copy
+        self._pending: Optional[int] = None
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        return self
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        if self._handle is None:
+            raise ValueError("dataset is closed")
+        if self._pending is not None:
+            _dll().adl_release_batch(self._handle, self._pending)
+            self._pending = None
+        idx = ctypes.c_uint64()
+        ptr = _dll().adl_next_batch(self._handle, ctypes.byref(idx))
+        if not ptr:
+            raise StopIteration  # closed under our feet
+        self._pending = idx.value
+        flat = np.ctypeslib.as_array(
+            ptr, shape=(self.batch_size * self.record_bytes,))
+        batch, off = {}, 0
+        # records are packed [record0, record1, ...]; view as
+        # [batch, record_bytes] then slice each field's byte range
+        rows = flat.reshape(self.batch_size, self.record_bytes)
+        for f in self.fields:
+            raw = rows[:, off:off + f.nbytes]
+            if self._copy:
+                # a real owning copy — NOT ascontiguousarray, which is a
+                # no-op (aliasing view) when the slice is already contiguous
+                raw = raw.copy()
+            elif not raw.flags.c_contiguous:
+                # zero-copy mode still needs the gather a strided
+                # multi-field column requires before viewing as f.dtype
+                raw = np.ascontiguousarray(raw)
+            batch[f.name] = raw.view(f.dtype).reshape(
+                (self.batch_size,) + f.shape)
+            off += f.nbytes
+        if self._copy:
+            _dll().adl_release_batch(self._handle, self._pending)
+            self._pending = None
+        return batch
+
+    def close(self):
+        if self._handle is not None:
+            if self._pending is not None:
+                _dll().adl_release_batch(self._handle, self._pending)
+                self._pending = None
+            _dll().adl_close(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        # releases the native worker threads, mmap, and fd when the dataset
+        # is dropped without close() (e.g. notebook / per-experiment use)
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter may be shutting down
+            pass

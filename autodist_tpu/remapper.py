@@ -35,6 +35,11 @@ class Remapper:
         for a in self.batch_axes:
             self.num_replicas *= int(mesh.shape[a])
         self.seq_shards = mesh.shape[seq_axis] if seq_axis else 1
+        # device_put can only retarget arrays onto meshes this process fully
+        # owns; multi-process meshes must go through host_to_mesh
+        self._fully_addressable = all(
+            d.process_index == jax.process_index()
+            for d in np.asarray(mesh.devices).flat)
 
     # ------------------------------------------------------------------ feed
 
@@ -43,24 +48,38 @@ class Remapper:
         return host_to_mesh(self.mesh, value, pspec)
 
     def remap_feed(self, batch) -> Any:
-        """Split the global batch across replicas along dim 0."""
+        """Split the global batch across replicas along dim 0. Leaves that
+        are already mesh-placed with the right sharding (e.g. by
+        ``data.DevicePrefetcher``) pass through untouched — re-placing
+        would round-trip them through the host."""
         def place(leaf):
-            arr = np.asarray(leaf)
-            if arr.ndim == 0:
-                return self._place(arr, P())
-            if arr.shape[0] % self.num_replicas != 0:
-                raise ValueError(
-                    "global batch dim %d is not divisible by the %d "
-                    "replicas; pad or resize the batch (TPU programs "
-                    "need static, even shards)" % (arr.shape[0],
-                                                   self.num_replicas))
-            if self.seq_axis and arr.ndim >= 2:
-                if arr.shape[1] % self.seq_shards != 0:
+            shape = np.shape(leaf)
+            if len(shape) == 0:
+                spec = P()
+            else:
+                if shape[0] % self.num_replicas != 0:
                     raise ValueError(
-                        "sequence dim %d is not divisible by the %d "
-                        "sequence shards" % (arr.shape[1], self.seq_shards))
-                return self._place(arr, P(self.batch_axes, self.seq_axis))
-            return self._place(arr, P(self.batch_axes))
+                        "global batch dim %d is not divisible by the %d "
+                        "replicas; pad or resize the batch (TPU programs "
+                        "need static, even shards)" % (shape[0],
+                                                       self.num_replicas))
+                if self.seq_axis and len(shape) >= 2:
+                    if shape[1] % self.seq_shards != 0:
+                        raise ValueError(
+                            "sequence dim %d is not divisible by the %d "
+                            "sequence shards" % (shape[1], self.seq_shards))
+                    spec = P(self.batch_axes, self.seq_axis)
+                else:
+                    spec = P(self.batch_axes)
+            if isinstance(leaf, jax.Array):
+                want = NamedSharding(self.mesh, spec)
+                if leaf.sharding.is_equivalent_to(want, leaf.ndim):
+                    return leaf
+                if self._fully_addressable:
+                    return jax.device_put(leaf, want)
+                # multi-process: fall through to the host-global path
+                # (make_array_from_callback), which every process can run
+            return self._place(np.asarray(leaf), spec)
         return jax.tree_util.tree_map(place, batch)
 
     # ----------------------------------------------------------------- fetch

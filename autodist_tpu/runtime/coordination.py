@@ -24,8 +24,9 @@ from typing import List, Optional
 from autodist_tpu import const
 from autodist_tpu.utils import logging
 
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "native")
+# native sources live inside the package so installed copies can build too
+_NATIVE_DIR = os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "native")
 _BINARY = os.path.join(_NATIVE_DIR, "build", "coordination_service")
 
 
