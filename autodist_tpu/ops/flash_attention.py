@@ -44,6 +44,8 @@ def _interpret() -> bool:
 def _pick_block(seq: int, want: int) -> int:
     """Largest power-of-two block <= want that divides seq (0 if none >= 8)."""
     b = min(want, seq)
+    while b & (b - 1):
+        b &= b - 1  # round down to a power of two (seq == b could be odd)
     while b >= 8 and seq % b:
         b //= 2
     return b if b >= 8 else 0

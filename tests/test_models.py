@@ -48,6 +48,22 @@ def test_model_trains(name, setup, builder):
     autodist_tpu.reset()
 
 
+def test_lm_flash_attention_mode_matches_default():
+    """attention="flash" (interpreted on CPU) must train and agree with the
+    XLA path — the kernel is numerics-preserving, not an approximation."""
+    losses = {}
+    for mode in ("flash", "default"):
+        autodist_tpu.reset()
+        loss_fn, params, batch, _ = lm.make_train_setup(
+            lm.LMConfig.tiny(), seq_len=32, batch_size=8, attention=mode)
+        ad = autodist_tpu.AutoDist(strategy_builder=S.AllReduce())
+        step = ad.function(loss_fn, optimizer=optax.adam(1e-3), params=params)
+        losses[mode] = [float(step(batch)["loss"]) for _ in range(3)]
+    np.testing.assert_allclose(losses["flash"], losses["default"],
+                               rtol=1e-4, atol=1e-5)
+    assert losses["flash"][-1] < losses["flash"][0]
+
+
 def test_bert_embeddings_detected_sparse():
     loss_fn, params, batch, _ = bert.make_train_setup(
         bert.BertConfig.tiny(), seq_len=16, batch_size=8)
