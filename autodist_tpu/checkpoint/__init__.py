@@ -1,0 +1,6 @@
+"""Checkpoint layer (reference ``autodist/checkpoint/``)."""
+from autodist_tpu.checkpoint.saver import Saver
+from autodist_tpu.checkpoint.saved_model_builder import (SavedModelBuilder,
+                                                         export_for_serving)
+
+__all__ = ["Saver", "SavedModelBuilder", "export_for_serving"]

@@ -16,6 +16,7 @@ gate (reference ``autodist/autodist.py:40-41``).
 """
 import json
 import os
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -47,13 +48,22 @@ def _flat_to_tree(template, flat: Dict[str, np.ndarray]):
 
 
 class Saver:
-    """Save/restore distributed training state in the original layout."""
+    """Save/restore distributed training state in the original layout.
+
+    ``async_save=True`` moves the file writes to a background thread: the
+    collective gathers (which every process must join) still happen inside
+    ``save()``, but the host-side npz serialization — the slow part for
+    large models — overlaps subsequent training steps. At most one write is
+    in flight; a new ``save()`` joins the previous one first, and
+    ``wait()`` joins explicitly (call before reading ``latest()``)."""
 
     def __init__(self, directory: Optional[str] = None, max_to_keep: int = 5,
-                 chief_only: bool = True):
+                 chief_only: bool = True, async_save: bool = False):
         self.directory = directory or const.DEFAULT_CHECKPOINT_DIR
         self.max_to_keep = max_to_keep
         self.chief_only = chief_only
+        self.async_save = async_save
+        self._writer = None
         os.makedirs(self.directory, exist_ok=True)
 
     # ------------------------------------------------------------------ save
@@ -79,18 +89,48 @@ class Saver:
         if self.chief_only and not const.is_chief():
             return None
         path = os.path.join(self.directory, "ckpt-%d" % step)
-        np.savez(path + ".params.npz", **_tree_to_flat(params))
-        np.savez(path + ".opt.npz", **_tree_to_flat(opt_state_host))
-        sync_flat = _tree_to_flat(sync_state_host)
-        if sync_flat:
-            np.savez(path + ".sync.npz", **sync_flat)
         meta = {"step": step, "format": "autodist_tpu.v1",
                 "strategy_id": dstep.strategy.id}
-        with open(path + ".meta.json", "w") as f:
-            json.dump(meta, f)
-        self._gc()
-        logging.info("saved checkpoint %s (step %d)", path, step)
+
+        def write():
+            np.savez(path + ".params.npz", **_tree_to_flat(params))
+            np.savez(path + ".opt.npz", **_tree_to_flat(opt_state_host))
+            sync_flat = _tree_to_flat(sync_state_host)
+            if sync_flat:
+                np.savez(path + ".sync.npz", **sync_flat)
+            # meta last: a checkpoint only becomes visible to _own_metas /
+            # latest() once all its data files exist
+            with open(path + ".meta.json", "w") as f:
+                json.dump(meta, f)
+            self._gc()
+            logging.info("saved checkpoint %s (step %d)", path, step)
+
+        if not self.async_save:
+            write()
+            return path
+        self.wait()  # at most one write in flight
+
+        def write_capturing():
+            try:
+                write()
+            except BaseException as e:  # noqa: BLE001 — re-raised in wait()
+                self._writer_error = e
+
+        self._writer_error = None
+        self._writer = threading.Thread(target=write_capturing,
+                                        name="adt-ckpt-writer", daemon=False)
+        self._writer.start()
         return path
+
+    def wait(self):
+        """Join a pending async write; re-raises any error the writer hit —
+        a failed checkpoint must not look like a success."""
+        if self._writer is not None:
+            self._writer.join()
+            self._writer = None
+            err, self._writer_error = getattr(self, "_writer_error", None), None
+            if err is not None:
+                raise err
 
     _META_RE = __import__("re").compile(r"^ckpt-(\d+)\.meta\.json$")
 
@@ -118,6 +158,7 @@ class Saver:
     # --------------------------------------------------------------- restore
 
     def latest(self) -> Optional[str]:
+        self.wait()  # an in-flight async write must be visible to readers
         metas = self._own_metas()
         if not metas:
             return None
@@ -127,6 +168,7 @@ class Saver:
     def restore_params(self, params_template, path: Optional[str] = None):
         """Params pytree in the original layout — usable with or without the
         framework (the vanilla-restore property)."""
+        self.wait()  # the path from an async save() is valid only post-write
         path = path or self.latest()
         if path is None:
             raise FileNotFoundError("no checkpoint in %s" % self.directory)
@@ -135,6 +177,7 @@ class Saver:
 
     def restore(self, runner, path: Optional[str] = None) -> Tuple[Any, int]:
         """Restore a Runner's distributed state; returns (state, step)."""
+        self.wait()  # the path from an async save() is valid only post-write
         path = path or self.latest()
         if path is None:
             raise FileNotFoundError("no checkpoint in %s" % self.directory)

@@ -155,3 +155,38 @@ def test_saved_model_export(tmp_path):
     assert spec["optimizer_name"] == "sgd"
     flat = dict(np.load(os.path.join(out, "params.npz")))
     assert flat["emb"].shape == (16, 4)
+
+
+def test_async_save_equivalent_and_overlapping(tmp_path):
+    """async_save writes the same bytes as sync save; training continues
+    while the write is in flight; latest()/restore join the writer."""
+    params, loss_fn, batch = _problem()
+    opt = optax.adam(0.05)
+    ad = autodist_tpu.AutoDist(strategy_builder=S.PartitionedAR())
+    runner = ad.build(loss_fn, opt, params, batch)
+    runner.init(params)
+    for _ in range(3):
+        runner.run(batch)
+
+    sync_saver = Saver(directory=str(tmp_path / "sync"))
+    sync_saver.save(runner)
+    async_saver = Saver(directory=str(tmp_path / "async"), async_save=True)
+    async_saver.save(runner)
+    runner.run(batch)  # train while the write may still be in flight
+
+    a, b = sync_saver.latest(), async_saver.latest()  # latest() joins writer
+    assert a is not None and b is not None
+    fa, fb = dict(np.load(a + ".params.npz")), dict(np.load(b + ".params.npz"))
+    assert sorted(fa) == sorted(fb)
+    for k in fa:
+        np.testing.assert_array_equal(fa[k], fb[k])
+
+    # restore from the async checkpoint resumes at the saved step
+    state, step = async_saver.restore(runner)
+    assert step == 3
+    # back-to-back async saves serialize (at most one writer in flight)
+    async_saver.save(runner, step=100)
+    async_saver.save(runner, step=101)
+    async_saver.wait()
+    steps = [s for s, _ in async_saver._own_metas()]
+    assert 100 in steps and 101 in steps
