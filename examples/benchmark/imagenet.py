@@ -58,6 +58,9 @@ def main():
     p.add_argument("--resource_spec", default=None)
     p.add_argument("--bf16", action=argparse.BooleanOptionalAction,
                    default=True, help="bfloat16 compute (--no-bf16 for f32)")
+    p.add_argument("--lr", type=float, default=None,
+                   help="SGD lr (default 0.1; 0.01 for vgg16, whose "
+                        "flatten-head gradients diverge at 0.1 from scratch)")
     args = p.parse_args()
 
     chunk = CHUNK_SIZES.get(args.model, 512)
@@ -68,10 +71,22 @@ def main():
     if args.image_size is not None:
         kw["image_size"] = args.image_size
     loss_fn, params, batch, _ = models.make_train_setup(args.model, **kw)
-    step = ad.function(loss_fn, optimizer=optax.sgd(0.1, momentum=0.9),
-                       params=params)
+    lr = args.lr if args.lr is not None else (0.01 if args.model == "vgg16"
+                                              else 0.1)
+    # clip: from-scratch CNNs at benchmark lrs throw early gradient spikes
+    # (vgg16's flatten head especially); clipping keeps every model finite
+    opt = optax.chain(optax.clip_by_global_norm(1.0),
+                      optax.sgd(lr, momentum=0.9))
+    # chains bypass the optimizer-capture patch; register so the serialized
+    # strategy still records what optimizer trained it
+    from autodist_tpu import patch
+    patch.register_optimizer(opt, "sgd",
+                             {"learning_rate": lr, "momentum": 0.9,
+                              "clip_global_norm": 1.0})
+    step = ad.function(loss_fn, optimizer=opt, params=params)
     hook = ExamplesPerSecondHook(args.batch_size, every_n_steps=20,
                                  name=args.model)
+    m = {"loss": float("nan")}
     for i in range(args.steps):
         m = step(batch)
         hook.after_step()
