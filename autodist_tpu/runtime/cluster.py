@@ -35,9 +35,13 @@ class Cluster:
     """Process layout + lifecycle for one training job."""
 
     def __init__(self, resource_spec: ResourceSpec,
-                 coordinator_port: int = const.DEFAULT_COORDINATOR_PORT):
+                 coordinator_port: int = const.DEFAULT_COORDINATOR_PORT,
+                 coordsvc_port: int = const.DEFAULT_COORDSVC_PORT):
         self._spec = resource_spec
         self._port = coordinator_port
+        # single source of truth for the native coordination-service port
+        # (server bring-up here, watchdog client in the Coordinator)
+        self.coordsvc_port = coordsvc_port
         # deterministic: chief first, then remaining addresses sorted
         others = [a for a in resource_spec.node_addresses if a != resource_spec.chief]
         self._process_addresses: List[str] = [resource_spec.chief] + others
@@ -88,7 +92,7 @@ class Cluster:
         if const.is_chief() and not const.ENV.ADT_DEBUG_REMOTE.val:
             from autodist_tpu.runtime.coordination import CoordinationServer
             try:
-                self._coordsvc = CoordinationServer(const.DEFAULT_COORDSVC_PORT)
+                self._coordsvc = CoordinationServer(self.coordsvc_port)
                 self._coordsvc.start()
                 atexit.register(self._coordsvc.stop)
             except (RuntimeError, TimeoutError, OSError,

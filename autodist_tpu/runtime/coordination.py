@@ -51,7 +51,12 @@ class CoordinationServer:
 
     def start(self, wait: float = 5.0):
         binary = build_binary()
+        # detach stdio: the service must not hold the parent's pipes open
+        # (a captured-output parent would block on EOF after the chief's
+        # own exit, since the service can outlive it)
         self._proc = subprocess.Popen([binary, str(self.port)],
+                                      stdin=subprocess.DEVNULL,
+                                      stdout=subprocess.DEVNULL,
                                       stderr=subprocess.DEVNULL)
         deadline = time.time() + wait
         while time.time() < deadline:

@@ -26,6 +26,8 @@ class Coordinator:
         self._cluster = cluster
         self._threads: List[threading.Thread] = []
         self._heartbeat_timeout = heartbeat_timeout
+        # the cluster owns the service port (it starts the server)
+        self._coordsvc_port = cluster.coordsvc_port
         self._stop_watchdog = threading.Event()
         atexit.register(self.join)
 
@@ -38,9 +40,11 @@ class Coordinator:
         def watch():
             import time as _time
             try:
-                client = CoordinationClient("127.0.0.1",
-                                            const.DEFAULT_COORDSVC_PORT)
-            except OSError:
+                client = CoordinationClient("127.0.0.1", self._coordsvc_port)
+            except OSError as e:
+                logging.warning("watchdog: coordination service unreachable "
+                                "on port %d (%s) — heartbeat supervision "
+                                "disabled", self._coordsvc_port, e)
                 return
             while not self._stop_watchdog.wait(self._heartbeat_timeout / 4):
                 try:
@@ -95,7 +99,14 @@ class Coordinator:
         t.start()
         self._threads.append(t)
 
+    def stop_watchdog(self):
+        """End heartbeat supervision — call when the job finishes cleanly,
+        BEFORE workers stop heartbeating, or the watchdog reads their normal
+        exit as a failure and aborts a successful run."""
+        self._stop_watchdog.set()
+
     def join(self):
+        self.stop_watchdog()
         for t in self._threads:
             if t is not threading.current_thread() and t.is_alive():
                 t.join(timeout=5)

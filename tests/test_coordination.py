@@ -91,3 +91,55 @@ def test_heartbeat_dead_detection(server):
     dead = c.dead_workers(0.1)
     assert "w0" in dead
     c.close()
+
+
+def test_coordinator_watchdog_fail_fast(tmp_path):
+    """A worker that stops heartbeating kills the chief process (the
+    reference's fail-fast supervision, coordinator.py:98-110). Run in a
+    subprocess because the watchdog aborts via os._exit(1)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "watchdog_host.py"
+    script.write_text("""
+import sys, time
+PORT = %d
+from autodist_tpu.runtime.coordination import CoordinationServer, CoordinationClient
+from autodist_tpu.runtime.coordinator import Coordinator
+from autodist_tpu.runtime.cluster import Cluster
+from autodist_tpu.resource_spec import ResourceSpec
+
+srv = CoordinationServer(PORT)
+srv.start()
+client = CoordinationClient("127.0.0.1", PORT)
+client.heartbeat("w1")
+
+class _S:
+    id = "watchdog-test"
+
+spec = ResourceSpec.from_dict(
+    {"nodes": [{"address": "127.0.0.1", "chief": True, "cpus": [0]}]})
+coord = Coordinator(_S(), Cluster(spec, coordsvc_port=PORT),
+                    heartbeat_timeout=1.0)
+coord.start_watchdog()
+print("WATCHDOG_UP", flush=True)
+time.sleep(12)  # w1 never heartbeats again; the watchdog must abort us
+print("STILL_ALIVE", flush=True)
+""" % port)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    try:
+        proc = subprocess.run([sys.executable, str(script)], env=env,
+                              capture_output=True, text=True, timeout=120)
+    finally:
+        # os._exit(1) (and any failure) orphans the service subprocess
+        subprocess.run(["pkill", "-f", "coordination_service %d" % port],
+                       check=False)
+    assert "WATCHDOG_UP" in proc.stdout
+    assert "STILL_ALIVE" not in proc.stdout, proc.stdout
+    assert proc.returncode == 1
