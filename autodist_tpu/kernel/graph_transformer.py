@@ -49,7 +49,8 @@ class DistributedStep:
     def __init__(self, *, mesh: Mesh, step_fn: Callable, layouts: Dict[str, VarLayout],
                  layout_tree, strategy: Strategy, model_item, mesh_axis: str,
                  sync_state_init: Callable, metadata: Optional[dict] = None,
-                 step_fn_nodonate: Optional[Callable] = None):
+                 step_fn_nodonate: Optional[Callable] = None,
+                 eval_fn: Optional[Callable] = None):
         self.mesh = mesh
         self.mesh_axis = mesh_axis
         self.all_axes = tuple(mesh.axis_names)
@@ -57,6 +58,7 @@ class DistributedStep:
         self.batch_axes = tuple(strategy.graph_config.batch_axes or (mesh_axis,))
         self._step_fn = step_fn
         self._step_fn_nodonate = step_fn_nodonate or step_fn
+        self._eval_fn = eval_fn
         self.layouts = layouts
         self._layout_tree = layout_tree
         self.strategy = strategy
@@ -71,6 +73,14 @@ class DistributedStep:
         pass ``donate=False``."""
         fn = self._step_fn if donate else self._step_fn_nodonate
         return fn(state, batch)
+
+    def evaluate(self, state: TrainState, batch):
+        """Forward-only metrics: no grads, no optimizer, no gradient
+        collectives — ~3x cheaper than a train step."""
+        if self._eval_fn is None:
+            _, metrics = self._step_fn_nodonate(state, batch)
+            return metrics
+        return self._eval_fn(state, batch)
 
     def snapshot_lowered(self, state: TrainState, batch):
         """Dump the transformed program's StableHLO (the reference's
@@ -421,6 +431,23 @@ class GraphTransformer:
                                        item.example_batch)
             metric_specs["aux"] = jax.tree_util.tree_map(lambda _: P(), loss_spec[1])
 
+        # forward-only metrics (Runner.evaluate): same param gather, no
+        # grad/optimizer/collective-sync cost
+        def local_eval(state: TrainState, batch):
+            full_params = _tree_map_layouts(
+                lambda leaf, lay: lay.gather_full(leaf), state.params,
+                layout_tree)
+            out = item.loss_fn(full_params, batch)
+            loss, aux = (out if has_aux else (out, None))
+            metrics = {"loss": jax.lax.pmean(loss, all_axes)}
+            if aux is not None:
+                metrics["aux"] = jax.tree_util.tree_map(
+                    lambda a: (jax.lax.pmean(a, all_axes)
+                               if jnp.issubdtype(jnp.asarray(a).dtype,
+                                                 jnp.inexact)
+                               else jax.lax.pmax(a, all_axes)), aux)
+            return metrics
+
         # check_vma=False: with the check on, differentiating w.r.t. a
         # replicated param auto-inserts a psum during transpose, which would
         # double-count with the synchronizers' explicit collectives — this
@@ -432,6 +459,10 @@ class GraphTransformer:
             out_specs=(state_specs, metric_specs), check_vma=False)
         step_fn = jax.jit(sharded, donate_argnums=(0,) if self._donate else ())
         step_fn_nodonate = jax.jit(sharded) if self._donate else step_fn
+        eval_fn = jax.jit(jax.shard_map(
+            local_eval, mesh=self._mesh,
+            in_specs=(state_specs, batch_specs),
+            out_specs=metric_specs, check_vma=False))
 
         ps_syncs = [s for s in syncs.values()
                     if s.__class__.__name__ == "PSSynchronizer"]
@@ -453,4 +484,4 @@ class GraphTransformer:
             mesh=self._mesh, step_fn=step_fn, step_fn_nodonate=step_fn_nodonate,
             layouts=layouts, layout_tree=layout_tree, strategy=self._strategy,
             model_item=item, mesh_axis=axis, sync_state_init=sync_state_init,
-            metadata=metadata)
+            metadata=metadata, eval_fn=eval_fn)

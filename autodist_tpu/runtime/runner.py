@@ -9,6 +9,7 @@ client). Step tracing (the reference's chrome-trace dump,
 ``runner.py:66-75,123-131``) maps to ``jax.profiler`` traces written under
 ``/tmp/autodist_tpu/traces``.
 """
+import itertools
 import os
 import time
 from typing import Any, Optional
@@ -106,6 +107,52 @@ class Runner:
     def gather_params(self):
         return self._dstep.gather_params(self.state)
 
+    # --------------------------------------------------- fit/evaluate facade
+
+    def fit(self, batches, steps: Optional[int] = None,
+            callbacks: Optional[list] = None) -> list:
+        """Train over an iterable of host batches (the reference's Keras
+        ``model.fit`` path, which its patch routed into the distributed
+        session — reference ``patch.py:96-197``). ``steps`` bounds infinite
+        iterables (e.g. RecordFileDataset) without consuming a batch past
+        the bound; ``callbacks`` are called as ``cb(step_index, metrics)``
+        after every step. Returns per-step metrics."""
+        history = []
+        bounded = batches if steps is None else itertools.islice(batches, steps)
+        for i, batch in enumerate(bounded):
+            metrics = self.run(batch)
+            history.append(metrics)
+            for cb in (callbacks or ()):
+                cb(i, metrics)
+        return history
+
+    def evaluate(self, batches, steps: Optional[int] = None) -> dict:
+        """Mean of the SCALAR metrics over an iterable of host batches,
+        without updating parameters (the reference's ``model.evaluate``).
+        Runs the forward-only compiled program — no grads, no optimizer, no
+        gradient collectives. Non-scalar metrics are skipped (warned once);
+        aggregate those from per-step ``run`` output instead."""
+        import numpy as np
+        if self.state is None:
+            raise RuntimeError("Runner.evaluate before init()")
+        totals, count, skipped = {}, 0, set()
+        bounded = batches if steps is None else itertools.islice(batches, steps)
+        for batch in bounded:
+            sharded = self._remapper.remap_feed(batch)
+            metrics = self._dstep.evaluate(self.state, sharded)
+            host = self._remapper.remap_fetch(metrics)
+            for k, v in host.items():
+                if np.ndim(v) == 0:
+                    totals[k] = totals.get(k, 0.0) + float(v)
+                elif k not in skipped:
+                    skipped.add(k)
+                    logging.warning("evaluate: skipping non-scalar metric "
+                                    "%r (shape %s)", k, np.shape(v))
+            count += 1
+        if count == 0:
+            return {}
+        return {k: v / count for k, v in totals.items()}
+
 
 class WrappedSession:
     """Thin session facade over Runner for reference-style ergonomics
@@ -117,6 +164,12 @@ class WrappedSession:
     def run(self, feed_dict=None, **kwargs):
         batch = feed_dict if feed_dict is not None else kwargs
         return self._runner.run(batch)
+
+    def fit(self, batches, steps=None, callbacks=None):
+        return self._runner.fit(batches, steps=steps, callbacks=callbacks)
+
+    def evaluate(self, batches, steps=None):
+        return self._runner.evaluate(batches, steps=steps)
 
     @property
     def state(self):

@@ -45,14 +45,51 @@ class ExamplesPerSecondHook:
         return 0.0
 
 
+def _emit(line, path=None):
+    print(line)
+    if path:
+        with open(path, "a") as f:
+            f.write(line + "\n")
+
+
 class BenchmarkLogger:
     def __init__(self, path=None):
         self.path = path
 
     def log(self, **record):
         record.setdefault("timestamp", time.time())
-        line = json.dumps(record, sort_keys=True)
-        print(line)
-        if self.path:
-            with open(self.path, "a") as f:
-                f.write(line + "\n")
+        _emit(json.dumps(record, sort_keys=True), self.path)
+
+
+class MLPerfLogger:
+    """MLPerf logging-spec lines (the reference vendors
+    ``utils/logs/mlperf_helper.py`` for the same purpose):
+    ``:::MLLOG {json}`` with ``time_ms``/``namespace``/``event_type``/
+    ``key``/``value``/``metadata`` fields, the format the ``mlperf_logging``
+    compliance checker parses."""
+
+    def __init__(self, benchmark: str, path=None, namespace: str = ""):
+        self.benchmark = benchmark
+        self.namespace = namespace
+        self.path = path
+
+    def event(self, key, value=None, event_type="POINT_IN_TIME", **metadata):
+        record = {
+            "namespace": self.namespace,
+            "time_ms": int(time.time() * 1000),
+            "event_type": event_type,
+            "key": key,
+            "value": value,
+            "metadata": metadata or None,
+        }
+        _emit(":::MLLOG " + json.dumps(record, sort_keys=True), self.path)
+
+    # common MLPerf keys as conveniences
+    def run_start(self, **md):
+        self.event("run_start", event_type="INTERVAL_START", **md)
+
+    def run_stop(self, status="success", **md):
+        self.event("run_stop", event_type="INTERVAL_END", status=status, **md)
+
+    def epoch(self, num, **md):
+        self.event("epoch_num", num, **md)

@@ -15,3 +15,47 @@ def test_reset_allows_new_instance():
     autodist_tpu.AutoDist()
     autodist_tpu.reset()
     autodist_tpu.AutoDist()  # no raise
+
+
+def test_runner_fit_and_evaluate():
+    """fit() trains over an iterable; evaluate() computes metrics without
+    touching parameters (the reference's model.fit/evaluate path, c7)."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    import autodist_tpu
+    from autodist_tpu import strategy as S
+
+    autodist_tpu.reset()
+    rng = np.random.RandomState(0)
+    W = rng.randn(4, 1).astype(np.float32)
+    params = {"w": jnp.zeros((4, 1))}
+    loss_fn = lambda p, b: jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)  # noqa: E731
+
+    def batches(n):
+        r = np.random.RandomState(1)
+        for _ in range(n):
+            x = r.randn(16, 4).astype(np.float32)
+            yield {"x": x, "y": x @ W}
+
+    ad = autodist_tpu.AutoDist(strategy_builder=S.AllReduce())
+    runner = ad.build(loss_fn, optax.sgd(0.2), params,
+                      next(iter(batches(1))))
+    runner.init(params)
+
+    seen = []
+    history = runner.fit(batches(40), callbacks=[lambda i, m: seen.append(i)])
+    assert len(history) == 40 and seen == list(range(40))
+    assert float(history[-1]["loss"]) < float(history[0]["loss"])
+
+    before = np.asarray(runner.gather_params()["w"]).copy()
+    ev = runner.evaluate(batches(5))
+    assert set(ev) == {"loss"} and np.isfinite(ev["loss"])
+    after = np.asarray(runner.gather_params()["w"])
+    np.testing.assert_array_equal(before, after)  # evaluate must not train
+
+    # steps bound on an infinite iterable
+    import itertools
+    h2 = runner.fit(itertools.cycle(batches(2)), steps=3)
+    assert len(h2) == 3
+    autodist_tpu.reset()
