@@ -61,6 +61,9 @@ class ENV(Enum):
     # start all processes simultaneously; switches the strategy handoff from
     # chief-writes-file-then-launches-workers to a collective broadcast
     ADT_EXTERNAL_LAUNCH = ("ADT_EXTERNAL_LAUNCH", bool, False)
+    # coordination-service port override (tests / colocated jobs); read at
+    # access time like every other ADT_* var, not frozen at import
+    ADT_COORDSVC_PORT = ("ADT_COORDSVC_PORT", int, DEFAULT_COORDSVC_PORT)
 
     @property
     def val(self):

@@ -36,12 +36,15 @@ class Cluster:
 
     def __init__(self, resource_spec: ResourceSpec,
                  coordinator_port: int = const.DEFAULT_COORDINATOR_PORT,
-                 coordsvc_port: int = const.DEFAULT_COORDSVC_PORT):
+                 coordsvc_port=None):
         self._spec = resource_spec
         self._port = coordinator_port
         # single source of truth for the native coordination-service port
-        # (server bring-up here, watchdog client in the Coordinator)
-        self.coordsvc_port = coordsvc_port
+        # (server bring-up here, watchdog client in the Coordinator);
+        # default resolved at construction so ADT_COORDSVC_PORT set after
+        # import still applies
+        self.coordsvc_port = (coordsvc_port if coordsvc_port is not None
+                              else const.ENV.ADT_COORDSVC_PORT.val)
         # deterministic: chief first, then remaining addresses sorted
         others = [a for a in resource_spec.node_addresses if a != resource_spec.chief]
         self._process_addresses: List[str] = [resource_spec.chief] + others

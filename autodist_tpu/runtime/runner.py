@@ -47,7 +47,7 @@ class Runner:
         host = (const.ENV.ADT_COORDINATOR_ADDR.val.split(":")[0]
                 or "127.0.0.1")
         try:
-            client = CoordinationClient(host, const.DEFAULT_COORDSVC_PORT)
+            client = CoordinationClient(host, const.ENV.ADT_COORDSVC_PORT.val)
             logging.info("staleness pacing active (window=%d) via %s",
                          self._staleness, host)
             return client

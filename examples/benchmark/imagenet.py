@@ -48,9 +48,9 @@ def make_builder(name: str, chunk: int):
 
 
 def _make_record_dataset(example_batch, args):
-    """Write a few batches of synthetic records once; return the native
-    loader's stream over them (reshuffled every epoch). The caller must
-    close() the dataset; the record file is unlinked on close."""
+    """Write a few batches of synthetic records once; return
+    (dataset, record_path). The caller unlinks path/path+'.json' when done
+    (~150-275 MB of synthetic images per run)."""
     import os
     import tempfile
     from autodist_tpu.data import RecordFileDataset, RecordFileWriter
@@ -63,18 +63,7 @@ def _make_record_dataset(example_batch, args):
         for _ in range(args.batch_size * 4):  # 4 batches, shuffled each epoch
             w.write({"image": rng.randn(*img_shape).astype(np.float32),
                      "label": np.int32(rng.randint(1000))})
-    ds = RecordFileDataset(path, args.batch_size, seed=0, num_threads=2)
-    inner_close = ds.close
-
-    def close_and_unlink():
-        inner_close()
-        for f in (path, path + ".json"):
-            try:
-                os.unlink(f)
-            except FileNotFoundError:
-                pass
-    ds.close = close_and_unlink  # ~150-275 MB of synthetic images per run
-    return ds
+    return RecordFileDataset(path, args.batch_size, seed=0, num_threads=2), path
 
 
 def main():
@@ -123,13 +112,22 @@ def main():
     if args.record_pipeline:
         # full input path: native loader threads -> device prefetcher ->
         # mesh-placed batches -> runner.fit
+        import os
         from autodist_tpu.data import DevicePrefetcher
         runner = ad.build(loss_fn, opt, params, batch)
         runner.init(params)
-        with _make_record_dataset(batch, args) as ds:
-            history = runner.fit(DevicePrefetcher(ds, runner, depth=2),
-                                 steps=args.steps,
-                                 callbacks=[lambda i, _m: hook.after_step()])
+        ds, record_path = _make_record_dataset(batch, args)
+        try:
+            with ds:
+                history = runner.fit(DevicePrefetcher(ds, runner, depth=2),
+                                     steps=args.steps,
+                                     callbacks=[lambda i, _m: hook.after_step()])
+        finally:
+            for f in (record_path, record_path + ".json"):
+                try:
+                    os.unlink(f)
+                except FileNotFoundError:
+                    pass
         if history:
             m = history[-1]
     else:

@@ -31,6 +31,9 @@ BUILDERS = {
     "PartitionedAR": lambda: S.PartitionedAR(),
     "PartitionedPS": lambda: S.PartitionedPS(),
     "Parallax": lambda: S.Parallax(),
+    # bounded staleness: exercises the Runner's cross-process pacing
+    # client against a live coordination service
+    "PSStale": lambda: S.PS(staleness=2),
 }
 
 

@@ -92,7 +92,10 @@ def _launch_pair(tmp_path, builder, n_steps=6, external=False):
         logs.append(log)
     for p, log in zip(procs, logs):
         assert p.returncode == 0, "process failed:\n%s" % log
-    return [json.loads(o.read_text()) for o in outs]
+    results = [json.loads(o.read_text()) for o in outs]
+    for r, log in zip(results, logs):
+        r["log"] = log
+    return results
 
 
 def _single_process_reference(builder, n_steps=6):
@@ -139,3 +142,37 @@ def test_two_process_training_matches_single_process(tmp_path, builder, external
 def test_two_process_extended_matrix(tmp_path, builder):
     chief, worker = _launch_pair(tmp_path, builder, external=True)
     _assert_pair_matches_reference(chief, worker, builder)
+
+
+def test_two_process_staleness_pacing(tmp_path):
+    """PS(staleness=2) across two real processes: the Runner's pacing
+    client reports steps/heartbeats to a live coordination service (the
+    reference's token-queue semantics, ps_synchronizer.py:388-458). The
+    parent hosts the service and asserts both workers reported all steps."""
+    from autodist_tpu.runtime.coordination import (CoordinationClient,
+                                                   CoordinationServer)
+    svc_port = _free_port()
+    srv = CoordinationServer(port=svc_port)
+    srv.start()
+    try:
+        old = os.environ.get("ADT_COORDSVC_PORT")
+        os.environ["ADT_COORDSVC_PORT"] = str(svc_port)
+        try:
+            chief, worker = _launch_pair(tmp_path, "PSStale", n_steps=5,
+                                         external=True)
+        finally:
+            if old is None:
+                os.environ.pop("ADT_COORDSVC_PORT", None)
+            else:
+                os.environ["ADT_COORDSVC_PORT"] = old
+        np.testing.assert_array_equal(chief["losses"], worker["losses"])
+        assert chief["losses"][-1] < chief["losses"][0]
+        # BOTH pacing clients connected (min_step alone can't distinguish
+        # one reporter from two) and every step was reported
+        for r in (chief, worker):
+            assert "staleness pacing active" in r["log"], r["log"][-2000:]
+        client = CoordinationClient("127.0.0.1", svc_port)
+        assert client.min_step() == 5
+        client.close()
+    finally:
+        srv.stop()
