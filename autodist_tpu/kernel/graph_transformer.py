@@ -348,7 +348,10 @@ class GraphTransformer:
             for b in (buckets if N > 1 else []):
                 bst = new_bucket_state.get(b.key)
                 bst_local = bst[0] if bst is not None else None
-                out, nst = collectives.bucket_reduce(b, g, bst_local, psum, N)
+                out, nst = collectives.bucket_reduce(
+                    b, g, bst_local, psum, N,
+                    ring_axis=(axis if len(all_axes) == 1 else None),
+                    ring_size=N)
                 synced.update(out)
                 if nst is not None:
                     new_bucket_state[b.key] = jnp.expand_dims(nst, 0)

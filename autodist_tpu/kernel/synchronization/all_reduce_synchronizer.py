@@ -29,6 +29,9 @@ class AllReduceSynchronizer(Synchronizer):
                          extra_axes)
         self.compressor = compressor_lib.create(
             getattr(config, "compressor", None), var_name)
+        # NOTE: int8 ring arming happens in bucket_reduce — every
+        # unpartitioned int8 var is concatable and routed into a bucket;
+        # this per-var compressor only serves the psum fallback paths
         self.group = getattr(config, "group", 0)
         self.spec = getattr(config, "spec", "AUTO")
         if (layout is not None and layout.partitioned

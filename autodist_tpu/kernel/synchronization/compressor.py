@@ -83,6 +83,62 @@ class HorovodCompressorEF(Compressor):
         return reduced, new_state
 
 
+class Int8Compressor(Compressor):
+    """Int8 wire format via an explicit quantized ring all-reduce (EQuARX
+    setting, arXiv 2506.17615): 4x less wire traffic than fp32, 2x less
+    than bf16. XLA cannot accumulate int8 collectives without overflow, so
+    the synchronizer/bucketing layer arms ``ring_axis``/``ring_size`` when
+    the reduction runs over a single mesh axis; otherwise the payload
+    falls back to bf16 psum (still 2x)."""
+
+    name = "Int8Compressor"
+
+    def __init__(self, var_name: str = ""):
+        super().__init__(var_name)
+        self.ring_axis = None   # armed by the lowering when eligible
+        self.ring_size = 1
+
+    def _ring(self, grad):
+        from autodist_tpu.parallel import collectives
+        flat = grad.reshape(-1).astype(jnp.float32)
+        out = collectives.int8_ring_all_reduce(flat, self.ring_axis,
+                                               self.ring_size)
+        return out.reshape(grad.shape).astype(grad.dtype)
+
+    def reduce(self, grad, state, psum):
+        if self.ring_axis is None or self.ring_size <= 1:
+            if grad.dtype in (jnp.float32, jnp.float64):
+                return psum(grad.astype(jnp.bfloat16)).astype(grad.dtype), state
+            return psum(grad), state
+        return self._ring(grad), state
+
+
+class Int8CompressorEF(Int8Compressor):
+    """Int8 ring all-reduce with error feedback: the local quantization
+    residual (what the wire could not represent of this replica's
+    compensated gradient) is carried to the next step, preserving the sum
+    of updates. When the ring is not armed (multi-axis reductions) this
+    degrades to exactly BF16CompressorEF — residual against the bf16 wire
+    value, no extra int8 noise."""
+
+    name = "Int8CompressorEF"
+
+    def state_init(self, grad_shape, dtype):
+        return jnp.zeros(grad_shape, dtype)
+
+    def reduce(self, grad, state, psum):
+        compensated = grad + state
+        if self.ring_axis is None or self.ring_size <= 1:
+            wire = compensated.astype(jnp.bfloat16)
+            new_state = compensated - wire.astype(grad.dtype)
+            return psum(wire).astype(grad.dtype), new_state
+        from autodist_tpu.parallel.collectives import _dequant_i8, _quant_i8
+        q, s = _quant_i8(compensated)
+        transmitted = _dequant_i8(q, s).astype(grad.dtype)
+        new_state = compensated - transmitted
+        return self._ring(transmitted), new_state
+
+
 class PowerSGDCompressor(Compressor):
     """Rank-r PowerSGD (arXiv 1905.13727) with error feedback and a
     warm-started Q factor. Communicates P (n x r) + Q (m x r) instead of the
@@ -137,7 +193,8 @@ class PowerSGDCompressor(Compressor):
 
 _REGISTRY: Dict[str, type] = {
     c.name: c for c in
-    (NoneCompressor, HorovodCompressor, HorovodCompressorEF, PowerSGDCompressor)
+    (NoneCompressor, HorovodCompressor, HorovodCompressorEF,
+     Int8Compressor, Int8CompressorEF, PowerSGDCompressor)
 }
 # TPU-flavored aliases
 _REGISTRY["BF16Compressor"] = HorovodCompressor

@@ -28,7 +28,8 @@ CHIP_PEAK_FLOPS = {
 DEFAULT_MXU_EFFICIENCY = 0.4      # achieved/peak for typical training steps
 WIRE_DTYPE_BYTES = 4              # gradients travel fp32 unless compressed
 COMPRESSED_BYTES = {"HorovodCompressor": 2, "HorovodCompressorEF": 2,
-                    "BF16Compressor": 2, "BF16CompressorEF": 2}
+                    "BF16Compressor": 2, "BF16CompressorEF": 2,
+                    "Int8Compressor": 1, "Int8CompressorEF": 1}
 PER_COLLECTIVE_LATENCY_S = 5e-6   # launch overhead per collective/bucket
 
 

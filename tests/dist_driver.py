@@ -34,6 +34,8 @@ BUILDERS = {
     # bounded staleness: exercises the Runner's cross-process pacing
     # client against a live coordination service
     "PSStale": lambda: S.PS(staleness=2),
+    # int8 quantized ring: ppermute hops cross the process boundary
+    "AllReduceInt8": lambda: S.AllReduce(compressor="Int8CompressorEF"),
 }
 
 

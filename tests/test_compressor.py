@@ -111,3 +111,82 @@ def test_powersgd_e2e_on_mesh():
     q = state.sync_state["var"]["w"]["q"]
     assert q.shape[-2:] == (4, 4)  # m x rank, warm-started across steps
     assert state.sync_state["var"]["w"]["error"].shape[-2:] == (16, 4)
+
+
+def test_int8_ring_all_reduce_matches_sum():
+    """The quantized ring produces bit-identical, ~1%-accurate sums."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from autodist_tpu.parallel.collectives import int8_ring_all_reduce
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("data",))
+    rng = np.random.RandomState(0)
+    L = 1000  # not divisible by 8 -> exercises padding
+    x = rng.randn(8, L).astype(np.float32)
+    out = jax.jit(jax.shard_map(
+        lambda xs: int8_ring_all_reduce(xs.reshape(-1), "data", 8),
+        mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+        check_vma=False))(x.reshape(8 * L))
+    got = np.asarray(out).reshape(8, L)
+    exact = x.sum(axis=0)
+    # SPMD invariant: every replica holds bit-identical reduced values
+    assert np.max(np.abs(got - got[0])) == 0.0
+    rel = np.abs(got[0] - exact) / (np.abs(exact) + 1e-6)
+    assert np.median(rel) < 0.03, np.median(rel)
+
+
+def test_int8_ef_trains_to_convergence():
+    """Int8CompressorEF through the full stack: error feedback recovers
+    what quantization drops, converging like the uncompressed path."""
+    import jax.numpy as jnp
+    import optax
+    import autodist_tpu
+    from autodist_tpu import strategy as S
+    rng = np.random.RandomState(0)
+    W = rng.randn(6, 2).astype(np.float32)
+    x = rng.randn(64, 6).astype(np.float32)
+    batch = {"x": x, "y": x @ W}
+    losses = {}
+    for comp in ("NoneCompressor", "Int8CompressorEF"):
+        autodist_tpu.reset()
+        params = {"w": jnp.zeros((6, 2))}
+        loss_fn = lambda p, b: jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)  # noqa: E731
+        ad = autodist_tpu.AutoDist(
+            strategy_builder=S.AllReduce(compressor=comp))
+        step = ad.function(loss_fn, optimizer=optax.sgd(0.2), params=params)
+        losses[comp] = [float(step(batch)["loss"]) for _ in range(80)]
+    assert losses["Int8CompressorEF"][-1] < 1e-4, losses["Int8CompressorEF"][-8:]
+    # EF keeps the compressed path within an order of magnitude of exact
+    assert losses["Int8CompressorEF"][-1] < max(10 * losses["NoneCompressor"][-1], 1e-4)
+
+
+def test_int8_resume_bitexact(tmp_path):
+    """EF residuals round-trip through checkpoints (sync_state)."""
+    import jax.numpy as jnp
+    import optax
+    import autodist_tpu
+    from autodist_tpu import strategy as S
+    from autodist_tpu.checkpoint import Saver
+    rng = np.random.RandomState(1)
+    params = {"w": jnp.asarray(rng.randn(8, 2) * 0.3, jnp.float32)}
+    loss_fn = lambda p, b: jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)  # noqa: E731
+    batch = {"x": rng.randn(16, 8).astype(np.float32),
+             "y": rng.randn(16, 2).astype(np.float32)}
+    autodist_tpu.reset()
+    ad = autodist_tpu.AutoDist(
+        strategy_builder=S.AllReduce(compressor="Int8CompressorEF"))
+    runner = ad.build(loss_fn, optax.sgd(0.1), params, batch)
+    runner.init(params)
+    for _ in range(3):
+        runner.run(batch)
+    saver = Saver(directory=str(tmp_path))
+    saver.save(runner)
+    for _ in range(2):
+        runner.run(batch)
+    a = runner.gather_params()
+    saver.restore(runner)
+    for _ in range(2):
+        runner.run(batch)
+    b = runner.gather_params()
+    np.testing.assert_array_equal(np.asarray(a["w"]), np.asarray(b["w"]))
