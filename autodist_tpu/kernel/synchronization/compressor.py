@@ -92,6 +92,7 @@ class Int8Compressor(Compressor):
     falls back to bf16 psum (still 2x)."""
 
     name = "Int8Compressor"
+    wire_dtype = jnp.bfloat16  # fallback wire when the ring is not armed
 
     def __init__(self, var_name: str = ""):
         super().__init__(var_name)
@@ -107,9 +108,7 @@ class Int8Compressor(Compressor):
 
     def reduce(self, grad, state, psum):
         if self.ring_axis is None or self.ring_size <= 1:
-            if grad.dtype in (jnp.float32, jnp.float64):
-                return psum(grad.astype(jnp.bfloat16)).astype(grad.dtype), state
-            return psum(grad), state
+            return HorovodCompressor.reduce(self, grad, state, psum)
         return self._ring(grad), state
 
 
@@ -127,11 +126,9 @@ class Int8CompressorEF(Int8Compressor):
         return jnp.zeros(grad_shape, dtype)
 
     def reduce(self, grad, state, psum):
-        compensated = grad + state
         if self.ring_axis is None or self.ring_size <= 1:
-            wire = compensated.astype(jnp.bfloat16)
-            new_state = compensated - wire.astype(grad.dtype)
-            return psum(wire).astype(grad.dtype), new_state
+            return HorovodCompressorEF.reduce(self, grad, state, psum)
+        compensated = grad + state
         from autodist_tpu.parallel.collectives import _dequant_i8, _quant_i8
         q, s = _quant_i8(compensated)
         transmitted = _dequant_i8(q, s).astype(grad.dtype)

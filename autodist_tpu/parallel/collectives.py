@@ -94,9 +94,14 @@ def bucket_reduce(bucket: Bucket, grads: Dict[str, jnp.ndarray], state, psum,
 
 
 def _quant_i8(c):
-    """Symmetric per-tensor int8 quantization: (q, scale)."""
-    scale = jnp.maximum(jnp.max(jnp.abs(c)), 1e-30) / 127.0
-    q = jnp.clip(jnp.round(c / scale), -127, 127).astype(jnp.int8)
+    """Symmetric per-tensor int8 quantization: (q, scale). A non-finite
+    input poisons the scale (NaN) so divergence propagates to the output
+    like every other reduction path, instead of being silently zeroed."""
+    absmax = jnp.max(jnp.abs(c))
+    scale = jnp.where(jnp.isfinite(absmax),
+                      jnp.maximum(absmax, 1e-30), jnp.nan) / 127.0
+    safe = jnp.where(jnp.isfinite(scale), scale, 1.0)  # keep the i8 cast defined
+    q = jnp.clip(jnp.round(c / safe), -127, 127).astype(jnp.int8)
     return q, scale
 
 

@@ -85,12 +85,20 @@ class CostModel:
         peak = CHIP_PEAK_FLOPS[self._chip] * self._eff
         return self.flops_per_step() / max(num_devices, 1) / peak
 
-    def _wire_bytes(self, info, sync) -> float:
+    def _wire_bytes(self, info, sync, ring_eligible: bool = True,
+                    compressed: bool = True) -> float:
         from autodist_tpu.kernel.synchronization import compressor as compressor_lib
+        if not compressed:
+            # partitioned/reduce-scatter syncs ignore compressors entirely
+            return info.num_elements * WIRE_DTYPE_BYTES
         try:
             name, rank = compressor_lib.parse_name(getattr(sync, "compressor", ""))
         except ValueError:
             name, rank = getattr(sync, "compressor", ""), None
+        if name in ("Int8Compressor", "Int8CompressorEF") and not ring_eligible:
+            # the quantized ring only arms on single-axis meshes; elsewhere
+            # the wire degrades to bf16
+            return info.num_elements * COMPRESSED_BYTES["HorovodCompressor"]
         if name == "PowerSGDCompressor":
             if len(info.shape) >= 2:
                 # PowerSGD flattens trailing dims to an n x m matrix and
@@ -109,6 +117,8 @@ class CostModel:
 
     def estimate(self, strategy: Strategy) -> CostBreakdown:
         n = max(len(strategy.graph_config.replicas), 1)
+        mesh_shape = strategy.graph_config.mesh_shape
+        ring_eligible = not (mesh_shape and len(mesh_shape) > 1)
         infos = self._item.var_infos
         ici_bw = self._spec.ici_bandwidth_gbps() * 1e9 / 8  # bytes/s
         # cross-host PS traffic rides the node NICs
@@ -125,14 +135,19 @@ class CostModel:
                 continue
             syncs = ([node.synchronizer] if node.synchronizer else
                      [p.synchronizer for p in node.part_configs])
+            partitioned = bool(node.partitioner)
             for sync in syncs:
                 if isinstance(sync, AllReduceSynchronizer):
-                    ar_bytes += self._wire_bytes(info, sync) / max(len(syncs), 1)
+                    ar_bytes += self._wire_bytes(
+                        info, sync, ring_eligible,
+                        compressed=not partitioned) / max(len(syncs), 1)
                     groups.add(sync.group)
                 elif isinstance(sync, PSSynchronizer):
                     dest = sync.reduction_destination.split(":")[0] or "ps"
                     ps_load[dest] = ps_load.get(dest, 0.0) + (
-                        self._wire_bytes(info, sync) / max(len(syncs), 1))
+                        self._wire_bytes(info, sync, ring_eligible,
+                                         compressed=not partitioned)
+                        / max(len(syncs), 1))
                     num_ps_transfers += 1
 
         # ring all-reduce: 2*(N-1)/N of the payload crosses each link

@@ -28,6 +28,7 @@ def default_candidates() -> List[Tuple[str, StrategyBuilder]]:
         ("AllReduce/128", AllReduce(chunk_size=128)),
         ("AllReduce/512", AllReduce(chunk_size=512)),
         ("AllReduce/bf16", AllReduce(compressor="HorovodCompressor")),
+        ("AllReduce/int8", AllReduce(compressor="Int8CompressorEF")),
         ("PartitionedAR", PartitionedAR()),
         ("Parallax", Parallax()),
         ("Parallax/bf16", Parallax(compressor="HorovodCompressor")),
