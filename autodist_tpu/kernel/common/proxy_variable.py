@@ -3,19 +3,22 @@
 Analog of reference ``autodist/kernel/common/proxy_variable.py:74-191``: a
 nontrainable clone of a PS-hosted variable on the worker device, with reads
 rewired to the clone and refresh ops after each gradient application. Under
-SPMD the "proxy" question becomes *where a parameter rests between steps*:
+SPMD the "proxy" question becomes *where a parameter rests between steps*,
+and the answer changes the compiled program (``parallel/ps.py``):
 
-- ``cached=True`` (the reference's proxy): the variable rests replicated on
-  every device; no per-step parameter traffic — only gradient collectives.
-  This is the lowering's default for unpartitioned vars, so a proxy config
-  is the natural state on TPU (the reference had to build it by hand).
-- ``cached=False`` (no proxy — PS-resident): the variable rests sharded on
-  its owner (ZeRO-style, the partitioned layout) and is all-gathered at the
-  start of each step — per-step parameter traffic in exchange for 1/N
-  resident memory, exactly the reference's no-proxy read-from-PS cost.
-
-``ProxyVariable.plan`` makes that decision explicit per variable, so PS
-configs with ``local_replication`` toggle between the two layouts.
+- ``cached=True`` (the reference's proxy, ``local_replication=True``): the
+  variable rests on device — replicated for unpartitioned vars (updated in
+  place by the on-device optimizer; only gradient collectives cross the
+  wire), ZeRO-sharded for partitioned vars. This is the reference's
+  worker-local clone: reads are free, and the "refresh op after apply" is
+  the on-device update itself.
+- ``cached=False`` (no proxy — PS-resident, the reference's default): the
+  variable and its optimizer state rest in HOST memory
+  (``parallel/ps.py:PSStore``); every step pulls the value host->device and
+  pushes the reduced gradient device->host, where the update applies on the
+  host CPU — exactly the reference's read-from-PS + update-on-PS data path
+  (reference ``ps_synchronizer.py:171-176``), with PCIe/DCN standing in for
+  gRPC.
 """
 import dataclasses
 
@@ -25,19 +28,15 @@ from autodist_tpu.kernel.partitioner import VarLayout
 @dataclasses.dataclass
 class ProxyPlan:
     var_name: str
-    cached: bool          # True: replicated-at-rest; False: sharded-at-rest
+    cached: bool          # True: device-resident; False: host-PS-resident
     refresh_every_step: bool = True  # proxies refresh after each apply
 
 
 class ProxyVariable:
     @staticmethod
     def plan(var_name: str, ps_config, layout: VarLayout) -> ProxyPlan:
-        """Decide the at-rest placement for a PS-synchronized variable."""
-        if layout.partitioned:
-            # sharded storage IS the PS-resident form; a proxy would defeat
-            # the memory sharding, so local_replication is ignored here
-            return ProxyPlan(var_name, cached=False)
-        # Unpartitioned PS vars currently always rest replicated (the proxy
-        # form); a true owner-resident unpartitioned variable awaits the
-        # host-offload PS path (parallel/ps.py).
-        return ProxyPlan(var_name, cached=True)
+        """Decide the at-rest placement for a PS-synchronized variable:
+        ``local_replication`` toggles device-cached vs host-resident."""
+        return ProxyPlan(var_name,
+                         cached=bool(getattr(ps_config, "local_replication",
+                                             False)))

@@ -32,6 +32,7 @@ from autodist_tpu.kernel.partitioner import VariablePartitioner, VarLayout
 from autodist_tpu.kernel.common import variable_utils
 from autodist_tpu.kernel.synchronization.synchronizer import Synchronizer
 from autodist_tpu.parallel import collectives
+from autodist_tpu.parallel import ps as ps_lib
 from autodist_tpu.strategy.base import Strategy
 from autodist_tpu.train_state import TrainState
 from autodist_tpu.utils import logging
@@ -50,7 +51,8 @@ class DistributedStep:
                  layout_tree, strategy: Strategy, model_item, mesh_axis: str,
                  sync_state_init: Callable, metadata: Optional[dict] = None,
                  step_fn_nodonate: Optional[Callable] = None,
-                 eval_fn: Optional[Callable] = None):
+                 eval_fn: Optional[Callable] = None,
+                 ps_store=None, holed_params_template=None):
         self.mesh = mesh
         self.mesh_axis = mesh_axis
         self.all_axes = tuple(mesh.axis_names)
@@ -66,32 +68,70 @@ class DistributedStep:
         self._sync_state_init = sync_state_init
         self.metadata = metadata or {}
         self.num_replicas = mesh.shape[mesh_axis]
+        # host-offloaded PS: values + optimizer state for no-proxy PS vars
+        # rest in the store (parallel/ps.py); the device state carries holes
+        self.ps_store = ps_store
+        self._holed_template = (holed_params_template
+                                if holed_params_template is not None
+                                else model_item.params)
+
+    # ---------------------------------------------------------- ps data path
+
+    def _pull_ps(self) -> dict:
+        """Host -> device transfer of the current PS values (the per-step
+        parameter read from the PS; empty when no var is host-resident)."""
+        if self.ps_store is None:
+            return {}
+        return {n: self._put(v, P())
+                for n, v in self.ps_store.pull().items()}
+
+    def _push_ps(self, ps_grads: dict) -> None:
+        """Device -> host transfer of the reduced PS gradients + host-side
+        optimizer apply (the PS update op)."""
+        if self.ps_store is not None and ps_grads:
+            self.ps_store.push(ps_grads)
 
     def __call__(self, state: TrainState, batch, donate: bool = True):
         """Run one step. ``donate=True`` (default) consumes ``state``'s
         buffers — callers holding their own reference to the input state must
         pass ``donate=False``."""
         fn = self._step_fn if donate else self._step_fn_nodonate
-        return fn(state, batch)
+        ps_vals = self._pull_ps()
+        new_state, ps_grads, metrics = fn(state, ps_vals, batch)
+        self._push_ps(ps_grads)
+        return new_state, metrics
 
     def evaluate(self, state: TrainState, batch):
         """Forward-only metrics: no grads, no optimizer, no gradient
         collectives — ~3x cheaper than a train step."""
+        ps_vals = self._pull_ps()
         if self._eval_fn is None:
-            _, metrics = self._step_fn_nodonate(state, batch)
+            _, _, metrics = self._step_fn_nodonate(state, ps_vals, batch)
             return metrics
-        return self._eval_fn(state, batch)
+        return self._eval_fn(state, ps_vals, batch)
 
     def snapshot_lowered(self, state: TrainState, batch):
         """Dump the transformed program's StableHLO (the reference's
         '3-transformed' TensorBoard snapshot, ``graph_transformer.py:90``)."""
         from autodist_tpu.utils import visualization_util
         try:
-            text = self._step_fn_nodonate.lower(state, batch).as_text()
+            text = self.lowered_text(state, batch)
             visualization_util.log_program("3-transformed-stablehlo", text,
                                            force=True)
         except Exception as e:  # noqa: BLE001 — diagnostics must not break runs
             logging.warning("snapshot_lowered failed: %s", e)
+
+    def lowered_text(self, state: TrainState, batch) -> str:
+        """StableHLO text of the compiled train step (used by snapshots and
+        by tests asserting on the program's collective structure). PS values
+        enter as avals — lowering must not cost a real pull."""
+        ps_avals = {}
+        if self.ps_store is not None:
+            infos = self.model_item.var_infos
+            ps_avals = {n: jax.ShapeDtypeStruct(tuple(infos[n].shape),
+                                                np.dtype(infos[n].dtype))
+                        for n in self.ps_store.var_names}
+        return self._step_fn_nodonate.lower(state, ps_avals, batch).as_text()
 
     # ------------------------------------------------------------- state mgmt
 
@@ -100,9 +140,22 @@ class DistributedStep:
         return host_to_mesh(self.mesh, value, pspec)
 
     def init_state(self, params, opt_state=None, sync_state=None) -> TrainState:
-        """Shard initial params/optimizer state into storage layout
-        (pad partitioned vars, place on the mesh)."""
+        """Shard initial params/optimizer state into storage layout: PS
+        leaves go to the host store; device leaves are padded (partitioned
+        vars) and placed on the mesh. ``params``/``opt_state`` arrive in the
+        ORIGINAL full layout (the checkpoint layout)."""
         item = self.model_item
+        if self.ps_store is not None and not ps_lib.holes_of(params):
+            # host-resident leaves: values + per-shard optimizer state
+            # (an already-holed input means re-init from a live state — the
+            # store then keeps its current contents)
+            self.ps_store.init_params(params)
+            params = ps_lib.hole_like(self._holed_template, params)
+            if opt_state is not None:
+                self.ps_store.load_opt_from_full(opt_state)
+                holed_opt_template = jax.eval_shape(item.optimizer.init,
+                                                    self._holed_template)
+                opt_state = ps_lib.hole_like(holed_opt_template, opt_state)
         if opt_state is None:
             opt_state = item.optimizer.init(params)
         # pad + place params
@@ -131,16 +184,25 @@ class DistributedStep:
     def gather_params(self, state: TrainState):
         """Params back in the original (full, unpadded) layout, on host —
         the reference's 'checkpoints load in vanilla TF' property
-        (reference ``checkpoint/saver.py:50-57``)."""
-        return self._gather_tree(state.params, self._layout_tree)
+        (reference ``checkpoint/saver.py:50-57``). Host-resident PS values
+        come straight from the store (the authoritative copy)."""
+        gathered = self._gather_tree(state.params, self._layout_tree)
+        if self.ps_store is not None:
+            gathered = ps_lib.fill_holes(gathered, self.ps_store.full_values())
+        return gathered
 
     def gather_opt_state(self, state: TrainState):
-        """Optimizer state in the original (full, unpadded) layout."""
+        """Optimizer state in the original (full, unpadded) layout; PS
+        vars' slots are reconstructed from the store's per-shard states."""
         from autodist_tpu.kernel.common import variable_utils
         layout_tree = variable_utils.map_state_layouts(
             state.opt_state, self.model_item.var_infos, self.layouts,
             VarLayout(name=""))
-        return self._gather_tree(state.opt_state, layout_tree)
+        gathered = self._gather_tree(state.opt_state, layout_tree)
+        if self.ps_store is not None:
+            gathered = ps_lib.fill_holes_with_path(
+                gathered, self.ps_store.full_opt_leaf)
+        return gathered
 
     def gather_sync_state(self, state: TrainState):
         """Compressor state to host, keeping the leading device axis."""
@@ -187,13 +249,17 @@ class GraphTransformer:
 
     # ---------------------------------------------------------------- helpers
 
-    def _build_synchronizers(self, layouts) -> Dict[str, Synchronizer]:
+    def _build_synchronizers(self, layouts, ps_names=frozenset()) -> Dict[str, Synchronizer]:
         """Per-variable synchronizer kernels from strategy node configs
-        (reference ``graph_transformer.py:94-130``)."""
+        (reference ``graph_transformer.py:94-130``). Host-resident PS vars
+        (``ps_names``) have no in-SPMD synchronizer — their gradient leaves
+        the device and the store applies the update."""
         syncs = {}
         for node in self._strategy.node_config:
             info = self._item.var_infos.get(node.var_name)
             if info is None:
+                continue
+            if node.var_name in ps_names:
                 continue
             if not info.trainable:
                 # frozen vars never sync (their grads are zeroed in the
@@ -244,7 +310,21 @@ class GraphTransformer:
             self._strategy, var_infos, self.num_replicas, self._axis,
             mesh_axis_sizes={a: int(self._mesh.shape[a]) for a in self._axes})
 
-        names, _, treedef = variable_utils.flatten_named(item.params)
+        # Host-offloaded PS: no-proxy PS vars leave the device state entirely
+        # (parallel/ps.py). Their device-side layout is moot (they enter the
+        # step as replicated pulled values), so any partitioned layout the
+        # partitioner assigned is dropped — host storage honors the TRUE
+        # (possibly uneven) shard sizes instead of the padded device split.
+        ps_plans = ps_lib.plan_host_ps(self._strategy, var_infos)
+        ps_names = frozenset(ps_plans)
+        for n in ps_names:
+            layouts[n] = VarLayout(name=n)
+        ps_store = (ps_lib.PSStore(ps_plans, var_infos, item.optimizer)
+                    if ps_plans else None)
+        holed_params = (ps_lib.hole_out_params(item.params, ps_names)
+                        if ps_names else item.params)
+
+        names, _, treedef = variable_utils.flatten_named(holed_params)
         layout_tree = variable_utils.unflatten_named(
             treedef, [layouts[n] for n in names])
 
@@ -259,7 +339,7 @@ class GraphTransformer:
                      if a not in set(layouts[n].mp_axis_names))
             for n in mp_names}
 
-        syncs = self._build_synchronizers(layouts)
+        syncs = self._build_synchronizers(layouts, ps_names)
         # Route unpartitioned AllReduce vars with an *active* compressor into
         # concat buckets (payload transform needs the merged vector).
         # NoneCompressor vars psum individually — XLA's all-reduce combiner
@@ -309,16 +389,29 @@ class GraphTransformer:
         all_axes = self._axes
         frozen_names = frozenset(n for n, v in var_infos.items() if not v.trainable)
 
-        def local_step(state: TrainState, batch):
-            full_params = _tree_map_layouts(
+        def local_step(state: TrainState, ps_vals, batch):
+            gathered = _tree_map_layouts(
                 lambda leaf, lay: lay.gather_full(leaf), state.params, layout_tree)
+            # host-resident PS values arrive pulled + replicated; fill the
+            # holes so the user's loss sees the full original params tree
+            full_params = (ps_lib.fill_holes(gathered, ps_vals)
+                           if ps_names else gathered)
             if has_aux:
                 (loss, aux), grads = grad_fn(full_params, batch)
             else:
                 loss, grads = grad_fn(full_params, batch)
                 aux = None
-            g_names, g_leaves, g_treedef = variable_utils.flatten_named(grads)
+            g_names, g_leaves, _ = variable_utils.flatten_named(grads)
             g = dict(zip(g_names, g_leaves))
+
+            # PS gradients exit the device: mean-reduced, replicated, pushed
+            # to the host store by the caller (the reference's grad push to
+            # the PS accumulator, ps_synchronizer.py:556-633)
+            if N == 1:
+                ps_grads = {n: g[n] for n in sorted(ps_names)}
+            else:
+                ps_grads = {n: jax.lax.psum(g[n], all_axes) / N
+                            for n in sorted(ps_names)}
 
             sync_state = dict(state.sync_state) if isinstance(state.sync_state, dict) else {}
             new_bucket_state = dict(sync_state.get("bucket", {}))
@@ -331,7 +424,7 @@ class GraphTransformer:
                 # would only insert degenerate all-reduces that block fusion
                 # (compressor states pass through unchanged)
                 synced = {n: (jnp.zeros_like(v) if n in frozen_names else v)
-                          for n, v in g.items()}
+                          for n, v in g.items() if n not in ps_names}
 
             # model-parallel vars: mean over the complement axes only; the /N
             # (total devices) normalization is exact — shard_map AD transposes
@@ -368,15 +461,18 @@ class GraphTransformer:
             # clean and the value never moves; remaining unconfigured vars
             # (shouldn't happen post-compile) get a plain mean-psum
             for n in g_names:
-                if n in synced:
+                if n in synced or n in ps_names:
                     continue
                 if n in var_infos and not var_infos[n].trainable:
                     synced[n] = jnp.zeros_like(g[n])
                 else:
                     synced[n] = psum(g[n]) / N
 
+            # device-side update covers only device-resident leaves (the
+            # holed structure); PS leaves update on the host
+            h_names, _, h_treedef = variable_utils.flatten_named(state.params)
             grads_storage = variable_utils.unflatten_named(
-                g_treedef, [synced[n] for n in g_names])
+                h_treedef, [synced[n] for n in h_names])
             updates, new_opt = optimizer.update(
                 grads_storage, state.opt_state, state.params)
             # mask non-trainable updates (guards vs. weight decay etc.)
@@ -400,12 +496,14 @@ class GraphTransformer:
                 new_sync["var"] = new_var_state
             new_state = TrainState(step=state.step + 1, params=new_params,
                                    opt_state=new_opt, sync_state=new_sync)
-            return new_state, metrics
+            return new_state, ps_grads, metrics
 
         # ----- spec trees for shard_map
         param_specs = _tree_map_layouts(lambda _leaf, lay: lay.pspec,
-                                        item.params, layout_tree)
-        opt_state_spec = item.opt_state_spec
+                                        holed_params, layout_tree)
+        opt_state_spec = (jax.eval_shape(item.optimizer.init, holed_params)
+                          if ps_names else item.opt_state_spec)
+        ps_specs = {n: P() for n in sorted(ps_names)}
         opt_layout_tree = variable_utils.map_state_layouts(
             opt_state_spec, var_infos, layouts, VarLayout(name=""))
         opt_specs = _tree_map_layouts(lambda _leaf, lay: lay.pspec,
@@ -436,10 +534,12 @@ class GraphTransformer:
 
         # forward-only metrics (Runner.evaluate): same param gather, no
         # grad/optimizer/collective-sync cost
-        def local_eval(state: TrainState, batch):
-            full_params = _tree_map_layouts(
+        def local_eval(state: TrainState, ps_vals, batch):
+            gathered = _tree_map_layouts(
                 lambda leaf, lay: lay.gather_full(leaf), state.params,
                 layout_tree)
+            full_params = (ps_lib.fill_holes(gathered, ps_vals)
+                           if ps_names else gathered)
             out = item.loss_fn(full_params, batch)
             loss, aux = (out if has_aux else (out, None))
             metrics = {"loss": jax.lax.pmean(loss, all_axes)}
@@ -458,33 +558,41 @@ class GraphTransformer:
         # reduce-scatter), so the automatic one must stay off.
         sharded = jax.shard_map(
             local_step, mesh=self._mesh,
-            in_specs=(state_specs, batch_specs),
-            out_specs=(state_specs, metric_specs), check_vma=False)
+            in_specs=(state_specs, ps_specs, batch_specs),
+            out_specs=(state_specs, ps_specs, metric_specs), check_vma=False)
         step_fn = jax.jit(sharded, donate_argnums=(0,) if self._donate else ())
         step_fn_nodonate = jax.jit(sharded) if self._donate else step_fn
         eval_fn = jax.jit(jax.shard_map(
             local_eval, mesh=self._mesh,
-            in_specs=(state_specs, batch_specs),
+            in_specs=(state_specs, ps_specs, batch_specs),
             out_specs=metric_specs, check_vma=False))
 
         ps_syncs = [s for s in syncs.values()
                     if s.__class__.__name__ == "PSSynchronizer"]
         metadata = {
-            "ps_assignments": {s.var_name: s.reduction_destination
-                               for s in ps_syncs},
+            # proxied (device-cached) PS vars keep a single destination;
+            # host-resident plans carry one owner per shard
+            "ps_assignments": dict(
+                {s.var_name: s.reduction_destination for s in ps_syncs},
+                **{n: list(p.destinations) for n, p in ps_plans.items()}),
+            "ps_host_resident": sorted(ps_names),
             "buckets": [b.key for b in buckets],
             "per_var_compressors": per_var_comp,
             # staleness window for the runner's cross-process pacing
-            "staleness": max((s.staleness for s in ps_syncs), default=0),
-            "async": any(not s.sync_mode for s in ps_syncs),
+            "staleness": max(
+                [s.staleness for s in ps_syncs]
+                + [ps_store.max_staleness() if ps_store else 0]),
+            "async": (any(not s.sync_mode for s in ps_syncs)
+                      or (ps_store.any_async() if ps_store else False)),
         }
         logging.info("GraphTransformer: lowered %d vars (%d partitioned, "
-                     "%d buckets) over %d replicas",
+                     "%d host-PS-resident, %d buckets) over %d replicas",
                      len(layouts),
                      sum(1 for l in layouts.values() if l.partitioned),
-                     len(buckets), N)
+                     len(ps_names), len(buckets), N)
         return DistributedStep(
             mesh=self._mesh, step_fn=step_fn, step_fn_nodonate=step_fn_nodonate,
             layouts=layouts, layout_tree=layout_tree, strategy=self._strategy,
             model_item=item, mesh_axis=axis, sync_state_init=sync_state_init,
-            metadata=metadata, eval_fn=eval_fn)
+            metadata=metadata, eval_fn=eval_fn, ps_store=ps_store,
+            holed_params_template=holed_params)

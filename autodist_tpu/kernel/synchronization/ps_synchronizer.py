@@ -9,24 +9,28 @@ surgery). The reference's machinery maps onto TPU as follows:
   replicas already share one logical variable; the local aggregation is the
   first hop of the single ``psum``.
 - *Between-graph apply* (place var+update on the PS device, per-worker
-  accumulators, token-queue barriers, ``:171-176,335-458,556-633``): the
-  synchronous dance — "push grads, owner averages over num_workers, applies,
-  workers wait for the token" — is exactly the semantics of one mean
-  ``psum`` followed by a (redundantly computed, hence communication-free)
-  update: every device leaves the step with the identical post-update value,
-  which is what the token queue guaranteed. The owner assignment
-  (``reduction_destination``) is kept as metadata: it drives the
-  load-balancing accounting and the host-offload placement in
-  ``parallel/ps.py``.
-- *Proxy variables* (``common/proxy_variable.py``): worker-local caches —
-  see ``kernel/common/proxy_variable.py``.
+  accumulators, token-queue barriers, ``:171-176,335-458,556-633``): with
+  ``local_replication=False`` (no proxy — the reference's default) the
+  variable takes the REAL host-offloaded PS data path in ``parallel/ps.py``:
+  values + optimizer state rest in host memory, pulled to device each step,
+  gradients pushed back and applied host-side — and this kernel is never
+  instantiated. This class handles only the **proxied** case
+  (``local_replication=True``, the reference's worker-local cache): the
+  variable rests on device, and the synchronous dance — "push grads, owner
+  averages over num_workers, applies, workers wait for the token" — is
+  exactly the semantics of one mean ``psum`` followed by a (redundantly
+  computed, hence communication-free) update: every device leaves the step
+  with the identical post-update value, which is what the token queue
+  guaranteed.
 - *Staleness* (``:388-458``): bounded staleness is a runtime-scheduling
   property on TPU, implemented by the Runner's cross-process pacing
   through the native coordination service
   (``runtime/coordination.py``): each process reports its step and blocks
   while more than ``staleness`` steps ahead of the slowest worker — the
   semantics the reference built from size-``s`` token queues. Fully-async
-  PS (``sync=False``) is not implemented and logs a warning.
+  PS (``sync=False``) is a host-store property (``parallel/ps.py``); an
+  async PROXIED var is contradictory (a device-cached copy updated in
+  lockstep cannot be async) and warns.
 """
 from autodist_tpu.kernel.synchronization.synchronizer import Synchronizer
 
@@ -43,9 +47,9 @@ class PSSynchronizer(Synchronizer):
         if not self.sync_mode:
             from autodist_tpu.utils import logging
             logging.warning(
-                "var %s: fully-async PS (sync=False) is not implemented; "
-                "executing synchronously (bounded staleness IS supported — "
-                "set staleness>0 for cross-process slack)", var_name)
+                "var %s: sync=False with local_replication=True is "
+                "contradictory — a device-cached proxy updates in lockstep; "
+                "drop the proxy to get the async host-PS path", var_name)
 
     def sync(self, grad, state):
         if self.layout is not None and self.layout.partitioned:

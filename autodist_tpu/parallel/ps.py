@@ -1,0 +1,478 @@
+"""Host-offloaded parameter-server data path.
+
+Analog of the reference's between-graph PS placement: the reference places
+each PS variable and its update op ON the parameter-server device — a host
+CPU device — and workers read/write it over the wire every step
+(reference ``autodist/kernel/synchronization/ps_synchronizer.py:171-176``,
+task placement ``:636-762``). The TPU-native equivalent keeps PS variables
+(and their optimizer state — the Adam moments are usually 2x the weights)
+resident in **host memory**, off the HBM:
+
+- at step start the store **pulls**: PS values transfer host -> device and
+  enter the SPMD step replicated (the reference's workers reading from the
+  PS over gRPC);
+- the step returns the mean-psum'd gradient for every PS variable instead
+  of updating it on device (the reference's grad push to the PS
+  accumulator);
+- the store **pushes**: gradients transfer device -> host, are split by
+  true shard ranges (honoring *uneven* ``shard_sizes`` exactly — host
+  arrays need no XLA padding, reference
+  ``strategy/uneven_partition_ps_strategy.py:128-137``), and the optimizer
+  update is applied **on the host CPU** per shard (the reference's update
+  op placed on the PS device).
+
+The strategy's ``local_replication`` knob therefore changes the program:
+``True`` (proxy, reference ``common/proxy_variable.py:74-191``) keeps the
+variable device-resident and updates it on device — no per-step parameter
+traffic; ``False`` routes it through this host path — 1/HBM residency in
+exchange for PCIe traffic every step. ``reduction_destination`` assigns the
+owning host; in synchronous mode every process holds a deterministic mirror
+(the psum'd gradient is bit-identical everywhere, so replaying the update
+locally IS the reference's "every worker transforms its own graph"
+architecture with zero serving traffic), and the owner is the one whose
+copy is authoritative for checkpoints and async serving.
+
+Mechanically, PS variables are carved out of the device ``TrainState`` as
+**holes** — empty pytree nodes that keep the tree structure (so optax
+transformations, tree specs and donation all compose) while contributing no
+device arrays.
+"""
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from autodist_tpu.model_item import _normalize_path
+from autodist_tpu.utils import logging
+
+
+# ------------------------------------------------------------------- holes
+
+
+class PSHole:
+    """An empty pytree node standing where a host-resident PS variable
+    would be: flattening yields no leaves, so jit/optax/shard_map treat it
+    as pure structure. The variable's flattened name rides in the treedef
+    (aux data), so two states with the same PS plan unify under jit."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __repr__(self):
+        return "PSHole(%s)" % self.name
+
+
+jax.tree_util.register_pytree_node(
+    PSHole, lambda h: ((), h.name), lambda name, _: PSHole(name))
+
+
+def _is_hole(x) -> bool:
+    return isinstance(x, PSHole)
+
+
+def hole_out_params(params, ps_names) -> Any:
+    """Replace leaves named in ``ps_names`` with PSHole nodes."""
+    def repl(path, leaf):
+        name = _normalize_path(path)
+        return PSHole(name) if name in ps_names else leaf
+    return jax.tree_util.tree_map_with_path(repl, params)
+
+
+def fill_holes(tree, values: Dict[str, Any]) -> Any:
+    """Replace every PSHole with ``values[hole.name]``."""
+    return jax.tree_util.tree_map(
+        lambda x: values[x.name] if _is_hole(x) else x, tree, is_leaf=_is_hole)
+
+
+def fill_holes_with_path(tree, provider: Callable[[str, str], Any]) -> Any:
+    """Replace every PSHole with ``provider(path, var_name)`` — used for
+    optimizer-state reconstruction where the hole's tree position (the
+    optimizer slot) matters."""
+    def repl(path, x):
+        if _is_hole(x):
+            return provider(_normalize_path(path), x.name)
+        return x
+    return jax.tree_util.tree_map_with_path(repl, tree, is_leaf=_is_hole)
+
+
+def hole_like(template, full):
+    """Structure-align ``full`` to a holed ``template``: wherever the
+    template has a PSHole, the corresponding subtree of ``full`` is dropped
+    and the hole kept; everywhere else ``full``'s leaves win."""
+    return jax.tree_util.tree_map(
+        lambda t, f: t if _is_hole(t) else f, template, full, is_leaf=_is_hole)
+
+
+def extract_holes(template, full) -> Dict[Tuple[str, str], Any]:
+    """Inverse of :func:`hole_like`: ``{(hole_path, var_name): subtree}``
+    for every hole position, pulling the subtree out of ``full``."""
+    out: Dict[Tuple[str, str], Any] = {}
+
+    def visit(path, t, f):
+        if _is_hole(t):
+            out[(_normalize_path(path), t.name)] = f
+        return t
+    jax.tree_util.tree_map_with_path(visit, template, full, is_leaf=_is_hole)
+    return out
+
+
+def holes_of(tree) -> List[str]:
+    """Names of all PSHoles in a tree."""
+    found: List[str] = []
+    jax.tree_util.tree_map(
+        lambda x: found.append(x.name) if _is_hole(x) else None,
+        tree, is_leaf=_is_hole)
+    return found
+
+
+# -------------------------------------------------------------------- plans
+
+
+@dataclasses.dataclass(frozen=True)
+class PSVarPlan:
+    """Host-residency plan for one PS variable.
+
+    ``destinations`` has one owner device string per shard (length 1 for
+    unpartitioned vars); ``shard_sizes`` are the TRUE sizes along ``axis``
+    (uneven allowed — host storage is ragged, never padded)."""
+    var_name: str
+    destinations: Tuple[str, ...]
+    shard_sizes: Optional[Tuple[int, ...]] = None   # None = unpartitioned
+    axis: int = 0
+    sync: bool = True
+    staleness: int = 0
+    sparse: bool = False
+
+    @property
+    def partitioned(self) -> bool:
+        return self.shard_sizes is not None and len(self.shard_sizes) > 1
+
+    def shard_ranges(self) -> List[Tuple[int, int]]:
+        if not self.shard_sizes:
+            return [(0, -1)]
+        ranges, off = [], 0
+        for s in self.shard_sizes:
+            ranges.append((off, off + s))
+            off += s
+        return ranges
+
+
+def _even_or_given_sizes(node, info) -> Tuple[int, ...]:
+    if node.shard_sizes:
+        return tuple(node.shard_sizes)
+    n = node.num_shards
+    axis = node.partition_axis or 0
+    dim = info.shape[axis]
+    base, rem = divmod(dim, n)
+    return tuple(base + (1 if i < rem else 0) for i in range(n))
+
+
+def plan_host_ps(strategy, var_infos) -> Dict[str, PSVarPlan]:
+    """Decide which variables are host-resident, from the compiled strategy.
+
+    A variable routes to the host PS path when it is PS-synchronized with
+    ``local_replication=False`` (no proxy — the reference's default, where
+    every read hits the PS). Proxied PS vars stay device-resident; AllReduce
+    vars never come here. The cached-vs-resident decision itself is owned by
+    ``ProxyVariable.plan`` (single source — this function adds only the
+    eligibility gating: trainable, non-model-parallel, uniform shard
+    configs)."""
+    from autodist_tpu.kernel.common.proxy_variable import ProxyVariable
+    from autodist_tpu.strategy.base import PSSynchronizer as PSConfig
+
+    def cached(cfg) -> bool:
+        return ProxyVariable.plan("", cfg, None).cached
+
+    plans: Dict[str, PSVarPlan] = {}
+    for node in strategy.node_config:
+        info = var_infos.get(node.var_name)
+        if info is None or not info.trainable:
+            continue
+        if node.mp_axes:
+            continue  # model-parallel storage owns these
+        sync_cfg = node.synchronizer
+        part_syncs = [p.synchronizer for p in node.part_configs
+                      if p.synchronizer is not None]
+        if node.partitioner and part_syncs:
+            if not all(isinstance(s, PSConfig) for s in part_syncs):
+                continue
+            if any(cached(s) for s in part_syncs):
+                continue  # proxied: device ZeRO path
+            sizes = _even_or_given_sizes(node, info)
+            plans[node.var_name] = PSVarPlan(
+                var_name=node.var_name,
+                destinations=tuple(s.reduction_destination for s in part_syncs),
+                shard_sizes=sizes,
+                axis=node.partition_axis or 0,
+                sync=all(s.sync for s in part_syncs),
+                staleness=max(s.staleness for s in part_syncs),
+                sparse=info.sparse)
+        elif isinstance(sync_cfg, PSConfig):
+            if cached(sync_cfg):
+                continue  # proxied: device-resident (cached) path
+            plans[node.var_name] = PSVarPlan(
+                var_name=node.var_name,
+                destinations=(sync_cfg.reduction_destination,),
+                sync=sync_cfg.sync,
+                staleness=sync_cfg.staleness,
+                sparse=info.sparse)
+    for p in plans.values():
+        if not p.sync:
+            logging.warning(
+                "var %s: async PS (sync=False) requires the serving PS mode "
+                "(multi-process + coordination service); in this "
+                "configuration updates apply synchronously", p.var_name)
+            break
+    return plans
+
+
+# -------------------------------------------------------------------- store
+
+
+class PSStore:
+    """Host-memory parameter server: values + optimizer state per shard.
+
+    The store is the PS device of the reference — parameters rest here, the
+    update op runs here (on the host CPU), and the training step only ever
+    sees pulled copies. Updates run through the SAME optax optimizer the
+    device path uses, one subtree per shard (the reference's per-PS
+    optimizer placement; cross-variable optimizer coupling such as global
+    gradient clipping decouples between the PS set and the device set,
+    exactly as it did across reference PS shards).
+
+    ``stats`` counts the wire: pulls/pushes and their bytes — the honest
+    cost of the no-proxy PS path that tests and the simulator can assert
+    on."""
+
+    def __init__(self, plans: Dict[str, PSVarPlan], var_infos, optimizer):
+        self.plans = dict(plans)
+        self._var_infos = var_infos
+        self._optimizer = optimizer
+        self._values: Dict[str, List[np.ndarray]] = {}
+        self._opt: Dict[str, List[Any]] = {}
+        self._cpu = jax.local_devices(backend="cpu")[0]
+        self.stats = {"pulls": 0, "pushes": 0, "applies": 0,
+                      "bytes_pulled": 0, "bytes_pushed": 0}
+        # jit cache for the per-shard host update (keyed by shape/dtype via
+        # jit's own cache); compiled for CPU so PS updates never touch HBM
+        self._apply = jax.jit(self._apply_impl, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _apply_impl(self, shard, opt_state, grad):
+        updates, new_opt = self._optimizer.update(
+            {"v": grad}, opt_state, {"v": shard})
+        return optax.apply_updates({"v": shard}, updates)["v"], new_opt
+
+    def _split(self, plan: PSVarPlan, full: np.ndarray) -> List[np.ndarray]:
+        if not plan.partitioned:
+            return [np.asarray(full)]
+        out = []
+        for lo, hi in plan.shard_ranges():
+            idx = [slice(None)] * full.ndim
+            idx[plan.axis] = slice(lo, hi)
+            out.append(np.ascontiguousarray(full[tuple(idx)]))
+        return out
+
+    def init_params(self, full_params) -> None:
+        """Take ownership of the PS leaves of a host params tree."""
+        from autodist_tpu.kernel.common import variable_utils
+        names, leaves, _ = variable_utils.flatten_named(full_params)
+        by_name = dict(zip(names, leaves))
+        with jax.default_device(self._cpu):
+            for name, plan in self.plans.items():
+                full = np.asarray(jax.device_get(by_name[name]))
+                self._values[name] = self._split(plan, full)
+                self._opt[name] = [
+                    self._optimizer.init({"v": jnp.asarray(s)})
+                    for s in self._values[name]]
+
+    def load_opt_from_full(self, full_opt_tree) -> None:
+        """Rebuild per-shard optimizer state from a full-layout opt tree
+        (checkpoint restore). Var-shaped leaves are sliced by shard range;
+        everything else (step counts, factored-state leaves not along the
+        split axis) is copied whole per shard."""
+        from autodist_tpu.kernel.common import variable_utils
+        flat_full = {}
+        names, leaves, _ = variable_utils.flatten_named(full_opt_tree)
+        for n, l in zip(names, leaves):
+            flat_full[n] = np.asarray(jax.device_get(l))
+        with jax.default_device(self._cpu):
+            for name, plan in self.plans.items():
+                info = self._var_infos[name]
+                new_states = []
+                for si, (lo, hi) in enumerate(plan.shard_ranges()):
+                    template = self._optimizer.init(
+                        {"v": jnp.asarray(self._values[name][si])})
+                    t_names, t_leaves, t_def = variable_utils.flatten_named(template)
+                    out = []
+                    for tn, tl in zip(t_names, t_leaves):
+                        # little-tree leaf "0/mu/v" <-> full leaf "0/mu/<var>"
+                        if tn.endswith("/v") or tn == "v":
+                            src_name = (tn[:-2] + "/" + name) if tn.endswith("/v") else name
+                        else:
+                            src_name = tn
+                        src = flat_full.get(src_name)
+                        if src is None:
+                            logging.warning(
+                                "PS restore: opt leaf %r for %s not in "
+                                "checkpoint; keeping fresh init", tn, name)
+                            out.append(tl)
+                            continue
+                        if (plan.partitioned and src.ndim > plan.axis
+                                and src.shape[plan.axis] == info.shape[plan.axis]):
+                            idx = [slice(None)] * src.ndim
+                            idx[plan.axis] = slice(lo, hi)
+                            src = src[tuple(idx)]
+                        out.append(jnp.asarray(src))
+                    new_states.append(variable_utils.unflatten_named(t_def, out))
+                self._opt[name] = new_states
+
+    # ------------------------------------------------------------- step i/o
+
+    def pull(self) -> Dict[str, np.ndarray]:
+        """Current full values, host-side (the workers' per-step PS read)."""
+        out = {}
+        for name, plan in self.plans.items():
+            shards = self._values[name]
+            full = (np.asarray(shards[0]) if len(shards) == 1
+                    else np.concatenate([np.asarray(s) for s in shards],
+                                        axis=plan.axis))
+            out[name] = full
+            self.stats["bytes_pulled"] += full.nbytes
+        self.stats["pulls"] += 1
+        return out
+
+    def push(self, grads: Dict[str, Any]) -> None:
+        """Apply mean-reduced gradients to the resident values (the PS-side
+        update op). Dense grads are full arrays; sparse grads are
+        ``(indices, values)`` pairs scatter-added into the shard's index
+        range (the reference's IndexedSlices split,
+        ``kernel/partitioner.py:660-684``)."""
+        with jax.default_device(self._cpu):
+            for name, g in grads.items():
+                plan = self.plans[name]
+                if isinstance(g, tuple):
+                    # wire accounting happens inside _densify (idx+vals are
+                    # what crossed device->host, not the dense array)
+                    g = self._densify(name, plan, g)
+                else:
+                    g = np.asarray(jax.device_get(g))
+                    self.stats["bytes_pushed"] += g.nbytes
+                for si, (lo, hi) in enumerate(plan.shard_ranges()):
+                    if plan.partitioned:
+                        idx = [slice(None)] * g.ndim
+                        idx[plan.axis] = slice(lo, hi)
+                        gs = np.ascontiguousarray(g[tuple(idx)])
+                    else:
+                        gs = g
+                    new_val, new_opt = self._apply(
+                        jnp.asarray(self._values[name][si]),
+                        self._opt[name][si], jnp.asarray(gs))
+                    self._values[name][si] = np.asarray(new_val)
+                    self._opt[name][si] = new_opt
+                self.stats["applies"] += 1
+        self.stats["pushes"] += 1
+
+    def _densify(self, name: str, plan: PSVarPlan, pair) -> np.ndarray:
+        """(indices, values) -> dense mean gradient for the full var."""
+        idx, vals = pair
+        idx = np.asarray(jax.device_get(idx)).reshape(-1)
+        vals = np.asarray(jax.device_get(vals))
+        vals = vals.reshape(idx.shape[0], -1)
+        # wire accounting: what actually crossed device->host
+        self.stats["bytes_pushed"] += idx.nbytes + vals.nbytes
+        shape = tuple(self._var_infos[name].shape)
+        dense = np.zeros(shape, vals.dtype).reshape(shape[0], -1)
+        np.add.at(dense, idx, vals)
+        return dense.reshape(shape)
+
+    # ---------------------------------------------------------- checkpoints
+
+    def full_values(self) -> Dict[str, np.ndarray]:
+        """Like :meth:`pull` but for checkpoints — does not count as wire."""
+        out = {}
+        for name, plan in self.plans.items():
+            shards = self._values[name]
+            out[name] = (np.asarray(shards[0]) if len(shards) == 1
+                         else np.concatenate([np.asarray(s) for s in shards],
+                                             axis=plan.axis))
+        return out
+
+    def full_opt_leaf(self, slot_path: str, var_name: str):
+        """Reconstruct one optimizer-state subtree in the var's full layout
+        (for original-layout checkpoints): concat var-sliced leaves across
+        shards, take shard 0 for shared leaves. ``slot_path`` is the hole's
+        position in the full opt tree, e.g. ``0/mu/<var_name>``."""
+        plan = self.plans[var_name]
+        states = self._opt[var_name]
+        # the per-shard little trees hold the same subtree under ".../v"
+        prefix = slot_path[: -len(var_name)].rstrip("/")
+        sub0 = self._subtree_at(states[0], prefix)
+        if sub0 is None:
+            raise KeyError("PS store has no opt slot %r for %s"
+                           % (slot_path, var_name))
+        if not plan.partitioned:
+            return jax.tree_util.tree_map(lambda x: np.asarray(x), sub0)
+        subs = [self._subtree_at(s, prefix) for s in states]
+        shard_dims = plan.shard_sizes
+
+        def merge(*leaves):
+            arrs = [np.asarray(l) for l in leaves]
+            a0 = arrs[0]
+            if (a0.ndim > plan.axis
+                    and tuple(a.shape[plan.axis] for a in arrs) == shard_dims):
+                return np.concatenate(arrs, axis=plan.axis)
+            return a0  # shared (count-like) leaf
+        return jax.tree_util.tree_map(merge, *subs)
+
+    @staticmethod
+    def _subtree_at(little_tree, slot_prefix: str):
+        """The subtree of a per-shard opt state at a slot path, where the
+        little tree's var key is ``v``. slot_prefix '' means the leaf 'v'
+        itself (optimizers whose whole state is var-shaped)."""
+        from autodist_tpu.kernel.common import variable_utils
+        # collect (name, leaf) then rebuild the subtree under prefix + "/v"
+        target = (slot_prefix + "/v") if slot_prefix else "v"
+        names, leaves, _ = variable_utils.flatten_named(little_tree)
+        # exact leaf hit
+        for n, l in zip(names, leaves):
+            if n == target:
+                return l
+        # subtree hit: leaves under target/
+        picked = [(n[len(target) + 1:], l) for n, l in zip(names, leaves)
+                  if n.startswith(target + "/")]
+        if not picked:
+            return None
+        return {n: l for n, l in picked}
+
+    # ------------------------------------------------------------ accounting
+
+    def resident_bytes(self) -> int:
+        """Host bytes resident in this store (values only)."""
+        return sum(int(s.nbytes) for shards in self._values.values()
+                   for s in shards)
+
+    def resident_bytes_by_destination(self) -> Dict[str, int]:
+        """Per-owner byte loads (the PS load-balancing accounting)."""
+        out: Dict[str, int] = {}
+        for name, plan in self.plans.items():
+            for dest, shard in zip(plan.destinations, self._values[name]):
+                out[dest] = out.get(dest, 0) + int(shard.nbytes)
+        return out
+
+    @property
+    def var_names(self):
+        return sorted(self.plans)
+
+    def max_staleness(self) -> int:
+        return max((p.staleness for p in self.plans.values()), default=0)
+
+    def any_async(self) -> bool:
+        return any(not p.sync for p in self.plans.values())

@@ -51,11 +51,17 @@ def test_flops_estimate_positive():
 
 
 def test_proxy_plan():
+    """local_replication (the reference's proxy) decides device-cached vs
+    host-PS-resident — for partitioned and unpartitioned vars alike."""
+    from autodist_tpu.strategy.base import PSSynchronizer as PSConfig
     part = VarLayout(name="v", partitioned=True, axis=0, num_shards=2,
                      orig_dim=8, padded_dim=8)
     rep = VarLayout(name="v")
-    assert ProxyVariable.plan("v", None, part).cached is False
-    assert ProxyVariable.plan("v", None, rep).cached is True
+    proxied = PSConfig(local_replication=True)
+    resident = PSConfig(local_replication=False)
+    for lay in (part, rep):
+        assert ProxyVariable.plan("v", proxied, lay).cached is True
+        assert ProxyVariable.plan("v", resident, lay).cached is False
 
 
 def test_network_utils():
