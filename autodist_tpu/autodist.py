@@ -178,11 +178,81 @@ class AutoDist:
         logging.info("compiled %r", compiled)
         logging.debug("compiled strategy:\n%s", compiled)
         self._setup(compiled)
-        mesh = mesh_lib.mesh_from_strategy(compiled, self._resource_spec,
-                                           backend=self._backend)
+        is_async = self._validate_async(compiled, item)
+        if is_async:
+            # async PS cannot ride global collectives (they are lockstep):
+            # each process runs its OWN local mesh — the reference's
+            # between-graph replication — and couples to peers only through
+            # the parameter service (runtime/ps_service.py)
+            mesh = mesh_lib.local_mesh(backend=self._backend)
+        else:
+            mesh = mesh_lib.mesh_from_strategy(compiled, self._resource_spec,
+                                               backend=self._backend)
         dstep = GraphTransformer(compiled, mesh, item).transform()
+        if is_async and dstep.ps_store is not None:
+            self._wire_async_ps(dstep)
         self._runner = Runner(dstep, tracing=self._tracing)
         return self._runner
+
+    def _validate_async(self, compiled: Strategy, item: ModelItem) -> bool:
+        """True when the strategy requests async PS; async must be PURE
+        host-PS (every trainable var, no proxy, no model-parallel mesh) —
+        anything else would need a cross-process collective, which async
+        training cannot have."""
+        from autodist_tpu.parallel import ps as ps_lib
+        plans = ps_lib.plan_host_ps(compiled, item.var_infos)
+        if not any(not p.sync for p in plans.values()):
+            return False
+        missing = set(item.trainable_var_names) - set(plans)
+        if missing:
+            raise ValueError(
+                "async PS (sync=False) requires EVERY trainable var on the "
+                "no-proxy PS path; not PS-host-resident: %s" % sorted(missing))
+        still_sync = sorted(n for n, p in plans.items() if p.sync)
+        if still_sync:
+            raise ValueError(
+                "async PS is all-or-nothing: these vars request sync=True "
+                "but the job is async (their deterministic mirror-apply "
+                "semantics cannot be honored): %s" % still_sync)
+        stale = sorted(n for n, p in plans.items() if p.staleness > 0)
+        if stale:
+            raise ValueError(
+                "staleness is a SYNC-training window (coordination-service "
+                "pacing); async PS always reads the latest published "
+                "version — drop staleness on: %s" % stale)
+        if compiled.graph_config.mesh_shape:
+            raise ValueError("async PS cannot combine with model-parallel "
+                             "mesh axes (collectives are lockstep)")
+        return True
+
+    def _wire_async_ps(self, dstep):
+        """Attach the parameter service: single-process jobs use the
+        in-process service; multi-process jobs talk to the chief's native
+        coordination service (which async REQUIRES)."""
+        from autodist_tpu.runtime import ps_service as pss
+        my_host = const.ENV.ADT_WORKER.val or self._resource_spec.chief
+        if const.ENV.ADT_NUM_PROCESSES.val <= 1:
+            services = {}
+
+            def service_for_host(host):
+                return services.setdefault(host, pss.LocalPSService())
+        else:
+            from autodist_tpu.runtime.coordination import CoordinationClient
+            coord_host = (const.ENV.ADT_COORDINATOR_ADDR.val.split(":")[0]
+                          or self._resource_spec.chief)
+            port = const.ENV.ADT_COORDSVC_PORT.val
+            try:
+                CoordinationClient(coord_host, port).ping()
+            except OSError as e:
+                raise RuntimeError(
+                    "async PS requires the native coordination service at "
+                    "%s:%d (%s)" % (coord_host, port, e))
+
+            def service_for_host(host):
+                return pss.CoordPSService(
+                    lambda: CoordinationClient(coord_host, port),
+                    prefix="ps:" + host)
+        dstep.ps_store.enable_serving(service_for_host, my_host)
 
     def function(self, loss_fn: Callable, *, optimizer, params, example_batch=None,
                  has_aux: bool = False) -> Callable:

@@ -64,6 +64,12 @@ class ENV(Enum):
     # coordination-service port override (tests / colocated jobs); read at
     # access time like every other ADT_* var, not frozen at import
     ADT_COORDSVC_PORT = ("ADT_COORDSVC_PORT", int, DEFAULT_COORDSVC_PORT)
+    # async-PS backpressure: max gradient blobs in flight per owner queue
+    # before push blocks (0 = unbounded, pure reference-style async)
+    ADT_PS_MAX_LAG = ("ADT_PS_MAX_LAG", int, 2)
+    # comma-separated mesh axis names to treat as DCN (cross-host) for the
+    # spec=DCN hierarchical reduce; default: detected from process layout
+    ADT_DCN_AXES = ("ADT_DCN_AXES", str, "")
 
     @property
     def val(self):

@@ -188,6 +188,10 @@ class DistributedStep:
         come straight from the store (the authoritative copy)."""
         gathered = self._gather_tree(state.params, self._layout_tree)
         if self.ps_store is not None:
+            # async serving: apply any queued gradients this process owns
+            # before reading (peers' in-flight grads are, by async
+            # semantics, allowed to land after)
+            self.ps_store.drain()
             gathered = ps_lib.fill_holes(gathered, self.ps_store.full_values())
         return gathered
 
@@ -286,9 +290,10 @@ class GraphTransformer:
             kind = ("AllReduceSynchronizer" if cfg.kind == "AllReduce"
                     else "PSSynchronizer")
             extra = tuple(a for a in self._axes if a != self._axis)
+            from autodist_tpu.parallel import mesh as mesh_lib
             syncs[node.var_name] = Synchronizer.create(
                 kind, node.var_name, cfg, self.total_devices, self._axis,
-                layouts[node.var_name], extra)
+                layouts[node.var_name], extra, mesh_lib.dcn_axes(self._mesh))
         return syncs
 
     # ---------------------------------------------------------------- main
@@ -388,6 +393,11 @@ class GraphTransformer:
         axis = self._axis
         all_axes = self._axes
         frozen_names = frozenset(n for n, v in var_infos.items() if not v.trainable)
+        from autodist_tpu.parallel import mesh as mesh_lib
+        dcn = tuple(a for a in mesh_lib.dcn_axes(self._mesh) if a in all_axes)
+        ici = tuple(a for a in all_axes if a not in dcn)
+        # int8 quantized rings: one ring per reduced mesh axis, in order
+        ring_axes = tuple((a, int(self._mesh.shape[a])) for a in all_axes)
 
         def local_step(state: TrainState, ps_vals, batch):
             gathered = _tree_map_layouts(
@@ -441,10 +451,12 @@ class GraphTransformer:
             for b in (buckets if N > 1 else []):
                 bst = new_bucket_state.get(b.key)
                 bst_local = bst[0] if bst is not None else None
+                bucket_psum = psum
+                if b.spec == "DCN" and dcn:
+                    bucket_psum = lambda x: collectives.hierarchical_psum(  # noqa: E731
+                        x, ici, dcn)
                 out, nst = collectives.bucket_reduce(
-                    b, g, bst_local, psum, N,
-                    ring_axis=(axis if len(all_axes) == 1 else None),
-                    ring_size=N)
+                    b, g, bst_local, bucket_psum, N, ring_axes=ring_axes)
                 synced.update(out)
                 if nst is not None:
                     new_bucket_state[b.key] = jnp.expand_dims(nst, 0)

@@ -24,9 +24,9 @@ from autodist_tpu.utils import logging
 
 class AllReduceSynchronizer(Synchronizer):
     def __init__(self, var_name, config, num_replicas, mesh_axis="data",
-                 layout=None, extra_axes=()):
+                 layout=None, extra_axes=(), dcn_axes=()):
         super().__init__(var_name, config, num_replicas, mesh_axis, layout,
-                         extra_axes)
+                         extra_axes, dcn_axes)
         self.compressor = compressor_lib.create(
             getattr(config, "compressor", None), var_name)
         # NOTE: int8 ring arming happens in bucket_reduce — every
@@ -39,6 +39,20 @@ class AllReduceSynchronizer(Synchronizer):
             logging.warning("var %s: compressor %s is ignored on the "
                             "partitioned (reduce-scatter) path", var_name,
                             self.compressor.name)
+
+    def psum(self, x):
+        """The ``spec`` hint is consumed here: ``DCN`` lowers the reduction
+        to the bandwidth-hierarchical form (reduce-scatter over ICI,
+        all-reduce the shard over DCN, all-gather over ICI) so the slow
+        cross-host links carry 1/N_ici of the payload. AUTO/ICI take the
+        single fused psum and let XLA schedule it."""
+        axes = (self.mesh_axis,) + self.extra_axes
+        dcn = tuple(a for a in axes if a in self.dcn_axes)
+        if self.spec == "DCN" and dcn:
+            from autodist_tpu.parallel.collectives import hierarchical_psum
+            ici = tuple(a for a in axes if a not in self.dcn_axes)
+            return hierarchical_psum(x, ici, dcn)
+        return super().psum(x)
 
     def state_init(self, grad_shape, dtype):
         return self.compressor.state_init(grad_shape, dtype)

@@ -37,9 +37,9 @@ from autodist_tpu.kernel.synchronization.synchronizer import Synchronizer
 
 class PSSynchronizer(Synchronizer):
     def __init__(self, var_name, config, num_replicas, mesh_axis="data",
-                 layout=None, extra_axes=()):
+                 layout=None, extra_axes=(), dcn_axes=()):
         super().__init__(var_name, config, num_replicas, mesh_axis, layout,
-                         extra_axes)
+                         extra_axes, dcn_axes)
         self.reduction_destination = getattr(config, "reduction_destination", "")
         self.local_replication = getattr(config, "local_replication", False)
         self.sync_mode = getattr(config, "sync", True)

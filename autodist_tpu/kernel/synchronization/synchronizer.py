@@ -22,12 +22,13 @@ from autodist_tpu import const
 class Synchronizer(ABC):
     def __init__(self, var_name: str, config, num_replicas: int,
                  mesh_axis: str = const.DATA_AXIS, layout=None,
-                 extra_axes: tuple = ()):
+                 extra_axes: tuple = (), dcn_axes: tuple = ()):
         self.var_name = var_name
         self.config = config
         self.num_replicas = num_replicas  # TOTAL devices reducing this grad
         self.mesh_axis = mesh_axis        # axis carrying partitioned shards
         self.extra_axes = tuple(extra_axes)  # further axes (seq, ...) to reduce
+        self.dcn_axes = tuple(dcn_axes)   # axes crossing hosts (spec=DCN hint)
         self.layout = layout  # VarLayout
 
     def psum(self, x):

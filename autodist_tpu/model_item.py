@@ -138,7 +138,12 @@ def detect_sparse_vars(loss_fn: Callable, params, example_batch) -> set:
     try:
         closed = jax.make_jaxpr(loss_fn)(params, example_batch)
     except Exception as e:  # noqa: BLE001 — detection is best-effort
-        logging.warning("sparse-var detection failed (%s); treating all vars dense", e)
+        logging.warning(
+            "sparse-var detection failed (%s: %s); treating ALL vars dense — "
+            "Parallax will route embeddings to AllReduce and sparse wire "
+            "paths stay off; if the model has embedding tables, fix the "
+            "trace failure or mark them via VarInfo.sparse",
+            type(e).__name__, e)
         return set()
     jaxpr = closed.jaxpr
     flat_params, _ = tree_flatten_with_path(params)

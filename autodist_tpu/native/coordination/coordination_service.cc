@@ -32,7 +32,22 @@
 //                                                      step <= minstep+stale)
 //   HEARTBEAT <worker>        -> OK
 //   DEADLIST <timeout_s>      -> VAL <w1,w2,...> | NONE
+//   BPUT <key> <ver> <b64>    -> OK                   (versioned blob store:
+//                                                      async-PS value serving)
+//   BGET <key>                -> BVAL <ver> <b64> | NONE
+//   QPUSH <q> <b64>           -> OK                   (FIFO blob queue:
+//                                                      async-PS grad push)
+//   QPOP <q>                  -> QVAL <b64> | NONE
+//   QLEN <q>                  -> VAL <n>
 //   SHUTDOWN                  -> OK (then exits)
+//
+// The blob commands are the wire of the ASYNC parameter-server path
+// (autodist_tpu/runtime/ps_service.py): the owner publishes versioned
+// parameter blobs with BPUT, workers fetch with BGET and push gradient
+// blobs with QPUSH, and the owner's apply thread drains with QPOP — the
+// role the reference's C++ ConditionalAccumulator + gRPC send/recv kernels
+// played for async PS (reference ps_synchronizer.py:556-633). Payloads are
+// base64 (the protocol stays newline-delimited text).
 
 #include <arpa/inet.h>
 #include <errno.h>
@@ -49,8 +64,10 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <deque>
 #include <map>
 #include <string>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -256,6 +273,32 @@ class Server {
         }
       }
       Reply(conn, dead.empty() ? "NONE" : "VAL " + dead);
+    } else if (cmd == "BPUT" && parts.size() == 4) {
+      blobs_[parts[1]] = {atol(parts[2].c_str()), parts[3]};
+      Reply(conn, "OK");
+    } else if (cmd == "BGET" && parts.size() == 2) {
+      auto it = blobs_.find(parts[1]);
+      if (it == blobs_.end()) {
+        Reply(conn, "NONE");
+      } else {
+        Reply(conn, "BVAL " + std::to_string(it->second.first) + " " +
+                        it->second.second);
+      }
+    } else if (cmd == "QPUSH" && parts.size() == 3) {
+      queues_[parts[1]].push_back(parts[2]);
+      Reply(conn, "OK");
+    } else if (cmd == "QPOP" && parts.size() == 2) {
+      auto it = queues_.find(parts[1]);
+      if (it == queues_.end() || it->second.empty()) {
+        Reply(conn, "NONE");
+      } else {
+        Reply(conn, "QVAL " + it->second.front());
+        it->second.pop_front();
+      }
+    } else if (cmd == "QLEN" && parts.size() == 2) {
+      auto it = queues_.find(parts[1]);
+      long n = (it == queues_.end()) ? 0 : static_cast<long>(it->second.size());
+      Reply(conn, "VAL " + std::to_string(n));
     } else if (cmd == "SHUTDOWN") {
       Reply(conn, "OK");
       Flush(conn);
@@ -302,6 +345,8 @@ class Server {
   bool shutdown_ = false;
   std::map<int, Conn> conns_;
   std::map<std::string, std::string> kv_;
+  std::map<std::string, std::pair<long, std::string>> blobs_;
+  std::map<std::string, std::deque<std::string>> queues_;
   std::map<std::string, long> counters_;
   std::map<std::string, std::vector<int>> barrier_waiters_;
   std::vector<Waiter> stale_waiters_;

@@ -163,9 +163,23 @@ void* adl_open(const char* path, uint64_t batch, int shuffle, uint64_t seed,
   uint64_t n_records, record_bytes;
   memcpy(&n_records, header + 4, 8);
   memcpy(&record_bytes, header + 12, 8);
-  if (batch == 0 || n_records < batch) {
+  if (batch == 0) {
+    fprintf(stderr, "adl_open: batch must be > 0\n");
+    close(fd);
+    return nullptr;
+  }
+  if (n_records < batch) {
     fprintf(stderr, "adl_open: batch %llu > records %llu\n",
             (unsigned long long)batch, (unsigned long long)n_records);
+    close(fd);
+    return nullptr;
+  }
+  if (n_records > UINT32_MAX) {
+    // the epoch permutation stores uint32 indices; silently wrapping would
+    // sample the wrong records
+    fprintf(stderr,
+            "adl_open: n_records %llu exceeds 2^32-1 (perm index width)\n",
+            (unsigned long long)n_records);
     close(fd);
     return nullptr;
   }

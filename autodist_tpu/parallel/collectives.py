@@ -34,6 +34,7 @@ class Bucket:
     sizes: List[int]
     dtype: str
     compressor_name: str
+    spec: str = "AUTO"              # AUTO | ICI | DCN communication hint
 
     @property
     def total_size(self) -> int:
@@ -58,28 +59,33 @@ def make_buckets(ar_vars: Dict[str, object], var_infos) -> Tuple[List[Bucket], D
             per_var[name] = comp
             continue
         dtype = var_infos[name].dtype
-        groups.setdefault((sync.group, comp, dtype), []).append(name)
+        spec = getattr(sync, "spec", "AUTO")
+        groups.setdefault((sync.group, comp, dtype, spec), []).append(name)
     buckets = []
-    for (gid, comp, dtype), names in sorted(groups.items(), key=lambda kv: kv[0][:2]):
+    for (gid, comp, dtype, spec), names in sorted(groups.items(),
+                                                  key=lambda kv: kv[0][:2] + kv[0][3:]):
         # deterministic in-bucket order by md5 instance key (reference parity)
         names = sorted(names, key=CollectiveKey.instance_key)
         shapes = [tuple(var_infos[n].shape) for n in names]
         sizes = [int(np.prod(s or (1,))) for s in shapes]
         buckets.append(Bucket(
-            key="g%d_%s_%s" % (gid, comp, dtype), var_names=names,
-            shapes=shapes, sizes=sizes, dtype=dtype, compressor_name=comp))
+            key="g%d_%s_%s_%s" % (gid, comp, dtype, spec), var_names=names,
+            shapes=shapes, sizes=sizes, dtype=dtype, compressor_name=comp,
+            spec=spec))
     return buckets, per_var
 
 
 def bucket_reduce(bucket: Bucket, grads: Dict[str, jnp.ndarray], state, psum,
-                  num_replicas: int, ring_axis=None, ring_size: int = 1):
+                  num_replicas: int, ring_axes: Tuple[Tuple[str, int], ...] = ()):
     """Concat -> compress+psum -> mean -> split. Returns (synced dict, state).
-    ``ring_axis``/``ring_size`` arm int8 compressors' explicit quantized
-    ring when the reduction runs over a single mesh axis."""
+    ``ring_axes`` — ((axis_name, size), ...) — arms int8 compressors'
+    explicit quantized ring; multi-axis reductions run one ring per axis
+    sequentially, keeping the 4x wire compression on dp x sp / dp x tp
+    meshes."""
     flat = jnp.concatenate([grads[n].reshape(-1) for n in bucket.var_names])
     comp = bucket.make_compressor()
-    if isinstance(comp, compressor_lib.Int8Compressor) and ring_axis and ring_size > 1:
-        comp.ring_axis, comp.ring_size = ring_axis, ring_size
+    if isinstance(comp, compressor_lib.Int8Compressor) and ring_axes:
+        comp.ring_axes = tuple((a, n) for a, n in ring_axes if n > 1)
     reduced, new_state = comp.reduce(flat, state, psum)
     reduced = reduced / num_replicas
     out = {}
@@ -153,3 +159,49 @@ def int8_ring_all_reduce(x, axis_name: str, n: int):
     out0 = jnp.zeros_like(xp).at[own].set(_dequant_i8(q0, s0))
     out, _, _ = jax.lax.fori_loop(1, n, ag_body, (out0, q0, s0))
     return out.reshape(-1)[:L]
+
+
+def int8_multi_axis_all_reduce(x, axes_sizes):
+    """Sum a flat f32 vector over MULTIPLE mesh axes with int8 wire payload:
+    one quantized ring per axis, sequentially — ring over axis 1 reduces
+    within each axis-2 fiber, then ring over axis 2 combines the partials
+    (the standard decomposition of a multi-axis all-reduce). Requantization
+    noise accumulates once per stage; pair with error feedback for training.
+    This is what keeps AutoStrategy's int8 candidate honest on dp x sp /
+    dp x tp meshes instead of silently degrading to bf16."""
+    for axis, n in axes_sizes:
+        if n > 1:
+            x = int8_ring_all_reduce(x, axis, n)
+    return x
+
+
+# ----------------------------------------------- hierarchical (DCN) psum
+
+
+def hierarchical_psum(x, ici_axes, dcn_axes):
+    """Bandwidth-hierarchy-aware sum: reduce-scatter over the fast ICI
+    axes, all-reduce only the 1/N_ici shard over the slow DCN axes, then
+    all-gather over ICI — the cross-slice wire carries 1/N_ici of the
+    payload instead of all of it. This is what the strategy's ``spec=DCN``
+    hint lowers to (the reference consumed its AUTO/NCCL/RING equivalent
+    server-side, ``proto/synchronizers.proto:37-44``)."""
+    ici_axes = tuple(ici_axes)
+    dcn_axes = tuple(dcn_axes)
+    if not dcn_axes:
+        return jax.lax.psum(x, ici_axes)
+    if not ici_axes:
+        return jax.lax.psum(x, dcn_axes)
+    n_ici = 1
+    for a in ici_axes:
+        n_ici *= jax.lax.axis_size(a)
+    shape = x.shape
+    flat = x.reshape(-1)
+    L = flat.shape[0]
+    pad = (-L) % n_ici
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    shard = jax.lax.psum_scatter(flat, ici_axes, scatter_dimension=0,
+                                 tiled=True)
+    shard = jax.lax.psum(shard, dcn_axes)
+    full = jax.lax.all_gather(shard, ici_axes, axis=0, tiled=True)
+    return full[:L].reshape(shape)

@@ -67,6 +67,34 @@ def host_to_mesh(mesh: Mesh, value, pspec) -> jax.Array:
     return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
 
 
+def dcn_axes(mesh: Mesh) -> tuple:
+    """Mesh axes that cross process (host) boundaries — the axes whose
+    collectives ride DCN rather than ICI. Detected from the device layout
+    (process_index varies along the axis); ``ADT_DCN_AXES`` (comma list)
+    overrides for single-process tests and exotic topologies."""
+    ov = const.ENV.ADT_DCN_AXES.val
+    if ov:
+        names = [a.strip() for a in ov.split(",") if a.strip()]
+        return tuple(a for a in names if a in mesh.axis_names)
+    procs = np.vectorize(lambda d: d.process_index)(mesh.devices)
+    out = []
+    for i, name in enumerate(mesh.axis_names):
+        if procs.min(axis=i).tolist() != procs.max(axis=i).tolist():
+            out.append(name)
+    return tuple(out)
+
+
+def local_mesh(backend: Optional[str] = None,
+               axes: Optional[Dict[str, int]] = None) -> Mesh:
+    """Mesh over THIS process's devices only — the between-graph replication
+    substrate for async PS (no cross-process collectives; processes couple
+    only through the parameter service, reference
+    ``ps_synchronizer.py:556-633`` semantics)."""
+    devs = sorted(jax.local_devices(backend=backend) if backend
+                  else jax.local_devices(), key=lambda d: d.id)
+    return build_mesh(devices=devs, axes=axes)
+
+
 def mesh_from_strategy(strategy, resource_spec=None, backend: Optional[str] = None) -> Mesh:
     """Mesh for a compiled Strategy: replicas define the data axis; the
     optional ``mesh_shape`` extension adds model/pipeline/sequence axes."""

@@ -39,6 +39,7 @@ device arrays.
 """
 import dataclasses
 import functools
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -222,13 +223,6 @@ def plan_host_ps(strategy, var_infos) -> Dict[str, PSVarPlan]:
                 sync=sync_cfg.sync,
                 staleness=sync_cfg.staleness,
                 sparse=info.sparse)
-    for p in plans.values():
-        if not p.sync:
-            logging.warning(
-                "var %s: async PS (sync=False) requires the serving PS mode "
-                "(multi-process + coordination service); in this "
-                "configuration updates apply synchronously", p.var_name)
-            break
     return plans
 
 
@@ -259,6 +253,14 @@ class PSStore:
         self._cpu = jax.local_devices(backend="cpu")[0]
         self.stats = {"pulls": 0, "pushes": 0, "applies": 0,
                       "bytes_pulled": 0, "bytes_pushed": 0}
+        self._serve_groups: Optional[Dict[str, dict]] = None
+        self._serve_config = None
+        self._my_pushes = 0
+        self._warned_sync_fallback = False
+        # guards value/opt swaps vs concurrent reads: the async apply
+        # thread must never expose a var whose shards span two versions
+        import threading
+        self._lock = threading.Lock()
         # jit cache for the per-shard host update (keyed by shape/dtype via
         # jit's own cache); compiled for CPU so PS updates never touch HBM
         self._apply = jax.jit(self._apply_impl, donate_argnums=(0, 1))
@@ -292,6 +294,8 @@ class PSStore:
                 self._opt[name] = [
                     self._optimizer.init({"v": jnp.asarray(s)})
                     for s in self._values[name]]
+        if self._serve_config is not None:
+            self._start_serving()
 
     def load_opt_from_full(self, full_opt_tree) -> None:
         """Rebuild per-shard optimizer state from a full-layout opt tree
@@ -336,35 +340,131 @@ class PSStore:
 
     # ------------------------------------------------------------- step i/o
 
-    def pull(self) -> Dict[str, np.ndarray]:
-        """Current full values, host-side (the workers' per-step PS read)."""
+    def _local_full(self, names=None) -> Dict[str, np.ndarray]:
         out = {}
-        for name, plan in self.plans.items():
-            shards = self._values[name]
-            full = (np.asarray(shards[0]) if len(shards) == 1
-                    else np.concatenate([np.asarray(s) for s in shards],
-                                        axis=plan.axis))
-            out[name] = full
-            self.stats["bytes_pulled"] += full.nbytes
+        for name in (names if names is not None else self.plans):
+            plan = self.plans[name]
+            with self._lock:
+                shards = list(self._values[name])
+            out[name] = (np.asarray(shards[0]) if len(shards) == 1
+                         else np.concatenate([np.asarray(s) for s in shards],
+                                             axis=plan.axis))
+        return out
+
+    def pull(self) -> Dict[str, np.ndarray]:
+        """Current full values, host-side (the workers' per-step PS read).
+        In serving (async) mode, values of groups owned by OTHER processes
+        are fetched from the service — the latest published version, no
+        barrier (the reference's async read-from-PS)."""
+        if self._serve_groups is None:
+            out = self._local_full()
+            for name in out:
+                self.stats["bytes_pulled"] += out[name].nbytes
+        else:
+            out = {}
+            for host, grp in self._serve_groups.items():
+                if grp["owned"]:
+                    out.update(self._local_full(grp["vars"]))
+                    continue
+                from autodist_tpu.runtime import ps_service as pss
+                deadline = time.monotonic() + 60.0
+                res = grp["service"].fetch()
+                while res is None:  # owner hasn't published yet
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            "async PS: owner %s never published" % host)
+                    time.sleep(0.002)
+                    res = grp["service"].fetch()
+                _version, blob = res
+                vals = pss.unpack_arrays(blob)
+                self.stats["bytes_pulled"] += len(blob)
+                out.update({n: vals[n] for n in grp["vars"]})
         self.stats["pulls"] += 1
         return out
 
     def push(self, grads: Dict[str, Any]) -> None:
-        """Apply mean-reduced gradients to the resident values (the PS-side
-        update op). Dense grads are full arrays; sparse grads are
-        ``(indices, values)`` pairs scatter-added into the shard's index
-        range (the reference's IndexedSlices split,
-        ``kernel/partitioner.py:660-684``)."""
-        with jax.default_device(self._cpu):
+        """Hand mean-reduced gradients to the PS. Mirror (sync) mode applies
+        locally — every process replays the identical deterministic update.
+        Serving (async) mode packs each owner group's gradients into a blob
+        and enqueues it on the owner's queue; the owner's apply thread
+        applies gradients one at a time (no barrier)."""
+        if self._serve_groups is None:
+            if self.any_async() and not self._warned_sync_fallback:
+                self._warned_sync_fallback = True
+                logging.warning(
+                    "async PS (sync=False) requested but serving is not "
+                    "wired (no AutoDist async build); applying synchronously")
+            host_grads = {}
             for name, g in grads.items():
+                if isinstance(g, tuple):
+                    host_grads[name] = tuple(np.asarray(jax.device_get(x))
+                                             for x in g)
+                    self.stats["bytes_pushed"] += sum(
+                        x.nbytes for x in host_grads[name])
+                else:
+                    host_grads[name] = np.asarray(jax.device_get(g))
+                    self.stats["bytes_pushed"] += host_grads[name].nbytes
+            self.apply_local(host_grads)
+        else:
+            from autodist_tpu.runtime import ps_service as pss
+            for host, grp in self._serve_groups.items():
+                payload = {}
+                for name in grp["vars"]:
+                    if name not in grads:
+                        continue
+                    g = grads[name]
+                    if isinstance(g, tuple):
+                        payload[name + "#idx"] = np.asarray(jax.device_get(g[0]))
+                        payload[name + "#vals"] = np.asarray(jax.device_get(g[1]))
+                    else:
+                        payload[name] = np.asarray(jax.device_get(g))
+                if not payload:
+                    continue
+                blob = pss.pack_arrays(payload)
+                self.stats["bytes_pushed"] += len(blob)
+                grp["service"].push_grads(blob)
+                # backpressure: an unbounded queue lets a fast worker stack
+                # gradients computed at ever-staler values (and diverge).
+                # The reference's async apply sat in the step's critical
+                # path; here the bound is explicit: at most ADT_PS_MAX_LAG
+                # blobs in flight (0 = unbounded, pure async).
+                from autodist_tpu import const as _const
+                max_lag = _const.ENV.ADT_PS_MAX_LAG.val
+                if max_lag > 0:
+                    deadline = time.monotonic() + 60.0
+                    while grp["service"].pending_grads() > max_lag:
+                        if time.monotonic() > deadline:
+                            logging.warning("async PS: owner %s queue stuck "
+                                            "above max lag", host)
+                            break
+                        time.sleep(0.001)
+            self._my_pushes += 1
+        self.stats["pushes"] += 1
+
+    def apply_local(self, grads: Dict[str, Any]) -> None:
+        """The PS-side update op: apply gradients to the resident shards
+        through the optimizer, on the host CPU. Dense grads are full
+        arrays; sparse grads are ``(indices, values)`` pairs — or their
+        packed ``name#idx``/``name#vals`` wire form — scatter-added into
+        the shard's index range (the reference's IndexedSlices split,
+        ``kernel/partitioner.py:660-684``)."""
+        items: Dict[str, Any] = {}
+        for name, g in grads.items():
+            if name.endswith("#idx"):
+                base = name[:-4]
+                items[base] = (g, grads[base + "#vals"])
+            elif name.endswith("#vals"):
+                continue
+            else:
+                items[name] = g
+        with jax.default_device(self._cpu):
+            for name, g in items.items():
                 plan = self.plans[name]
                 if isinstance(g, tuple):
-                    # wire accounting happens inside _densify (idx+vals are
-                    # what crossed device->host, not the dense array)
                     g = self._densify(name, plan, g)
                 else:
-                    g = np.asarray(jax.device_get(g))
-                    self.stats["bytes_pushed"] += g.nbytes
+                    g = np.asarray(g)
+                new_vals, new_opts = [], []
                 for si, (lo, hi) in enumerate(plan.shard_ranges()):
                     if plan.partitioned:
                         idx = [slice(None)] * g.ndim
@@ -375,19 +475,91 @@ class PSStore:
                     new_val, new_opt = self._apply(
                         jnp.asarray(self._values[name][si]),
                         self._opt[name][si], jnp.asarray(gs))
-                    self._values[name][si] = np.asarray(new_val)
-                    self._opt[name][si] = new_opt
+                    new_vals.append(np.asarray(new_val))
+                    new_opts.append(new_opt)
+                # swap ALL shards of the var at once: a concurrent reader
+                # must never see a value whose shards span two versions
+                with self._lock:
+                    self._values[name] = new_vals
+                    self._opt[name] = new_opts
                 self.stats["applies"] += 1
-        self.stats["pushes"] += 1
+
+    # ---------------------------------------------------- async PS serving
+
+    def enable_serving(self, service_for_host, my_host: str) -> None:
+        """Switch to serving (async) mode: variables are grouped by owner
+        host (``reduction_destination``); this process runs an apply loop
+        for the groups it owns and fetches the rest over the service — the
+        reference's sharded-PS deployment (one PS task per destination,
+        ``ps_synchronizer.py:636-762``). May be called before
+        ``init_params``; owner loops start once values exist."""
+        self._serve_config = (service_for_host, my_host)
+        if self._values:
+            self._start_serving()
+
+    def _start_serving(self) -> None:
+        from autodist_tpu.runtime import ps_service as pss
+        service_for_host, my_host = self._serve_config
+        if self._serve_groups is not None:  # re-init: restart owner loops
+            self.close()
+        groups: Dict[str, list] = {}
+        for name, plan in self.plans.items():
+            hosts = {d.split(":")[0] for d in plan.destinations if d}
+            if len(hosts) > 1:
+                logging.warning(
+                    "async PS: var %s has shards on multiple hosts %s; "
+                    "whole-var ownership goes to %s", name, sorted(hosts),
+                    sorted(hosts)[0])
+            host = sorted(hosts)[0] if hosts else my_host
+            groups.setdefault(host, []).append(name)
+        self._serve_groups = {}
+        for host, names in sorted(groups.items()):
+            svc = service_for_host(host)
+            owned = (host == my_host)
+            grp = {"vars": sorted(names), "service": svc, "owned": owned,
+                   "worker": None}
+            if owned:
+                grp["worker"] = pss.AsyncPSWorker(
+                    svc, self.apply_local,
+                    functools.partial(self._local_full, grp["vars"])).start()
+            self._serve_groups[host] = grp
+        logging.info("async PS serving: %d owner groups, this process (%s) "
+                     "owns %s", len(self._serve_groups), my_host,
+                     [h for h, g in self._serve_groups.items() if g["owned"]])
+
+    @property
+    def serving(self) -> bool:
+        return self._serve_groups is not None
+
+    def applied_total(self) -> int:
+        """Gradient blobs applied by this process's owner loops."""
+        if self._serve_groups is None:
+            return self.stats["applies"]
+        return sum(g["worker"].applied for g in self._serve_groups.values()
+                   if g["worker"] is not None)
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Wait for this process's owner queues to empty (checkpoints)."""
+        if self._serve_groups is None:
+            return
+        for grp in self._serve_groups.values():
+            if grp["worker"] is not None:
+                grp["worker"].drain(timeout)
+
+    def close(self) -> None:
+        if self._serve_groups is not None:
+            for grp in self._serve_groups.values():
+                if grp["worker"] is not None:
+                    grp["worker"].stop()
 
     def _densify(self, name: str, plan: PSVarPlan, pair) -> np.ndarray:
-        """(indices, values) -> dense mean gradient for the full var."""
+        """(indices, values) -> dense mean gradient for the full var.
+        Wire accounting happens at the push site (idx+vals are what crossed
+        the wire), not here."""
         idx, vals = pair
         idx = np.asarray(jax.device_get(idx)).reshape(-1)
         vals = np.asarray(jax.device_get(vals))
         vals = vals.reshape(idx.shape[0], -1)
-        # wire accounting: what actually crossed device->host
-        self.stats["bytes_pushed"] += idx.nbytes + vals.nbytes
         shape = tuple(self._var_infos[name].shape)
         dense = np.zeros(shape, vals.dtype).reshape(shape[0], -1)
         np.add.at(dense, idx, vals)
@@ -396,13 +568,24 @@ class PSStore:
     # ---------------------------------------------------------- checkpoints
 
     def full_values(self) -> Dict[str, np.ndarray]:
-        """Like :meth:`pull` but for checkpoints — does not count as wire."""
+        """Like :meth:`pull` but for checkpoints — does not count as wire.
+        In serving mode, non-owned groups come from the owner's latest
+        published version (the authoritative copy); the local stale mirror
+        is only the fallback when the owner has not published."""
+        if self._serve_groups is None:
+            return self._local_full()
+        from autodist_tpu.runtime import ps_service as pss
         out = {}
-        for name, plan in self.plans.items():
-            shards = self._values[name]
-            out[name] = (np.asarray(shards[0]) if len(shards) == 1
-                         else np.concatenate([np.asarray(s) for s in shards],
-                                             axis=plan.axis))
+        for host, grp in self._serve_groups.items():
+            if grp["owned"]:
+                out.update(self._local_full(grp["vars"]))
+                continue
+            res = grp["service"].fetch()
+            if res is None:
+                out.update(self._local_full(grp["vars"]))  # pre-publish
+            else:
+                vals = pss.unpack_arrays(res[1])
+                out.update({n: vals[n] for n in grp["vars"] if n in vals})
         return out
 
     def full_opt_leaf(self, slot_path: str, var_name: str):

@@ -77,8 +77,17 @@ class Remapper:
                     return leaf
                 if self._fully_addressable:
                     return jax.device_put(leaf, want)
-                # multi-process: fall through to the host-global path
-                # (make_array_from_callback), which every process can run
+                if not leaf.is_fully_addressable:
+                    # a multi-process global array with the WRONG sharding
+                    # cannot be read back host-side (np.asarray raises on
+                    # non-addressable shards) — tell the caller what to do
+                    raise ValueError(
+                        "feed %s is a multi-process global array with "
+                        "sharding %s (want %s); feed host numpy arrays, or "
+                        "pre-place with Remapper.remap_feed's target "
+                        "sharding" % (np.shape(leaf), leaf.sharding, want))
+                # process-local device array: re-place via the host-global
+                # path (make_array_from_callback), which every process runs
             return self._place(np.asarray(leaf), spec)
         return jax.tree_util.tree_map(place, batch)
 

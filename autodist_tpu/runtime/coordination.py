@@ -138,6 +138,38 @@ class CoordinationClient:
     def heartbeat(self, worker: str):
         assert self._cmd("HEARTBEAT %s" % worker) == "OK"
 
+    # ---- versioned blobs + FIFO queues (the async-PS wire; payloads are
+    #      raw bytes, base64'd on the line protocol)
+
+    def bput(self, key: str, version: int, payload: bytes):
+        import base64
+        b64 = base64.b64encode(payload).decode()
+        assert self._cmd("BPUT %s %d %s" % (key, version, b64)) == "OK"
+
+    def bget(self, key: str):
+        """-> (version, payload bytes) or None."""
+        import base64
+        resp = self._cmd("BGET %s" % key)
+        if resp == "NONE":
+            return None
+        _, ver, b64 = resp.split(" ", 2)
+        return int(ver), base64.b64decode(b64)
+
+    def qpush(self, queue: str, payload: bytes):
+        import base64
+        b64 = base64.b64encode(payload).decode()
+        assert self._cmd("QPUSH %s %s" % (queue, b64)) == "OK"
+
+    def qpop(self, queue: str):
+        import base64
+        resp = self._cmd("QPOP %s" % queue)
+        if resp == "NONE":
+            return None
+        return base64.b64decode(resp[5:])
+
+    def qlen(self, queue: str) -> int:
+        return int(self._cmd("QLEN %s" % queue)[4:])
+
     def dead_workers(self, timeout_s: float) -> List[str]:
         resp = self._cmd("DEADLIST %s" % timeout_s)
         return [] if resp == "NONE" else resp[4:].split(",")

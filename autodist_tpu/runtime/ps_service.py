@@ -1,0 +1,230 @@
+"""Async parameter-server serving over the native coordination service.
+
+The reference implements asynchronous PS with C++ graph kernels: each
+worker's update op pushes its gradient into a per-worker
+``ConditionalAccumulator`` on the PS and applies without waiting for peers
+(reference ``autodist/kernel/synchronization/ps_synchronizer.py:556-633``).
+On TPU, async training cannot ride XLA collectives — they are lockstep by
+construction — so the async wire is the native coordination service
+(``native/coordination/coordination_service.cc``): the variable's owner
+publishes versioned parameter blobs (``BPUT``), workers fetch the latest
+(``BGET``) and push gradient blobs into a FIFO (``QPUSH``), and the owner's
+apply thread drains the queue (``QPOP``), applying each worker's gradient
+individually through the host store's optimizer — one gradient at a time,
+no averaging barrier, exactly the reference's async semantics.
+
+Under async PS every process runs its OWN local device mesh (the
+reference's between-graph replication): gradients aggregate across local
+replicas with local collectives, and the only cross-process coupling is
+this service. Fetches always take the latest published version (pure
+async, the reference's ``sync=False`` semantics); the only pacing is the
+``ADT_PS_MAX_LAG`` backpressure bound on each owner queue. Bounded
+staleness (``staleness=s``) belongs to SYNC training (the Runner's
+coordination-service step window) and is rejected for async strategies.
+
+``LocalPSService`` is the in-process degenerate case (single-process async:
+the apply thread still decouples gradient application from stepping).
+"""
+import collections
+import struct
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from autodist_tpu.utils import logging
+
+_MAGIC = b"ADPS"
+
+
+def pack_arrays(arrays: Dict[str, np.ndarray]) -> bytes:
+    """Self-describing binary packing of a {name: ndarray} dict.
+
+    Layout: magic, count, then per entry: name_len/name/dtype_len/dtype/
+    ndim/shape.../raw bytes. Names are sorted for determinism."""
+    out = [_MAGIC, struct.pack("<I", len(arrays))]
+    for name in sorted(arrays):
+        arr = np.ascontiguousarray(arrays[name])
+        nb = name.encode()
+        dt = arr.dtype.str.encode()
+        out.append(struct.pack("<H", len(nb)))
+        out.append(nb)
+        out.append(struct.pack("<H", len(dt)))
+        out.append(dt)
+        out.append(struct.pack("<B", arr.ndim))
+        out.append(struct.pack("<%dq" % arr.ndim, *arr.shape))
+        out.append(arr.tobytes())
+    return b"".join(out)
+
+
+def unpack_arrays(blob: bytes) -> Dict[str, np.ndarray]:
+    if blob[:4] != _MAGIC:
+        raise ValueError("not an ADPS blob")
+    off = 4
+    (count,) = struct.unpack_from("<I", blob, off)
+    off += 4
+    out = {}
+    for _ in range(count):
+        (nlen,) = struct.unpack_from("<H", blob, off)
+        off += 2
+        name = blob[off:off + nlen].decode()
+        off += nlen
+        (dlen,) = struct.unpack_from("<H", blob, off)
+        off += 2
+        dtype = np.dtype(blob[off:off + dlen].decode())
+        off += dlen
+        (ndim,) = struct.unpack_from("<B", blob, off)
+        off += 1
+        shape = struct.unpack_from("<%dq" % ndim, blob, off)
+        off += 8 * ndim
+        size = int(np.prod(shape or (1,))) * dtype.itemsize
+        out[name] = np.frombuffer(blob, dtype, count=int(np.prod(shape or (1,))),
+                                  offset=off).reshape(shape).copy()
+        off += size
+    return out
+
+
+class PSServiceBase:
+    """The wire the async PS path talks over (publish/fetch values, push/pop
+    gradient blobs)."""
+
+    def publish(self, version: int, blob: bytes) -> None:
+        raise NotImplementedError
+
+    def fetch(self) -> Optional[Tuple[int, bytes]]:
+        raise NotImplementedError
+
+    def push_grads(self, blob: bytes) -> None:
+        raise NotImplementedError
+
+    def pop_grads(self) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def pending_grads(self) -> int:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class LocalPSService(PSServiceBase):
+    """In-process service (single-process async PS; also the unit-test
+    harness for the serving protocol)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._published: Optional[Tuple[int, bytes]] = None
+        self._queue = collections.deque()
+
+    def publish(self, version, blob):
+        with self._lock:
+            self._published = (version, blob)
+
+    def fetch(self):
+        with self._lock:
+            return self._published
+
+    def push_grads(self, blob):
+        with self._lock:
+            self._queue.append(blob)
+
+    def pop_grads(self):
+        with self._lock:
+            return self._queue.popleft() if self._queue else None
+
+    def pending_grads(self):
+        with self._lock:
+            return len(self._queue)
+
+
+class CoordPSService(PSServiceBase):
+    """Serving over the native coordination service. ``prefix`` isolates
+    concurrent jobs on one service. Each talking thread needs its own
+    socket; clients are created per-thread via the factory."""
+
+    def __init__(self, client_factory: Callable, prefix: str = "ps"):
+        self._factory = client_factory
+        self._local = threading.local()
+        self._prefix = prefix
+
+    def _client(self):
+        if not hasattr(self._local, "client"):
+            self._local.client = self._factory()
+        return self._local.client
+
+    def publish(self, version, blob):
+        self._client().bput(self._prefix + "/vals", version, blob)
+
+    def fetch(self):
+        return self._client().bget(self._prefix + "/vals")
+
+    def push_grads(self, blob):
+        self._client().qpush(self._prefix + "/grads", blob)
+
+    def pop_grads(self):
+        return self._client().qpop(self._prefix + "/grads")
+
+    def pending_grads(self):
+        return self._client().qlen(self._prefix + "/grads")
+
+
+class AsyncPSWorker:
+    """The owner-side apply loop: drain gradient blobs, apply each through
+    ``apply_fn``, republish ``values_fn()`` (the reference's per-worker
+    accumulator apply, one gradient at a time — no barrier)."""
+
+    def __init__(self, service: PSServiceBase, apply_fn: Callable,
+                 values_fn: Callable, poll_s: float = 0.002):
+        self._apply_fn = apply_fn
+        self._values_fn = values_fn
+        self._service = service
+        self._poll_s = poll_s
+        self._stop = threading.Event()
+        self._applied = 0
+        self._busy = False  # a blob is popped but not yet applied
+        self._thread = threading.Thread(target=self._loop,
+                                        name="adt-ps-apply", daemon=True)
+
+    def start(self):
+        # initial publish so workers can fetch before the first apply
+        self._service.publish(0, pack_arrays(self._values_fn()))
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set():
+            # busy is raised BEFORE the pop: a drain() racing the pop must
+            # never observe (queue empty, not busy) while a blob is in hand
+            self._busy = True
+            blob = self._service.pop_grads()
+            if blob is None:
+                self._busy = False
+                time.sleep(self._poll_s)
+                continue
+            try:
+                self._apply_fn(unpack_arrays(blob))
+                self._applied += 1
+                self._service.publish(
+                    self._applied, pack_arrays(self._values_fn()))
+            except Exception as e:  # noqa: BLE001 — a poisoned blob must not kill the loop
+                logging.error("async PS apply failed: %s", e)
+            finally:
+                self._busy = False
+
+    @property
+    def applied(self) -> int:
+        return self._applied
+
+    def drain(self, timeout: float = 30.0) -> int:
+        """Block until the queue is empty and applied (tests/checkpoints)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._service.pending_grads() == 0 and not self._busy:
+                return self._applied
+            time.sleep(self._poll_s)
+        raise TimeoutError("async PS queue did not drain")
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)

@@ -38,8 +38,10 @@ class Runner:
         self._coord = None
         self._staleness = int(distributed_step.metadata.get("staleness", 0))
         # bounded-staleness pacing is a cross-process property; within one
-        # SPMD program all replicas are already lockstep
-        if self._staleness > 0 and const.ENV.ADT_NUM_PROCESSES.val > 1:
+        # SPMD program all replicas are already lockstep. Async PS paces
+        # itself through the parameter service (no step barrier at all).
+        if (self._staleness > 0 and const.ENV.ADT_NUM_PROCESSES.val > 1
+                and not distributed_step.metadata.get("async")):
             self._coord = self._connect_coordination()
 
     def _connect_coordination(self):

@@ -67,6 +67,20 @@ class CostModel:
 
     # ---------------------------------------------------------------- pieces
 
+    def _example_batch_size(self) -> int:
+        """Leading dim of the example batch (the real batch size), falling
+        back to 32 only when no batch is attached."""
+        try:
+            import jax
+            leaves = jax.tree_util.tree_leaves(self._item.example_batch)
+            for leaf in leaves:
+                shape = getattr(leaf, "shape", ())
+                if len(shape) >= 1 and shape[0] > 0:
+                    return int(shape[0])
+        except Exception:  # noqa: BLE001
+            pass
+        return 32
+
     def flops_per_step(self) -> float:
         if self._flops is not None:
             return self._flops
@@ -77,7 +91,10 @@ class CostModel:
                 self._item.params, self._item.example_batch)
             fwd = count_flops_estimate(closed.jaxpr)
         except Exception:  # noqa: BLE001 — fall back to a params-based bound
-            fwd = 6.0 * self._item.total_bytes() / 4 * 32  # 2*params*batch~32
+            # dense fwd ~ 2 * params * batch (the REAL batch size, not a
+            # guess — a hardcoded 32 misranks compute- vs comm-bound
+            # candidates for large-batch CNNs)
+            fwd = 2.0 * (self._item.total_bytes() / 4) * self._example_batch_size()
         self._flops = 3.0 * fwd  # fwd + ~2x bwd
         return self._flops
 
@@ -85,8 +102,7 @@ class CostModel:
         peak = CHIP_PEAK_FLOPS[self._chip] * self._eff
         return self.flops_per_step() / max(num_devices, 1) / peak
 
-    def _wire_bytes(self, info, sync, ring_eligible: bool = True,
-                    compressed: bool = True) -> float:
+    def _wire_bytes(self, info, sync, compressed: bool = True) -> float:
         from autodist_tpu.kernel.synchronization import compressor as compressor_lib
         if not compressed:
             # partitioned/reduce-scatter syncs ignore compressors entirely
@@ -95,10 +111,6 @@ class CostModel:
             name, rank = compressor_lib.parse_name(getattr(sync, "compressor", ""))
         except ValueError:
             name, rank = getattr(sync, "compressor", ""), None
-        if name in ("Int8Compressor", "Int8CompressorEF") and not ring_eligible:
-            # the quantized ring only arms on single-axis meshes; elsewhere
-            # the wire degrades to bf16
-            return info.num_elements * COMPRESSED_BYTES["HorovodCompressor"]
         if name == "PowerSGDCompressor":
             if len(info.shape) >= 2:
                 # PowerSGD flattens trailing dims to an n x m matrix and
@@ -117,8 +129,8 @@ class CostModel:
 
     def estimate(self, strategy: Strategy) -> CostBreakdown:
         n = max(len(strategy.graph_config.replicas), 1)
-        mesh_shape = strategy.graph_config.mesh_shape
-        ring_eligible = not (mesh_shape and len(mesh_shape) > 1)
+        # int8 rings run per-axis on multi-axis meshes (sequential rings),
+        # so compression no longer degrades off single-axis meshes
         infos = self._item.var_infos
         ici_bw = self._spec.ici_bandwidth_gbps() * 1e9 / 8  # bytes/s
         # cross-host PS traffic rides the node NICs
@@ -139,13 +151,13 @@ class CostModel:
             for sync in syncs:
                 if isinstance(sync, AllReduceSynchronizer):
                     ar_bytes += self._wire_bytes(
-                        info, sync, ring_eligible,
+                        info, sync,
                         compressed=not partitioned) / max(len(syncs), 1)
                     groups.add(sync.group)
                 elif isinstance(sync, PSSynchronizer):
                     dest = sync.reduction_destination.split(":")[0] or "ps"
                     ps_load[dest] = ps_load.get(dest, 0.0) + (
-                        self._wire_bytes(info, sync, ring_eligible,
+                        self._wire_bytes(info, sync,
                                          compressed=not partitioned)
                         / max(len(syncs), 1))
                     num_ps_transfers += 1

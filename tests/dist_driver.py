@@ -36,6 +36,9 @@ BUILDERS = {
     "PSStale": lambda: S.PS(staleness=2),
     # int8 quantized ring: ppermute hops cross the process boundary
     "AllReduceInt8": lambda: S.AllReduce(compressor="Int8CompressorEF"),
+    # fully-async PS: per-process local meshes, grads/values over the
+    # coordination service's blob queues (no cross-process collectives)
+    "PSAsync": lambda: S.PS(sync=False),
 }
 
 

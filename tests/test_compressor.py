@@ -190,3 +190,125 @@ def test_int8_resume_bitexact(tmp_path):
         runner.run(batch)
     b = runner.gather_params()
     np.testing.assert_array_equal(np.asarray(a["w"]), np.asarray(b["w"]))
+
+
+def test_int8_multi_axis_ring_matches_sum():
+    """Sequential per-axis quantized rings on a 2-axis (4x2) mesh: the
+    result approximates the full 8-way sum (VERDICT r1: int8 must not
+    silently degrade to bf16 on dp x sp / dp x tp meshes)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from autodist_tpu.parallel.collectives import int8_multi_axis_all_reduce
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("data", "seq"))
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 33).astype(np.float32)
+
+    out = jax.jit(jax.shard_map(
+        lambda x: int8_multi_axis_all_reduce(
+            x.reshape(-1), (("data", 4), ("seq", 2))),
+        mesh=mesh, in_specs=P(("data", "seq")), out_specs=P(),
+        check_vma=False))(xs)
+    want = xs.sum(axis=0)
+    # two quantization stages: tolerance ~2x the single-ring bound
+    scale = np.abs(xs).sum(axis=0).max()
+    np.testing.assert_allclose(np.asarray(out), want,
+                               atol=4 * scale / 127.0, rtol=0.1)
+
+
+def test_int8_bucket_armed_on_two_axis_mesh():
+    """Through the full stack on a dp x seq mesh, the int8 bucket must run
+    the explicit ring (ppermute in the lowered program), not the bf16
+    psum fallback."""
+    import autodist_tpu as adt
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(8, 4) * 0.1, jnp.float32)}
+
+    def loss_fn(p, b):
+        return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+    batch = {"x": rng.randn(16, 8).astype(np.float32),
+             "y": rng.randn(16, 4).astype(np.float32)}
+    from autodist_tpu.strategy.base import (AllReduceSynchronizer, GraphConfig,
+                                            Strategy, VarConfig)
+    from autodist_tpu.strategy.base import StrategyBuilder
+
+    class Int8TwoAxis(StrategyBuilder):
+        def build(self, model_item, resource_spec):
+            return Strategy(
+                node_config=[VarConfig(
+                    var_name="w",
+                    synchronizer=AllReduceSynchronizer(
+                        compressor="Int8CompressorEF"))],
+                graph_config=GraphConfig(
+                    replicas=[d.name_string() for d in resource_spec.devices],
+                    mesh_shape={"data": 4, "seq": 2}))
+
+    ad = adt.AutoDist(strategy_builder=Int8TwoAxis())
+    runner = ad.build(loss_fn, optax.sgd(0.1), params, batch)
+    runner.init(params)
+    sharded = runner.remapper.remap_feed(batch)
+    hlo = runner.distributed_step.lowered_text(runner.state, sharded)
+    assert "collective_permute" in hlo or "ppermute" in hlo, \
+        "int8 ring not armed on 2-axis mesh"
+    # and it trains
+    losses = [float(runner.run(batch)["loss"]) for _ in range(10)]
+    assert losses[-1] < losses[0]
+
+
+def test_hierarchical_psum_matches_plain():
+    """spec=DCN lowering: reduce-scatter/psum/all-gather equals one psum
+    numerically, and the lowered program carries the scatter+gather."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from autodist_tpu.parallel.collectives import hierarchical_psum
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("dcnaxis", "data"))
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 5, 3).astype(np.float32)
+
+    fn = jax.jit(jax.shard_map(
+        lambda x: hierarchical_psum(x.reshape(5, 3), ("data",), ("dcnaxis",)),
+        mesh=mesh, in_specs=P(("dcnaxis", "data")), out_specs=P(),
+        check_vma=False))
+    out = fn(xs)
+    np.testing.assert_allclose(np.asarray(out), xs.sum(axis=0), rtol=1e-5,
+                               atol=1e-5)
+    hlo = fn.lower(xs).as_text()
+    assert "reduce_scatter" in hlo and "all_gather" in hlo
+
+
+def test_spec_dcn_consumed_in_lowering(monkeypatch):
+    """An AllReduce strategy with spec=DCN on a 2-axis mesh (data marked
+    DCN via the override) must lower the gradient reduce hierarchically —
+    the spec hint is no longer dead metadata (VERDICT r1)."""
+    import autodist_tpu as adt
+    monkeypatch.setenv("ADT_DCN_AXES", "data")
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(8, 4) * 0.1, jnp.float32)}
+
+    def loss_fn(p, b):
+        return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+    batch = {"x": rng.randn(16, 8).astype(np.float32),
+             "y": rng.randn(16, 4).astype(np.float32)}
+    from autodist_tpu.strategy.base import (AllReduceSynchronizer, GraphConfig,
+                                            Strategy, StrategyBuilder,
+                                            VarConfig)
+
+    class DCNHint(StrategyBuilder):
+        def build(self, model_item, resource_spec):
+            return Strategy(
+                node_config=[VarConfig(
+                    var_name="w",
+                    synchronizer=AllReduceSynchronizer(spec="DCN"))],
+                graph_config=GraphConfig(
+                    replicas=[d.name_string() for d in resource_spec.devices],
+                    mesh_shape={"data": 4, "seq": 2}))
+
+    ad = adt.AutoDist(strategy_builder=DCNHint())
+    runner = ad.build(loss_fn, optax.sgd(0.1), params, batch)
+    runner.init(params)
+    sharded = runner.remapper.remap_feed(batch)
+    hlo = runner.distributed_step.lowered_text(runner.state, sharded)
+    assert "reduce_scatter" in hlo, "spec=DCN did not lower hierarchically"
+    losses = [float(runner.run(batch)["loss"]) for _ in range(5)]
+    assert losses[-1] < losses[0]

@@ -144,6 +144,47 @@ def test_two_process_extended_matrix(tmp_path, builder):
     _assert_pair_matches_reference(chief, worker, builder)
 
 
+def test_two_process_async_ps(tmp_path):
+    """PS(sync=False) across two real processes: each runs its OWN local
+    4-device mesh (between-graph replication — no cross-process
+    collectives); the chief owns every variable and serves values / applies
+    gradient blobs through the coordination service's BPUT/QPUSH wire (the
+    reference's async accumulator path, ps_synchronizer.py:556-633)."""
+    from autodist_tpu.runtime.coordination import (CoordinationClient,
+                                                   CoordinationServer)
+    svc_port = _free_port()
+    srv = CoordinationServer(port=svc_port)
+    srv.start()
+    try:
+        old = os.environ.get("ADT_COORDSVC_PORT")
+        os.environ["ADT_COORDSVC_PORT"] = str(svc_port)
+        try:
+            chief, worker = _launch_pair(tmp_path, "PSAsync", n_steps=10,
+                                         external=True)
+        finally:
+            if old is None:
+                os.environ.pop("ADT_COORDSVC_PORT", None)
+            else:
+                os.environ["ADT_COORDSVC_PORT"] = old
+        for r in (chief, worker):
+            # local mesh: 4 devices per process, NOT one 8-device program
+            assert r["local_devices"] == 4
+            assert "async PS serving" in r["log"], r["log"][-2000:]
+            # async trajectories are process-specific; each must converge
+            assert r["losses"][-1] < r["losses"][0]
+        # the chief owned and applied gradient blobs: published version on
+        # the service counts applies (>= chief's own 5 steps; the worker's
+        # last pushes may legally land after the chief exits)
+        client = CoordinationClient("127.0.0.1", svc_port)
+        res = client.bget("ps:127.0.0.1/vals")
+        assert res is not None
+        version, _ = res
+        assert version >= 5, "chief applied fewer blobs than its own steps"
+        client.close()
+    finally:
+        srv.stop()
+
+
 def test_two_process_staleness_pacing(tmp_path):
     """PS(staleness=2) across two real processes: the Runner's pacing
     client reports steps/heartbeats to a live coordination service (the
