@@ -253,15 +253,27 @@ class GraphTransformer:
 
     # ---------------------------------------------------------------- helpers
 
-    def _build_synchronizers(self, layouts, ps_names=frozenset()) -> Dict[str, Synchronizer]:
+    def _build_synchronizers(self, layouts, ps_names=frozenset(),
+                             sparse_wire=frozenset()) -> Dict[str, Synchronizer]:
         """Per-variable synchronizer kernels from strategy node configs
         (reference ``graph_transformer.py:94-130``). Host-resident PS vars
         (``ps_names``) have no in-SPMD synchronizer — their gradient leaves
-        the device and the store applies the update."""
+        the device and the store applies the update. Sparse-wire vars sync
+        via the (ids, values) all-gather path in the lowering
+        (``ops/embedding.py``), not a dense collective."""
         syncs = {}
         for node in self._strategy.node_config:
             info = self._item.var_infos.get(node.var_name)
             if info is None:
+                continue
+            if node.var_name in sparse_wire:
+                comp = getattr(node.synchronizer, "compressor",
+                               "NoneCompressor")
+                if comp and comp != "NoneCompressor":
+                    logging.warning(
+                        "var %s: compressor %s ignored — sparse-wire "
+                        "gradients ship as (ids, values) pairs, already "
+                        "batch-sized", node.var_name, comp)
                 continue
             if node.var_name in ps_names:
                 continue
@@ -344,7 +356,95 @@ class GraphTransformer:
                      if a not in set(layouts[n].mp_axis_names))
             for n in mp_names}
 
-        syncs = self._build_synchronizers(layouts, ps_names)
+        # Sparse wire path (ops/embedding.py): gather-indexed vars whose
+        # lookups carry a matching name synchronize as (ids, values) pairs
+        # — batch-shaped wire instead of vocab-shaped (the reference's
+        # IndexedSlices all-gather, all_reduce_synchronizer.py:132-173).
+        from autodist_tpu.ops import embedding as embedding_lib
+        sparse_candidates = {
+            n for n, v in var_infos.items()
+            if v.sparse and v.trainable
+            and (n in ps_names
+                 or (not layouts[n].partitioned and not layouts[n].mp_axes))}
+        sparse_specs = {}
+        if sparse_candidates and item.loss_fn is not None:
+            loss_plain = (lambda p, b: item.loss_fn(p, b)[0]) if item.has_aux \
+                else item.loss_fn
+            # taps live INSIDE shard_map: discover against the per-device
+            # (local) batch shape, not the host-global one
+            g_batch_axes = tuple(
+                self._strategy.graph_config.batch_axes or (self._axis,))
+            bf = int(np.prod([self._mesh.shape[a] for a in g_batch_axes]))
+            sf = (int(self._mesh.shape[self._seq_axis])
+                  if self._seq_axis else 1)
+
+            def local_aval(leaf):
+                shape = list(np.shape(leaf))
+                if len(shape) >= 1 and shape[0] % bf == 0:
+                    shape[0] //= bf
+                if sf > 1 and len(shape) >= 2 and shape[1] % sf == 0:
+                    shape[1] //= sf
+                return jax.ShapeDtypeStruct(
+                    tuple(shape), np.asarray(leaf).dtype
+                    if not hasattr(leaf, "dtype") else leaf.dtype)
+            local_batch = jax.tree_util.tree_map(local_aval,
+                                                 item.example_batch)
+            discovered = set()
+            try:
+                sparse_specs = embedding_lib.discover(
+                    loss_plain, item.params, local_batch,
+                    sparse_candidates)
+                discovered = set(sparse_specs)
+                if sparse_specs:
+                    # a table with OTHER differentiable uses (tied output
+                    # embedding, weight sharing) gets a real dense gradient
+                    # the sparse wire would drop — keep those dense
+                    full_names, _, _ = variable_utils.flatten_named(
+                        item.params)
+                    safe = embedding_lib.safe_sparse_names(
+                        loss_plain, item.params, local_batch, sparse_specs,
+                        full_names)
+                    tied = sorted(set(sparse_specs) - safe)
+                    if tied:
+                        logging.warning(
+                            "sparse vars %s have dense gradient paths "
+                            "besides their lookups (tied embeddings?); "
+                            "keeping them on the dense sync path", tied)
+                    sparse_specs = {n: s for n, s in sparse_specs.items()
+                                    if n in safe}
+                # the wire only pays when the gathered (ids, values)
+                # payload undercuts the dense gradient (batch << vocab);
+                # small tables with large batches stay dense
+                keep = {}
+                for n, specs in sparse_specs.items():
+                    info = var_infos[n]
+                    feat = max(1, int(np.prod(info.shape[1:] or (1,))))
+                    rows = sum(int(np.prod(ids_shape or (1,)))
+                               for ids_shape, _d, _f, _fd in specs)
+                    sparse_bytes = rows * self.total_devices * (feat + 1)
+                    dense_bytes = int(info.shape[0]) * feat
+                    if sparse_bytes < dense_bytes:
+                        keep[n] = specs
+                    else:
+                        logging.debug(
+                            "var %s: sparse wire (%d) >= dense (%d) "
+                            "elements; keeping dense sync", n,
+                            sparse_bytes, dense_bytes)
+                sparse_specs = keep
+            except Exception as e:  # noqa: BLE001 — discovery is best-effort
+                sparse_specs = {}
+                logging.warning("sparse-wire discovery failed (%s); dense "
+                                "sync for all sparse vars", e)
+            uncaptured = sparse_candidates - discovered
+            if uncaptured:
+                logging.warning(
+                    "sparse vars %s not routed through "
+                    "ops.embedding.embedding_lookup(name=...); their "
+                    "gradients sync DENSE (vocab-sized wire)",
+                    sorted(uncaptured))
+        sparse_wire = frozenset(sparse_specs)
+
+        syncs = self._build_synchronizers(layouts, ps_names, sparse_wire)
         # Route unpartitioned AllReduce vars with an *active* compressor into
         # concat buckets (payload transform needs the merged vector).
         # NoneCompressor vars psum individually — XLA's all-reduce combiner
@@ -388,6 +488,14 @@ class GraphTransformer:
 
         # ----- the local (per-device) step executed under shard_map
         grad_fn = jax.value_and_grad(item.loss_fn, has_aux=item.has_aux)
+        if sparse_wire:
+            def loss_with_taps(full_params, taps, batch):
+                with embedding_lib.capture(taps) as cap:
+                    out = item.loss_fn(full_params, batch)
+                loss, aux = (out if item.has_aux else (out, None))
+                return loss, (aux, cap.ids)
+            sparse_grad_fn = jax.value_and_grad(
+                loss_with_taps, argnums=(0, 1), has_aux=True)
         optimizer = item.optimizer
         has_aux = item.has_aux
         axis = self._axis
@@ -406,7 +514,11 @@ class GraphTransformer:
             # holes so the user's loss sees the full original params tree
             full_params = (ps_lib.fill_holes(gathered, ps_vals)
                            if ps_names else gathered)
-            if has_aux:
+            if sparse_wire:
+                taps = embedding_lib.make_taps(sparse_specs)
+                (loss, (aux, ids_seen)), (grads, tap_grads) = sparse_grad_fn(
+                    full_params, taps, batch)
+            elif has_aux:
                 (loss, aux), grads = grad_fn(full_params, batch)
             else:
                 loss, grads = grad_fn(full_params, batch)
@@ -414,14 +526,30 @@ class GraphTransformer:
             g_names, g_leaves, _ = variable_utils.flatten_named(grads)
             g = dict(zip(g_names, g_leaves))
 
+            # sparse wire: per-var (ids, values) pairs, all-gathered across
+            # the mesh — batch-shaped payload instead of vocab-shaped
+            sparse_pairs = {}
+            for n in sorted(sparse_wire):
+                flat_ids, flat_vals = embedding_lib.flatten_pairs(
+                    ids_seen.get(n, []), tap_grads.get(n, []))
+                if N > 1:
+                    flat_ids, flat_vals = embedding_lib.gather_pairs(
+                        flat_ids, flat_vals, all_axes)
+                sparse_pairs[n] = (flat_ids, flat_vals / N)
+
             # PS gradients exit the device: mean-reduced, replicated, pushed
             # to the host store by the caller (the reference's grad push to
-            # the PS accumulator, ps_synchronizer.py:556-633)
-            if N == 1:
-                ps_grads = {n: g[n] for n in sorted(ps_names)}
-            else:
-                ps_grads = {n: jax.lax.psum(g[n], all_axes) / N
-                            for n in sorted(ps_names)}
+            # the PS accumulator, ps_synchronizer.py:556-633); sparse PS
+            # vars ship the (ids, values) pair itself — the store
+            # scatter-adds into each owner shard's index range
+            ps_grads = {}
+            for n in sorted(ps_names):
+                if n in sparse_pairs:
+                    ps_grads[n] = sparse_pairs[n]
+                elif N == 1:
+                    ps_grads[n] = g[n]
+                else:
+                    ps_grads[n] = jax.lax.psum(g[n], all_axes) / N
 
             sync_state = dict(state.sync_state) if isinstance(state.sync_state, dict) else {}
             new_bucket_state = dict(sync_state.get("bucket", {}))
@@ -434,7 +562,8 @@ class GraphTransformer:
                 # would only insert degenerate all-reduces that block fusion
                 # (compressor states pass through unchanged)
                 synced = {n: (jnp.zeros_like(v) if n in frozen_names else v)
-                          for n, v in g.items() if n not in ps_names}
+                          for n, v in g.items()
+                          if n not in ps_names and n not in sparse_wire}
 
             # model-parallel vars: mean over the complement axes only; the /N
             # (total devices) normalization is exact — shard_map AD transposes
@@ -447,6 +576,18 @@ class GraphTransformer:
                     continue
                 comp = mp_complement[n]
                 synced[n] = (jax.lax.psum(g[n], comp) if comp else g[n]) / N
+
+            # sparse AllReduce vars: densify AFTER the wire (local
+            # scatter-add of the gathered pairs — reference
+            # all_reduce_synchronizer.py:132-173's conversion back)
+            for n in sorted(sparse_wire):
+                if n in ps_names:
+                    continue
+                info = var_infos[n]
+                s_ids, s_vals = sparse_pairs[n]
+                synced[n] = embedding_lib.scatter_add_dense(
+                    s_ids, s_vals, int(info.shape[0]),
+                    tuple(info.shape[1:]))
 
             for b in (buckets if N > 1 else []):
                 bst = new_bucket_state.get(b.key)
@@ -516,6 +657,9 @@ class GraphTransformer:
         opt_state_spec = (jax.eval_shape(item.optimizer.init, holed_params)
                           if ps_names else item.opt_state_spec)
         ps_specs = {n: P() for n in sorted(ps_names)}
+        # sparse PS grads leave as (ids, values) pairs, both replicated
+        ps_out_specs = {n: ((P(), P()) if n in sparse_wire else P())
+                        for n in sorted(ps_names)}
         opt_layout_tree = variable_utils.map_state_layouts(
             opt_state_spec, var_infos, layouts, VarLayout(name=""))
         opt_specs = _tree_map_layouts(lambda _leaf, lay: lay.pspec,
@@ -571,7 +715,8 @@ class GraphTransformer:
         sharded = jax.shard_map(
             local_step, mesh=self._mesh,
             in_specs=(state_specs, ps_specs, batch_specs),
-            out_specs=(state_specs, ps_specs, metric_specs), check_vma=False)
+            out_specs=(state_specs, ps_out_specs, metric_specs),
+            check_vma=False)
         step_fn = jax.jit(sharded, donate_argnums=(0,) if self._donate else ())
         step_fn_nodonate = jax.jit(sharded) if self._donate else step_fn
         eval_fn = jax.jit(jax.shard_map(
@@ -588,6 +733,7 @@ class GraphTransformer:
                 {s.var_name: s.reduction_destination for s in ps_syncs},
                 **{n: list(p.destinations) for n, p in ps_plans.items()}),
             "ps_host_resident": sorted(ps_names),
+            "sparse_wire": sorted(sparse_wire),
             "buckets": [b.key for b in buckets],
             "per_var_compressors": per_var_comp,
             # staleness window for the runner's cross-process pacing

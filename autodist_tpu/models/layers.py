@@ -18,6 +18,33 @@ def causal_mask(seq_len: int) -> jnp.ndarray:
     return jnp.tril(jnp.ones((1, 1, seq_len, seq_len), jnp.bool_))
 
 
+class SparseEmbed(nn.Module):
+    """Embedding with the sparse-gradient wire identity.
+
+    Drop-in for ``nn.Embed`` whose lookup routes through
+    ``autodist_tpu.ops.embedding.embedding_lookup`` with the table's
+    flattened parameter name, so the lowering can synchronize gradients as
+    (ids, values) pairs instead of dense vocab-sized arrays (the
+    reference's IndexedSlices path). Do NOT use for tied output embeddings
+    — a table with other differentiable uses is auto-detected and kept
+    dense, making the named lookup pointless there."""
+    num_embeddings: int
+    features: int
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids):
+        from autodist_tpu.ops.embedding import embedding_lookup
+        table = self.param(
+            "embedding",
+            nn.initializers.variance_scaling(1.0, "fan_in", "normal",
+                                             out_axis=0),
+            (self.num_embeddings, self.features), self.param_dtype)
+        name = "/".join(("params",) + tuple(self.path) + ("embedding",))
+        return embedding_lookup(table.astype(self.dtype), ids, name=name)
+
+
 class MultiHeadAttention(nn.Module):
     """Standard MHA with an injectable attention implementation."""
     num_heads: int

@@ -10,6 +10,8 @@ import dataclasses
 from typing import Any, Optional
 
 import flax.linen as nn
+
+from autodist_tpu.models.layers import SparseEmbed
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -34,16 +36,18 @@ class NeuMF(nn.Module):
     @nn.compact
     def __call__(self, user_ids, item_ids):
         cfg = self.config
-        mf_u = nn.Embed(cfg.num_users, cfg.mf_dim, dtype=cfg.dtype,
-                        name="mf_user_embedding")(user_ids)
-        mf_i = nn.Embed(cfg.num_items, cfg.mf_dim, dtype=cfg.dtype,
-                        name="mf_item_embedding")(item_ids)
+        # SparseEmbed: gradients for these tables synchronize as
+        # (ids, values) pairs — the reference's IndexedSlices wire
+        mf_u = SparseEmbed(cfg.num_users, cfg.mf_dim, dtype=cfg.dtype,
+                           name="mf_user_embedding")(user_ids)
+        mf_i = SparseEmbed(cfg.num_items, cfg.mf_dim, dtype=cfg.dtype,
+                           name="mf_item_embedding")(item_ids)
         gmf = mf_u * mf_i
         mlp_dim0 = cfg.mlp_dims[0] // 2
-        mlp_u = nn.Embed(cfg.num_users, mlp_dim0, dtype=cfg.dtype,
-                         name="mlp_user_embedding")(user_ids)
-        mlp_i = nn.Embed(cfg.num_items, mlp_dim0, dtype=cfg.dtype,
-                         name="mlp_item_embedding")(item_ids)
+        mlp_u = SparseEmbed(cfg.num_users, mlp_dim0, dtype=cfg.dtype,
+                            name="mlp_user_embedding")(user_ids)
+        mlp_i = SparseEmbed(cfg.num_items, mlp_dim0, dtype=cfg.dtype,
+                            name="mlp_item_embedding")(item_ids)
         h = jnp.concatenate([mlp_u, mlp_i], axis=-1)
         for i, d in enumerate(cfg.mlp_dims[1:]):
             h = nn.relu(nn.Dense(d, dtype=cfg.dtype, name="mlp_%d" % i)(h))

@@ -33,7 +33,10 @@ def main():
     }
 
     def loss_fn(p, batch):
-        emb = jnp.take(p["embedding"], batch["tokens"], axis=0)  # [B,S,E]
+        # named lookup -> sparse (ids, values) gradient wire
+        from autodist_tpu.ops.embedding import embedding_lookup
+        emb = embedding_lookup(p["embedding"], batch["tokens"],
+                               name="embedding")  # [B,S,E]
         pooled = jnp.mean(emb, axis=1)
         logits = (pooled @ p["dense"]["kernel"] + p["dense"]["bias"])[..., 0]
         labels = batch["label"].astype(jnp.float32)

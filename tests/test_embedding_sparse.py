@@ -1,0 +1,246 @@
+"""Sparse/embedding gradient wire path (VERDICT r1 item 2).
+
+DLRM-style setting: vocab >= 100k, batch <= 1k. The sparse wire must cut
+gradient-sync bytes by >= 10x vs dense psum while matching the dense
+path's numerics (reference all_reduce_synchronizer.py:132-173 and
+partitioner.py:660-684).
+"""
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+import autodist_tpu as adt
+from autodist_tpu import strategy
+from autodist_tpu.ops import embedding as E
+
+VOCAB, DIM, BATCH = 100_000, 16, 512
+
+
+def _model(sparse_names=True):
+    """Tiny DLRM-ish tower: embedding lookup -> dense head."""
+    rng = np.random.RandomState(0)
+    params = {
+        "emb": {"table": jnp.asarray(rng.randn(VOCAB, DIM) * 0.1, jnp.float32)},
+        "head": jnp.asarray(rng.randn(DIM, 1) * 0.1, jnp.float32),
+    }
+    name = "emb/table" if sparse_names else None
+
+    def loss_fn(p, batch):
+        rows = E.embedding_lookup(p["emb"]["table"], batch["ids"], name=name)
+        pred = rows @ p["head"]
+        return jnp.mean((pred[:, 0] - batch["y"]) ** 2)
+
+    batch = {"ids": rng.randint(0, VOCAB, (BATCH,)).astype(np.int32),
+             "y": rng.randn(BATCH).astype(np.float32)}
+    return loss_fn, params, batch
+
+
+def _run(builder, sparse_names=True, steps=3):
+    loss_fn, params, batch = _model(sparse_names)
+    ad = adt.AutoDist(strategy_builder=builder)
+    runner = ad.build(loss_fn, optax.sgd(0.5), params, batch)
+    runner.init(params)
+    for _ in range(steps):
+        runner.run(batch)
+    out = runner.gather_params()
+    dstep = runner.distributed_step
+    adt.reset()
+    return out, dstep, runner
+
+
+def test_lookup_is_plain_take_outside_capture():
+    t = jnp.arange(12.0).reshape(4, 3)
+    ids = jnp.asarray([1, 3])
+    np.testing.assert_array_equal(
+        np.asarray(E.embedding_lookup(t, ids, name="x")),
+        np.asarray(t[ids]))
+
+
+def test_tap_gradients_equal_dense_rows():
+    """d loss/d tap == the gathered-row cotangent; stop_gradient kills the
+    dense table grad."""
+    t = jnp.arange(12.0).reshape(4, 3)
+    ids = jnp.asarray([1, 3, 1])
+
+    def loss(table, tap):
+        with E.capture({"v": [tap]}):
+            rows = E.embedding_lookup(table, ids, name="v")
+        return jnp.sum(rows * rows)
+
+    tap0 = jnp.zeros((3, 3))
+    gt, gtap = jax.grad(loss, argnums=(0, 1))(t, tap0)
+    assert np.all(np.asarray(gt) == 0)  # table got NO dense gradient
+    np.testing.assert_allclose(np.asarray(gtap), 2 * np.asarray(t[ids]))
+
+
+def test_sparse_wire_engages_and_matches_dense_numerics():
+    sparse_params, sparse_dstep, _ = _run(strategy.AllReduce())
+    assert sparse_dstep.metadata["sparse_wire"] == ["emb/table"]
+    dense_params, dense_dstep, _ = _run(strategy.AllReduce(),
+                                        sparse_names=False)
+    assert dense_dstep.metadata["sparse_wire"] == []
+    for k in ("emb/table", "head"):
+        a = np.asarray(sparse_params["emb"]["table"] if k == "emb/table"
+                       else sparse_params["head"])
+        b = np.asarray(dense_params["emb"]["table"] if k == "emb/table"
+                       else dense_params["head"])
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6,
+                                   err_msg="sparse vs dense mismatch at %s" % k)
+
+
+def _collective_bytes(hlo: str, op: str) -> int:
+    """Total payload bytes of a collective kind in an HLO/StableHLO dump."""
+    total = 0
+    for m in re.finditer(r'"?%s"?[^\n]*' % op, hlo):
+        line = m.group(0)
+        for shape in re.findall(r"tensor<([0-9x]+)x(f32|f16|bf16|i32|si32|i8)",
+                                line):
+            dims, dt = shape
+            n = 1
+            for d in dims.split("x"):
+                if d:
+                    n *= int(d)
+            total += n * (1 if dt == "i8" else 2 if dt in ("f16", "bf16") else 4)
+    return total
+
+
+def test_wire_bytes_at_least_10x_smaller():
+    """The lowered program must not all-reduce a vocab-sized tensor; the
+    sparse payload (all-gathered ids+values) is >= 10x smaller."""
+    loss_fn, params, batch = _model(True)
+    ad = adt.AutoDist(strategy_builder=strategy.AllReduce())
+    runner = ad.build(loss_fn, optax.sgd(0.5), params, batch)
+    runner.init(params)
+    sharded = runner.remapper.remap_feed(batch)
+    hlo = runner.distributed_step.lowered_text(runner.state, sharded)
+
+    dense_grad_bytes = VOCAB * DIM * 4
+    # no all-reduce anywhere near the dense-table size
+    ar_bytes = _collective_bytes(hlo, "all_reduce")
+    assert ar_bytes < dense_grad_bytes / 10, \
+        "dense-table all-reduce still present (%d bytes)" % ar_bytes
+    # the sparse wire itself: gathered ids+values are batch-shaped
+    ag_bytes = _collective_bytes(hlo, "all_gather")
+    assert ag_bytes > 0, "no all-gather found — sparse wire not engaged"
+    assert ag_bytes < dense_grad_bytes / 10, \
+        "sparse wire too heavy: %d vs dense %d" % (ag_bytes, dense_grad_bytes)
+
+
+def test_sparse_ps_ships_pairs_to_store():
+    """PS host path: the store receives (ids, values), scatter-adds into
+    shard index ranges, and the pushed wire bytes are batch-scale."""
+    loss_fn, params, batch = _model(True)
+    ad = adt.AutoDist(strategy_builder=strategy.PartitionedPS())
+    runner = ad.build(loss_fn, optax.sgd(0.5), params, batch)
+    runner.init(params)
+    store = runner.distributed_step.ps_store
+    assert store is not None and store.plans["emb/table"].partitioned
+    runner.run(batch)
+    dense_push = VOCAB * DIM * 4
+    assert 0 < store.stats["bytes_pushed"] < dense_push / 10, \
+        "sparse PS push not batch-scale: %d" % store.stats["bytes_pushed"]
+
+    # numerics: same updates as the dense AllReduce run
+    got = runner.gather_params()
+    adt.reset()
+    dense_params, _, _ = _run(strategy.AllReduce(), sparse_names=False,
+                              steps=1)
+    np.testing.assert_allclose(np.asarray(got["emb"]["table"]),
+                               np.asarray(dense_params["emb"]["table"]),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got["head"]),
+                               np.asarray(dense_params["head"]),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_uncaptured_sparse_var_warns_and_falls_back(caplog):
+    """A gather-detected var without a named lookup syncs dense, loudly."""
+    import logging as pylog
+    logger = pylog.getLogger("autodist_tpu")  # propagate=False: attach directly
+    logger.addHandler(caplog.handler)
+    try:
+        _, dstep, _ = _run(strategy.AllReduce(), sparse_names=False, steps=1)
+    finally:
+        logger.removeHandler(caplog.handler)
+    assert dstep.metadata["sparse_wire"] == []
+    assert any("sync DENSE" in r.message for r in caplog.records)
+
+
+def test_tied_embedding_stays_dense():
+    """A table with a second differentiable use (tied output projection)
+    MUST stay on the dense path — the sparse wire would drop the tied
+    gradient component (safety check on the grad jaxpr)."""
+    rng = np.random.RandomState(0)
+    vocab, dim = 5000, 8
+    params = {"emb": {"table": jnp.asarray(rng.randn(vocab, dim) * 0.1,
+                                           jnp.float32)}}
+
+    def loss_fn(p, batch):
+        rows = E.embedding_lookup(p["emb"]["table"], batch["ids"],
+                                  name="emb/table")
+        logits = rows @ p["emb"]["table"].T  # tied: second (dense) use
+        return jnp.mean(logits ** 2)
+
+    batch = {"ids": rng.randint(0, vocab, (64,)).astype(np.int32)}
+    ad = adt.AutoDist(strategy_builder=strategy.AllReduce())
+    runner = ad.build(loss_fn, optax.sgd(0.1), params, batch)
+    runner.init(params)
+    assert runner.distributed_step.metadata["sparse_wire"] == []
+    # dense gradients flow: the table actually moves under training
+    before = np.asarray(runner.gather_params()["emb"]["table"]).copy()
+    runner.run(batch)
+    after = np.asarray(runner.gather_params()["emb"]["table"])
+    assert not np.allclose(before, after)
+
+
+def test_small_vocab_cost_gate_keeps_dense():
+    """vocab << batch: the gathered pair payload exceeds the dense grad,
+    so the lowering keeps dense sync despite a named lookup."""
+    rng = np.random.RandomState(0)
+    vocab, dim, batch_n = 32, 4, 512
+    params = {"t": jnp.asarray(rng.randn(vocab, dim) * 0.1, jnp.float32)}
+
+    def loss_fn(p, batch):
+        rows = E.embedding_lookup(p["t"], batch["ids"], name="t")
+        return jnp.mean(rows ** 2)
+
+    batch = {"ids": rng.randint(0, vocab, (batch_n,)).astype(np.int32)}
+    ad = adt.AutoDist(strategy_builder=strategy.AllReduce())
+    runner = ad.build(loss_fn, optax.sgd(0.1), params, batch)
+    runner.init(params)
+    assert runner.distributed_step.metadata["sparse_wire"] == []
+
+
+def test_ncf_sparse_embed_layers_engage():
+    """The model zoo's SparseEmbed layers carry correctly-derived names —
+    a big-vocab NCF engages the sparse wire end to end."""
+    from autodist_tpu.models import ncf
+    cfg = ncf.NCFConfig(num_users=20000, num_items=20000, mf_dim=8,
+                        mlp_dims=(16, 8))
+    model = ncf.NeuMF(cfg)
+    import jax as _jax
+    rng = np.random.RandomState(0)
+    users = rng.randint(0, cfg.num_users, (64,)).astype(np.int32)
+    items = rng.randint(0, cfg.num_items, (64,)).astype(np.int32)
+    params = model.init(_jax.random.PRNGKey(0), users, items)
+
+    def loss_fn(p, batch):
+        logits = model.apply(p, batch["u"], batch["i"])
+        y = batch["y"].astype(jnp.float32)
+        return jnp.mean(jnp.maximum(logits, 0) - logits * y
+                        + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+    batch = {"u": users, "i": items,
+             "y": rng.randint(0, 2, (64,)).astype(np.int32)}
+    ad = adt.AutoDist(strategy_builder=strategy.Parallax())
+    runner = ad.build(loss_fn, optax.sgd(0.1), params, batch)
+    runner.init(params)
+    wired = runner.distributed_step.metadata["sparse_wire"]
+    assert "params/mf_user_embedding/embedding" in wired, wired
+    assert len(wired) == 4
+    losses = [float(runner.run(batch)["loss"]) for _ in range(5)]
+    assert losses[-1] < losses[0]
