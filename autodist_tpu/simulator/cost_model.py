@@ -27,6 +27,9 @@ CHIP_PEAK_FLOPS = {
 }
 DEFAULT_MXU_EFFICIENCY = 0.4      # achieved/peak for typical training steps
 WIRE_DTYPE_BYTES = 4              # gradients travel fp32 unless compressed
+# host<->device link for the host-offloaded PS path (no-proxy PS keeps
+# values+opt state in host RAM; every step pulls/pushes over PCIe)
+PCIE_BANDWIDTH_BYTES_S = 32e9
 COMPRESSED_BYTES = {"HorovodCompressor": 2, "HorovodCompressorEF": 2,
                     "BF16Compressor": 2, "BF16CompressorEF": 2,
                     "Int8Compressor": 1, "Int8CompressorEF": 1}
@@ -155,6 +158,14 @@ class CostModel:
                         compressed=not partitioned) / max(len(syncs), 1)
                     groups.add(sync.group)
                 elif isinstance(sync, PSSynchronizer):
+                    if sync.local_replication:
+                        # proxied PS is device-resident: its sync is an
+                        # on-device psum — ICI traffic, no PCIe
+                        ar_bytes += (self._wire_bytes(
+                            info, sync, compressed=False)
+                            / max(len(syncs), 1))
+                        num_ps_transfers += 1
+                        continue
                     dest = sync.reduction_destination.split(":")[0] or "ps"
                     ps_load[dest] = ps_load.get(dest, 0.0) + (
                         self._wire_bytes(info, sync,
@@ -164,12 +175,15 @@ class CostModel:
 
         # ring all-reduce: 2*(N-1)/N of the payload crosses each link
         allreduce_s = (2.0 * (n - 1) / n) * ar_bytes / ici_bw if n > 1 else 0.0
-        # PS: each server receives grads from and sends params to N-1 workers;
-        # bound by the busiest server's NIC (grads in + params out)
+        # PS (host-offloaded, no proxy): every step pulls values host->device
+        # and pushes grads device->host over PCIe on each node, plus
+        # cross-node serving over the busiest server's NIC
         single = self._spec.is_single_node()
-        ps_bw = ici_bw if single else dcn_bw
-        ps_s = (max(ps_load.values(), default=0.0) * 2.0 * (n - 1) / n / ps_bw
-                if n > 1 else 0.0)
+        ps_bytes = max(ps_load.values(), default=0.0)
+        pcie_s = (2.0 * sum(ps_load.values()) / PCIE_BANDWIDTH_BYTES_S
+                  if ps_load else 0.0)
+        ps_s = pcie_s + (ps_bytes * 2.0 * (n - 1) / n / dcn_bw
+                         if (n > 1 and not single) else 0.0)
         latency_s = PER_COLLECTIVE_LATENCY_S * (len(groups) + num_ps_transfers)
         return CostBreakdown(compute_s=self.compute_time(n),
                              allreduce_s=allreduce_s, ps_s=ps_s,

