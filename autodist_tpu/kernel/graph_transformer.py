@@ -361,11 +361,18 @@ class GraphTransformer:
         # — batch-shaped wire instead of vocab-shaped (the reference's
         # IndexedSlices all-gather, all_reduce_synchronizer.py:132-173).
         from autodist_tpu.ops import embedding as embedding_lib
+        # AR sparse wire only exists ACROSS devices (it replaces the dense
+        # gradient collective); on a single replica there is nothing to
+        # save and the explicit scatter path only costs compile time. The
+        # host-PS path keeps it regardless: (ids, values) still beats a
+        # vocab-sized dense push over PCIe.
         sparse_candidates = {
             n for n, v in var_infos.items()
             if v.sparse and v.trainable
             and (n in ps_names
-                 or (not layouts[n].partitioned and not layouts[n].mp_axes))}
+                 or (self.total_devices > 1
+                     and not layouts[n].partitioned
+                     and not layouts[n].mp_axes))}
         sparse_specs = {}
         if sparse_candidates and item.loss_fn is not None:
             loss_plain = (lambda p, b: item.loss_fn(p, b)[0]) if item.has_aux \

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from autodist_tpu.models.layers import TransformerBlock
+from autodist_tpu.models.layers import TransformerBlock, SparseEmbed
 
 
 @dataclasses.dataclass
@@ -54,16 +54,19 @@ class BertEncoder(nn.Module):
                  deterministic=True):
         cfg = self.config
         seq_len = input_ids.shape[-1]
-        word_emb = nn.Embed(cfg.vocab_size, cfg.hidden_size,
-                            dtype=cfg.dtype, name="word_embeddings")
-        x = word_emb(input_ids)
+        # SparseEmbed: MLM output is untied, so gradients for these
+        # tables can ride the sparse (ids, values) wire; the small
+        # position/type tables are auto-kept dense by the cost gate
+        x = SparseEmbed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                        name="word_embeddings")(input_ids)
         pos = jnp.arange(seq_len)[None]
-        x = x + nn.Embed(cfg.max_position, cfg.hidden_size, dtype=cfg.dtype,
-                         name="position_embeddings")(pos)
+        x = x + SparseEmbed(cfg.max_position, cfg.hidden_size,
+                            dtype=cfg.dtype,
+                            name="position_embeddings")(pos)
         if token_type_ids is not None:
-            x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
-                             dtype=cfg.dtype,
-                             name="token_type_embeddings")(token_type_ids)
+            x = x + SparseEmbed(cfg.type_vocab_size, cfg.hidden_size,
+                                dtype=cfg.dtype,
+                                name="token_type_embeddings")(token_type_ids)
         x = nn.LayerNorm(dtype=cfg.dtype, name="embeddings_ln")(x)
         mask = None
         if attention_mask is not None:

@@ -2,12 +2,15 @@
 
 TPU-native counterpart of the reference's 1B-word LM example
 (``examples/lm1b/language_model.py`` — an LSTM with sampled softmax, metric
-words/sec ``lm1b_train.py:62-75``). Re-designed transformer-first for TPU: a
-causal decoder with tied embeddings — LSTMs serialize on the sequence axis
-and starve the MXU; a causal transformer with ``lax``-friendly static
-shapes is the idiomatic equivalent at the same objective (next-word
-prediction on lm1b). The big embedding table is the PartitionedPS stress
-case, as in the reference benchmark.
+words/sec ``lm1b_train.py:62-75``). Re-designed transformer-first for TPU —
+LSTMs serialize on the sequence axis and starve the MXU; a causal
+transformer with ``lax``-friendly static shapes is the idiomatic
+equivalent at the same objective (next-word prediction on lm1b). The token
+embedding and the lm_head are deliberately UNTIED so the big table can
+ride the sparse (ids, values) gradient wire (``models/layers.SparseEmbed``
+— a tied table would need dense gradients and is auto-kept dense). The big
+embedding table is the PartitionedPS stress case, as in the reference
+benchmark.
 """
 import dataclasses
 from typing import Any, Optional
@@ -17,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from autodist_tpu.models.layers import TransformerBlock, causal_mask
+from autodist_tpu.models.layers import TransformerBlock, causal_mask, SparseEmbed
 
 
 @dataclasses.dataclass
@@ -50,15 +53,16 @@ class TransformerLM(nn.Module):
     def __call__(self, input_ids):
         cfg = self.config
         seq_len = input_ids.shape[-1]  # LOCAL length under seq sharding
-        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
-                     name="embed")(input_ids)
+        # untied lm_head -> the token table can ride the sparse wire
+        x = SparseEmbed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                        name="embed")(input_ids)
         x = x * np.sqrt(cfg.d_model)
         positions = jnp.arange(seq_len)
         if self.seq_parallel:
             from autodist_tpu.parallel import sequence
             positions = positions + sequence.position_offset(seq_len)
-        pos = nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
-                       name="pos_embed")(positions[None])
+        pos = SparseEmbed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
+                          name="pos_embed")(positions[None])
         x = x + pos
         # with an injected SP attention the causal structure is handled
         # inside the op; the local mask would be wrong and is skipped

@@ -150,28 +150,59 @@ def bench_model(name, setup_kw, batch_key, pairs=6, iters=4):
 
 
 def main():
-    from autodist_tpu.models.lm import LMConfig  # noqa: F401 (registry kw below)
+    import os
+    import sys
+    import jax
+    import jax.numpy as jnp
+    # Persistent compilation cache: XLA compiles through the tunnel cost
+    # minutes per model; the cache makes repeat runs (and the driver's
+    # run after ours, same host) near-instant on the compile side.
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/tmp/adt_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — older jax: run uncached
+        pass
+    from autodist_tpu.models.lm import LMConfig
 
+    # lm1b config at bf16 (TPU-first; the f32 99k-vocab variant compiles
+    # ~2x slower through the tunnel for the same capability claim)
+    lm1b_cfg = LMConfig.lm1b(dtype=jnp.bfloat16)
     configs = [
         ("resnet50", dict(batch_size=64), "image"),
         ("bert_base", dict(batch_size=16, seq_len=128), "input_ids"),
-        ("lm", dict(config=LMConfig.lm1b(), batch_size=16, seq_len=256),
-         "tokens"),
+        ("lm", dict(config=lm1b_cfg, batch_size=16, seq_len=256), "tokens"),
     ]
+    budget_s = float(os.environ.get("ADT_BENCH_BUDGET_S", "2700"))
+    t_start = time.perf_counter()
     models = {}
     for name, kw, batch_key in configs:
         label = "lm1b" if name == "lm" else name
+        elapsed = time.perf_counter() - t_start
+        # start a model only while meaningful time remains (compiles through
+        # the tunnel dominate; phases themselves are cheap)
+        if models and elapsed > budget_s - 300:
+            print("  skipping %s: %.0fs elapsed, budget %.0fs"
+                  % (label, elapsed, budget_s), file=sys.stderr, flush=True)
+            models[label] = {"skipped": "bench budget"}
+            continue
         models[label] = bench_model(name, kw, batch_key)
 
-    worst = min(m["vs_baseline"] for m in models.values())
+    skipped = sorted(k for k, m in models.items() if "skipped" in m)
+    worst = min(m["vs_baseline"] for m in models.values()
+                if "vs_baseline" in m)
     headline = models["resnet50"]
-    print(json.dumps({
+    result = {
         "metric": "resnet50_train_examples_per_sec",
         "value": headline["examples_per_sec"],
         "unit": "examples/s",
-        "vs_baseline": worst,  # min across resnet50/bert_base/lm1b
+        # min across the models that RAN; "skipped_models" flags any the
+        # budget dropped so the coverage of vs_baseline is explicit
+        "vs_baseline": worst,
         "models": models,
-    }))
+    }
+    if skipped:
+        result["skipped_models"] = skipped
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
