@@ -39,6 +39,10 @@ BUILDERS = {
     # fully-async PS: per-process local meshes, grads/values over the
     # coordination service's blob queues (no cross-process collectives)
     "PSAsync": lambda: S.PS(sync=False),
+    # async with MULTI-OWNER serving: load balancing spreads variables
+    # over both hosts, so each process runs an apply loop for its own
+    # group and fetches the peer's
+    "PSAsyncLB": lambda: S.PSLoadBalancing(sync=False),
 }
 
 

@@ -13,6 +13,7 @@ SSH launching is exercised dry-run (``ADT_DEBUG_REMOTE``) elsewhere
 data path — cross-process Gloo collectives, strategy file handoff, global
 mesh construction — is fully real.
 """
+import contextlib
 import json
 import os
 import socket
@@ -144,28 +145,37 @@ def test_two_process_extended_matrix(tmp_path, builder):
     _assert_pair_matches_reference(chief, worker, builder)
 
 
+@contextlib.contextmanager
+def _coordination_service():
+    """Live coordination service on a free port, exported to child
+    processes via ADT_COORDSVC_PORT (restored on exit)."""
+    from autodist_tpu.runtime.coordination import (CoordinationClient,
+                                                   CoordinationServer)
+    svc_port = _free_port()
+    srv = CoordinationServer(port=svc_port)
+    srv.start()
+    old = os.environ.get("ADT_COORDSVC_PORT")
+    os.environ["ADT_COORDSVC_PORT"] = str(svc_port)
+    try:
+        yield svc_port
+    finally:
+        if old is None:
+            os.environ.pop("ADT_COORDSVC_PORT", None)
+        else:
+            os.environ["ADT_COORDSVC_PORT"] = old
+        srv.stop()
+
+
 def test_two_process_async_ps(tmp_path):
     """PS(sync=False) across two real processes: each runs its OWN local
     4-device mesh (between-graph replication — no cross-process
     collectives); the chief owns every variable and serves values / applies
     gradient blobs through the coordination service's BPUT/QPUSH wire (the
     reference's async accumulator path, ps_synchronizer.py:556-633)."""
-    from autodist_tpu.runtime.coordination import (CoordinationClient,
-                                                   CoordinationServer)
-    svc_port = _free_port()
-    srv = CoordinationServer(port=svc_port)
-    srv.start()
-    try:
-        old = os.environ.get("ADT_COORDSVC_PORT")
-        os.environ["ADT_COORDSVC_PORT"] = str(svc_port)
-        try:
-            chief, worker = _launch_pair(tmp_path, "PSAsync", n_steps=10,
-                                         external=True)
-        finally:
-            if old is None:
-                os.environ.pop("ADT_COORDSVC_PORT", None)
-            else:
-                os.environ["ADT_COORDSVC_PORT"] = old
+    from autodist_tpu.runtime.coordination import CoordinationClient
+    with _coordination_service() as svc_port:
+        chief, worker = _launch_pair(tmp_path, "PSAsync", n_steps=10,
+                                     external=True)
         for r in (chief, worker):
             # local mesh: 4 devices per process, NOT one 8-device program
             assert r["local_devices"] == 4
@@ -181,8 +191,27 @@ def test_two_process_async_ps(tmp_path):
         version, _ = res
         assert version >= 5, "chief applied fewer blobs than its own steps"
         client.close()
-    finally:
-        srv.stop()
+
+
+@pytest.mark.integration
+def test_two_process_async_multi_owner(tmp_path):
+    """PSLoadBalancing(sync=False): variables spread across BOTH hosts, so
+    each process serves its own group (apply loop + publishes) and fetches
+    the peer's — the reference's sharded-PS deployment, asynchronously."""
+    from autodist_tpu.runtime.coordination import CoordinationClient
+    with _coordination_service() as svc_port:
+        chief, worker = _launch_pair(tmp_path, "PSAsyncLB", n_steps=10,
+                                     external=True)
+        for r in (chief, worker):
+            assert r["local_devices"] == 4
+            # each process owns a NON-EMPTY group (load balancing spread)
+            assert "owns ['" in r["log"], r["log"][-2000:]
+            assert r["losses"][-1] < r["losses"][0]
+        # BOTH hosts published value blobs on the service
+        client = CoordinationClient("127.0.0.1", svc_port)
+        assert client.bget("ps:127.0.0.1/vals") is not None
+        assert client.bget("ps:localhost/vals") is not None
+        client.close()
 
 
 def test_two_process_staleness_pacing(tmp_path):
@@ -190,22 +219,10 @@ def test_two_process_staleness_pacing(tmp_path):
     client reports steps/heartbeats to a live coordination service (the
     reference's token-queue semantics, ps_synchronizer.py:388-458). The
     parent hosts the service and asserts both workers reported all steps."""
-    from autodist_tpu.runtime.coordination import (CoordinationClient,
-                                                   CoordinationServer)
-    svc_port = _free_port()
-    srv = CoordinationServer(port=svc_port)
-    srv.start()
-    try:
-        old = os.environ.get("ADT_COORDSVC_PORT")
-        os.environ["ADT_COORDSVC_PORT"] = str(svc_port)
-        try:
-            chief, worker = _launch_pair(tmp_path, "PSStale", n_steps=5,
-                                         external=True)
-        finally:
-            if old is None:
-                os.environ.pop("ADT_COORDSVC_PORT", None)
-            else:
-                os.environ["ADT_COORDSVC_PORT"] = old
+    from autodist_tpu.runtime.coordination import CoordinationClient
+    with _coordination_service() as svc_port:
+        chief, worker = _launch_pair(tmp_path, "PSStale", n_steps=5,
+                                     external=True)
         np.testing.assert_array_equal(chief["losses"], worker["losses"])
         assert chief["losses"][-1] < chief["losses"][0]
         # BOTH pacing clients connected (min_step alone can't distinguish
@@ -215,5 +232,3 @@ def test_two_process_staleness_pacing(tmp_path):
         client = CoordinationClient("127.0.0.1", svc_port)
         assert client.min_step() == 5
         client.close()
-    finally:
-        srv.stop()
