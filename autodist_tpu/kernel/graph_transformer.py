@@ -82,8 +82,8 @@ class DistributedStep:
         parameter read from the PS; empty when no var is host-resident)."""
         if self.ps_store is None:
             return {}
-        return {n: self._put(v, P())
-                for n, v in self.ps_store.pull().items()}
+        from autodist_tpu.parallel.mesh import tree_to_mesh
+        return tree_to_mesh(self.mesh, self.ps_store.pull(), P())
 
     def _push_ps(self, ps_grads: dict) -> None:
         """Device -> host transfer of the reduced PS gradients + host-side

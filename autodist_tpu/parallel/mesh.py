@@ -67,6 +67,19 @@ def host_to_mesh(mesh: Mesh, value, pspec) -> jax.Array:
     return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
 
 
+def tree_to_mesh(mesh: Mesh, tree, pspec):
+    """Place a whole pytree onto the mesh with ONE shared PartitionSpec.
+    Single-process meshes take the batched ``device_put`` fast path (one
+    dispatch for the whole tree, not one per leaf — the per-step PS pull of
+    a 100-variable model is 100x fewer host round-trips); multi-process
+    falls back to the per-leaf host-global placement."""
+    from jax.sharding import NamedSharding
+    if jax.process_count() == 1:
+        return jax.device_put(tree, NamedSharding(mesh, pspec))
+    return jax.tree_util.tree_map(
+        lambda leaf: host_to_mesh(mesh, leaf, pspec), tree)
+
+
 def dcn_axes(mesh: Mesh) -> tuple:
     """Mesh axes that cross process (host) boundaries — the axes whose
     collectives ride DCN rather than ICI. Detected from the device layout

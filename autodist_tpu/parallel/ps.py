@@ -264,6 +264,11 @@ class PSStore:
         # jit cache for the per-shard host update (keyed by shape/dtype via
         # jit's own cache); compiled for CPU so PS updates never touch HBM
         self._apply = jax.jit(self._apply_impl, donate_argnums=(0, 1))
+        # batched variant: ALL shards' updates traced into ONE program —
+        # one dispatch per step instead of one per shard (a 100-var model
+        # pays ~100x less host-dispatch latency)
+        self._apply_batch = jax.jit(self._apply_batch_impl,
+                                    donate_argnums=(0, 1))
 
     # ------------------------------------------------------------ lifecycle
 
@@ -271,6 +276,16 @@ class PSStore:
         updates, new_opt = self._optimizer.update(
             {"v": grad}, opt_state, {"v": shard})
         return optax.apply_updates({"v": shard}, updates)["v"], new_opt
+
+    def _apply_batch_impl(self, shards, opt_states, grads):
+        """One traced program covering every (var, shard): per-key
+        optimizer semantics identical to :meth:`_apply_impl` (each shard
+        keeps its own little opt-state tree)."""
+        new_vals, new_opts = {}, {}
+        for key in shards:
+            new_vals[key], new_opts[key] = self._apply_impl(
+                shards[key], opt_states[key], grads[key])
+        return new_vals, new_opts
 
     def _split(self, plan: PSVarPlan, full: np.ndarray) -> List[np.ndarray]:
         if not plan.partitioned:
@@ -458,13 +473,14 @@ class PSStore:
             else:
                 items[name] = g
         with jax.default_device(self._cpu):
+            # collect every (var, shard) then apply in ONE jitted dispatch
+            shards, opts, gshards, order = {}, {}, {}, []
             for name, g in items.items():
                 plan = self.plans[name]
                 if isinstance(g, tuple):
                     g = self._densify(name, plan, g)
                 else:
                     g = np.asarray(g)
-                new_vals, new_opts = [], []
                 for si, (lo, hi) in enumerate(plan.shard_ranges()):
                     if plan.partitioned:
                         idx = [slice(None)] * g.ndim
@@ -472,16 +488,23 @@ class PSStore:
                         gs = np.ascontiguousarray(g[tuple(idx)])
                     else:
                         gs = g
-                    new_val, new_opt = self._apply(
-                        jnp.asarray(self._values[name][si]),
-                        self._opt[name][si], jnp.asarray(gs))
-                    new_vals.append(np.asarray(new_val))
-                    new_opts.append(new_opt)
+                    key = "%s::%d" % (name, si)
+                    shards[key] = jnp.asarray(self._values[name][si])
+                    opts[key] = self._opt[name][si]
+                    gshards[key] = jnp.asarray(gs)
+                    order.append((name, si, key))
+            new_vals, new_opts = self._apply_batch(shards, opts, gshards)
+            per_var: Dict[str, Tuple[list, list]] = {}
+            for name, si, key in order:
+                vlist, olist = per_var.setdefault(name, ([], []))
+                vlist.append(np.asarray(new_vals[key]))
+                olist.append(new_opts[key])
+            for name, (vlist, olist) in per_var.items():
                 # swap ALL shards of the var at once: a concurrent reader
                 # must never see a value whose shards span two versions
                 with self._lock:
-                    self._values[name] = new_vals
-                    self._opt[name] = new_opts
+                    self._values[name] = vlist
+                    self._opt[name] = olist
                 self.stats["applies"] += 1
 
     # ---------------------------------------------------- async PS serving

@@ -64,7 +64,7 @@ def _compiled_flops(lowered_compiled) -> float:
         return 0.0
 
 
-def bench_model(name, setup_kw, batch_key, pairs=6, iters=4):
+def bench_model(name, setup_kw, batch_key, pairs=8, iters=4):
     import sys
     import jax
     print("bench_model:", name, setup_kw, file=sys.stderr, flush=True)
@@ -122,6 +122,21 @@ def bench_model(name, setup_kw, batch_key, pairs=6, iters=4):
         run_fw()
     jax.block_until_ready((base_box[0], state_box[0].params))
     print("  warmup done in %.1fs" % (time.perf_counter() - t0),
+          file=sys.stderr, flush=True)
+
+    # adaptive phase length: short steps need more iterations per phase or
+    # a single throttle window dominates the pair ratio (bert-sized steps
+    # at 4 iters/phase swung medians 0.87-1.00 between runs). The probe is
+    # a median of 3 so one throttled probe step can't pin iters low.
+    probes = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run_fw()
+        jax.block_until_ready(state_box[0].params)
+        probes.append(time.perf_counter() - t0)
+    step_s = max(statistics.median(probes), 1e-4)
+    iters = max(iters, min(64, int(round(1.0 / step_s))))
+    print("  step=%.0fms -> %d iters/phase" % (step_s * 1e3, iters),
           file=sys.stderr, flush=True)
 
     ratios, fw_rates = [], []

@@ -13,6 +13,7 @@ if __package__ in (None, ""):  # direct invocation: put the repo root on sys.pat
         _os.path.dirname(_os.path.abspath(__file__)))))
 import argparse
 import dataclasses
+import os
 import time
 
 import optax
@@ -24,15 +25,24 @@ from autodist_tpu.models import lm
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--config", default="tiny", choices=["tiny", "default", "lm1b"])
+    p.add_argument("--config", default="tiny",
+                   choices=["tiny", "byte", "default", "lm1b"])
     p.add_argument("--batch_size", type=int, default=64)
     p.add_argument("--seq_len", type=int, default=128)
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--log_frequency", type=int, default=20)
     p.add_argument("--resource_spec", default=None)
+    p.add_argument("--data", default="synthetic",
+                   help="'synthetic', or a directory of text files to "
+                        "tokenize (byte-level) through the native record "
+                        "loader; 'docs' uses the repo's own documentation")
     args = p.parse_args()
 
     cfg = {"tiny": lm.LMConfig.tiny, "default": lm.LMConfig,
+           # byte-level vocab for raw-text corpora (--data), small dims
+           "byte": lambda: lm.LMConfig(vocab_size=256, d_model=128,
+                                       num_layers=2, num_heads=4,
+                                       mlp_dim=256),
            "lm1b": lm.LMConfig.lm1b}[args.config]()
     if cfg.max_seq_len < args.seq_len:
         cfg = dataclasses.replace(cfg, max_seq_len=args.seq_len)
@@ -42,10 +52,35 @@ def main():
         cfg, seq_len=args.seq_len, batch_size=args.batch_size)
     step = ad.function(loss_fn, optimizer=optax.adam(1e-3), params=params)
 
+    batches = None
+    if args.data != "synthetic":
+        # real text -> ADT1 records -> native loader (vocab must be
+        # byte-level for raw text)
+        import glob
+        import tempfile
+        from autodist_tpu.data import text as text_lib
+        from autodist_tpu.data.record_dataset import RecordFileDataset
+        if cfg.vocab_size < text_lib.BYTE_VOCAB:
+            raise SystemExit("--data needs vocab_size >= 256 (byte tokens)")
+        if args.data == "docs":
+            repo = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            paths = text_lib.repo_docs_corpus(repo)
+        else:
+            paths = sorted(glob.glob(os.path.join(args.data, "*")))
+        # per-process path: concurrent runs must not clobber each other's
+        # records while the native loader has them mmapped
+        rec = os.path.join(tempfile.gettempdir(),
+                           "lm1b_text_%d.adt" % os.getpid())
+        n = text_lib.write_lm_records(paths, rec, seq_len=args.seq_len)
+        print("real-text corpus: %d files -> %d records" % (len(paths), n))
+        ds = RecordFileDataset(rec, batch_size=args.batch_size, shuffle=True)
+        batches = iter(ds)
+
     t0, words = time.perf_counter(), 0
     run_t0, run_words, m = None, 0, {"loss": float("nan")}
     for i in range(args.steps):
-        m = step(batch)
+        m = step(batch if batches is None else next(batches))
         words += args.batch_size * args.seq_len
         if run_t0 is None:
             run_t0 = time.perf_counter()  # post-compile clock for the summary
