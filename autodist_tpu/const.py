@@ -65,8 +65,15 @@ class ENV(Enum):
     # access time like every other ADT_* var, not frozen at import
     ADT_COORDSVC_PORT = ("ADT_COORDSVC_PORT", int, DEFAULT_COORDSVC_PORT)
     # async-PS backpressure: max gradient blobs in flight per owner queue
-    # before push blocks (0 = unbounded, pure reference-style async)
+    # before push blocks; 0 disables
+    # the client-side pacing, but the coordination service still enforces
+    # a hard 4096-entry queue cap (qpush raises past it) so a dead owner
+    # can never eat the host's memory
     ADT_PS_MAX_LAG = ("ADT_PS_MAX_LAG", int, 2)
+    # every N steps, sync multi-process PS compares a digest of the host
+    # mirrors across processes via the coordination service (0 = off);
+    # catches silent mirror divergence from heterogeneous host codegen
+    ADT_PS_MIRROR_CHECK_EVERY = ("ADT_PS_MIRROR_CHECK_EVERY", int, 0)
     # comma-separated mesh axis names to treat as DCN (cross-host) for the
     # spec=DCN hierarchical reduce; default: detected from process layout
     ADT_DCN_AXES = ("ADT_DCN_AXES", str, "")

@@ -204,6 +204,9 @@ class DistributedStep:
             VarLayout(name=""))
         gathered = self._gather_tree(state.opt_state, layout_tree)
         if self.ps_store is not None:
+            # drain before reading so the opt snapshot pairs with the value
+            # snapshot gather_params takes (not torn across an async apply)
+            self.ps_store.drain()
             gathered = ps_lib.fill_holes_with_path(
                 gathered, self.ps_store.full_opt_leaf)
         return gathered

@@ -6,6 +6,11 @@ example_batch, apply_fn)``, plugging directly into
 """
 from autodist_tpu.models import bert, cnn, lm, ncf, resnet  # noqa: F401
 
+def _bert(cfg_ctor, **kw):
+    cfg_kw = {k: kw.pop(k) for k in ("dtype",) if k in kw}
+    return bert.make_train_setup(cfg_ctor(**cfg_kw), **kw)
+
+
 REGISTRY = {
     "resnet18": lambda **kw: resnet.make_train_setup(resnet.ResNet18, **kw),
     "resnet50": lambda **kw: resnet.make_train_setup(resnet.ResNet50, **kw),
@@ -14,8 +19,8 @@ REGISTRY = {
     "inceptionv3": lambda **kw: resnet.make_train_setup(
         cnn.InceptionV3, **{"image_size": 299, **kw}),
     "densenet121": lambda **kw: resnet.make_train_setup(cnn.DenseNet121, **kw),
-    "bert_base": lambda **kw: bert.make_train_setup(bert.BertConfig.base(), **kw),
-    "bert_large": lambda **kw: bert.make_train_setup(bert.BertConfig.large(), **kw),
+    "bert_base": lambda **kw: _bert(bert.BertConfig.base, **kw),
+    "bert_large": lambda **kw: _bert(bert.BertConfig.large, **kw),
     "lm": lambda **kw: lm.make_train_setup(**kw),
     "ncf": lambda **kw: ncf.make_train_setup(**kw),
 }

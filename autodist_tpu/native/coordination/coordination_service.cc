@@ -285,8 +285,15 @@ class Server {
                         it->second.second);
       }
     } else if (cmd == "QPUSH" && parts.size() == 3) {
-      queues_[parts[1]].push_back(parts[2]);
-      Reply(conn, "OK");
+      // cap: a queue nobody drains (dead owner) must not eat the host's
+      // memory; clients see the rejection and fail loudly
+      auto& q = queues_[parts[1]];
+      if (q.size() >= kMaxQueueLen) {
+        Reply(conn, "ERR queue full");
+      } else {
+        q.push_back(parts[2]);
+        Reply(conn, "OK");
+      }
     } else if (cmd == "QPOP" && parts.size() == 2) {
       auto it = queues_.find(parts[1]);
       if (it == queues_.end() || it->second.empty()) {
@@ -345,6 +352,7 @@ class Server {
   bool shutdown_ = false;
   std::map<int, Conn> conns_;
   std::map<std::string, std::string> kv_;
+  static constexpr size_t kMaxQueueLen = 4096;
   std::map<std::string, std::pair<long, std::string>> blobs_;
   std::map<std::string, std::deque<std::string>> queues_;
   std::map<std::string, long> counters_;

@@ -261,14 +261,13 @@ class PSStore:
         # thread must never expose a var whose shards span two versions
         import threading
         self._lock = threading.Lock()
-        # jit cache for the per-shard host update (keyed by shape/dtype via
-        # jit's own cache); compiled for CPU so PS updates never touch HBM
-        self._apply = jax.jit(self._apply_impl, donate_argnums=(0, 1))
-        # batched variant: ALL shards' updates traced into ONE program —
-        # one dispatch per step instead of one per shard (a 100-var model
-        # pays ~100x less host-dispatch latency)
-        self._apply_batch = jax.jit(self._apply_batch_impl,
-                                    donate_argnums=(0, 1))
+        # ALL shards' updates traced into ONE program — one dispatch per
+        # step instead of one per shard (a 100-var model pays ~100x less
+        # host-dispatch latency). Compiled for CPU so PS updates never
+        # touch HBM. NO donation: checkpoint readers (full_opt_leaf /
+        # full_values) may hold references to the stored buffers while the
+        # async apply thread runs; donating would invalidate them mid-read.
+        self._apply_batch = jax.jit(self._apply_batch_impl)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -436,23 +435,34 @@ class PSStore:
                 if not payload:
                     continue
                 blob = pss.pack_arrays(payload)
-                self.stats["bytes_pushed"] += len(blob)
-                grp["service"].push_grads(blob)
-                # backpressure: an unbounded queue lets a fast worker stack
-                # gradients computed at ever-staler values (and diverge).
-                # The reference's async apply sat in the step's critical
-                # path; here the bound is explicit: at most ADT_PS_MAX_LAG
-                # blobs in flight (0 = unbounded, pure async).
+                # backpressure BEFORE the push: an unbounded queue lets a
+                # fast worker stack gradients computed at ever-staler values
+                # (and diverge), and a dead owner would grow its queue
+                # without bound. The reference's async apply sat in the
+                # step's critical path; here the bound is explicit: at most
+                # ADT_PS_MAX_LAG blobs in flight (0 = unbounded, pure
+                # async). On timeout the push is DROPPED (counted in
+                # stats["dropped_pushes"]) — the watchdog/DEADLIST plane is
+                # what kills the job if the owner is really gone.
                 from autodist_tpu import const as _const
                 max_lag = _const.ENV.ADT_PS_MAX_LAG.val
                 if max_lag > 0:
                     deadline = time.monotonic() + 60.0
-                    while grp["service"].pending_grads() > max_lag:
+                    stuck = False
+                    while grp["service"].pending_grads() >= max_lag:
                         if time.monotonic() > deadline:
-                            logging.warning("async PS: owner %s queue stuck "
-                                            "above max lag", host)
+                            logging.warning(
+                                "async PS: owner %s queue stuck at max lag; "
+                                "dropping this push", host)
+                            stuck = True
                             break
                         time.sleep(0.001)
+                    if stuck:
+                        self.stats["dropped_pushes"] = (
+                            self.stats.get("dropped_pushes", 0) + 1)
+                        continue
+                self.stats["bytes_pushed"] += len(blob)
+                grp["service"].push_grads(blob)
             self._my_pushes += 1
         self.stats["pushes"] += 1
 
@@ -572,8 +582,17 @@ class PSStore:
     def close(self) -> None:
         if self._serve_groups is not None:
             for grp in self._serve_groups.values():
+                stopped = True
                 if grp["worker"] is not None:
-                    grp["worker"].stop()
+                    stopped = grp["worker"].stop()
+                if stopped:
+                    grp["service"].close()
+                else:
+                    # the apply thread is wedged (slow apply / stalled
+                    # recv); leaking its socket beats yanking it out from
+                    # under a live thread mid-publish
+                    logging.warning("PS owner apply thread did not stop; "
+                                    "leaving its service open")
 
     def _densify(self, name: str, plan: PSVarPlan, pair) -> np.ndarray:
         """(indices, values) -> dense mean gradient for the full var.
@@ -617,7 +636,8 @@ class PSStore:
         shards, take shard 0 for shared leaves. ``slot_path`` is the hole's
         position in the full opt tree, e.g. ``0/mu/<var_name>``."""
         plan = self.plans[var_name]
-        states = self._opt[var_name]
+        with self._lock:  # atomic snapshot vs the apply thread's swap
+            states = list(self._opt[var_name])
         # the per-shard little trees hold the same subtree under ".../v"
         prefix = slot_path[: -len(var_name)].rstrip("/")
         sub0 = self._subtree_at(states[0], prefix)
@@ -659,6 +679,26 @@ class PSStore:
         return {n: l for n, l in picked}
 
     # ------------------------------------------------------------ accounting
+
+    def mirror_digest(self) -> str:
+        """Digest of all resident values — the sync multi-process
+        consistency check. Every process's mirror must stay bit-identical
+        (deterministic jitted CPU applies of the identical psum'd
+        gradient); the Runner compares digests across processes via the
+        coordination service every ``ADT_PS_MIRROR_CHECK_EVERY`` steps and
+        fails fast on divergence (heterogeneous host codegen would
+        otherwise silently fork the replicas). Mirror mode only: a serving
+        store has one authoritative owner copy, so there is nothing to
+        cross-check (and no consistent snapshot to hash under the apply
+        thread)."""
+        assert not self.serving, "mirror_digest is for sync (mirror) mode"
+        import hashlib
+        h = hashlib.md5()
+        for name in sorted(self._values):
+            h.update(name.encode())
+            for s in self._values[name]:
+                h.update(np.ascontiguousarray(s).tobytes())
+        return h.hexdigest()
 
     def resident_bytes(self) -> int:
         """Host bytes resident in this store (values only)."""

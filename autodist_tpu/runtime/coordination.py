@@ -158,7 +158,11 @@ class CoordinationClient:
     def qpush(self, queue: str, payload: bytes):
         import base64
         b64 = base64.b64encode(payload).decode()
-        assert self._cmd("QPUSH %s %s" % (queue, b64)) == "OK"
+        resp = self._cmd("QPUSH %s %s" % (queue, b64))
+        if resp != "OK":
+            # the service rejects pushes past its size cap rather than
+            # letting an orphaned queue eat the host's memory
+            raise RuntimeError("qpush rejected: %s" % resp)
 
     def qpop(self, queue: str):
         import base64

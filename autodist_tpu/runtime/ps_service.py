@@ -147,11 +147,30 @@ class CoordPSService(PSServiceBase):
         self._factory = client_factory
         self._local = threading.local()
         self._prefix = prefix
+        self._clients_lock = threading.Lock()
+        self._clients = []  # every per-thread client, for close()
+        self._closed = False
 
     def _client(self):
+        if self._closed:
+            # a thread may still hold a (now closed) client in its TLS;
+            # fail with a clear error instead of a bad-fd OSError
+            raise RuntimeError("CoordPSService is closed")
         if not hasattr(self._local, "client"):
             self._local.client = self._factory()
+            with self._clients_lock:
+                self._clients.append(self._local.client)
         return self._local.client
+
+    def close(self):
+        self._closed = True
+        with self._clients_lock:
+            clients, self._clients = self._clients, []
+        for c in clients:
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def publish(self, version, blob):
         self._client().bput(self._prefix + "/vals", version, blob)
@@ -225,6 +244,8 @@ class AsyncPSWorker:
             time.sleep(self._poll_s)
         raise TimeoutError("async PS queue did not drain")
 
-    def stop(self):
+    def stop(self) -> bool:
+        """Stop the apply loop; True when the thread actually exited."""
         self._stop.set()
         self._thread.join(timeout=5)
+        return not self._thread.is_alive()

@@ -36,26 +36,27 @@ class Runner:
         self.state: Optional[TrainState] = None
         self._step_count = 0
         self._coord = None
+        self._mirror_coord = None
         self._staleness = int(distributed_step.metadata.get("staleness", 0))
         # bounded-staleness pacing is a cross-process property; within one
         # SPMD program all replicas are already lockstep. Async PS paces
         # itself through the parameter service (no step barrier at all).
         if (self._staleness > 0 and const.ENV.ADT_NUM_PROCESSES.val > 1
                 and not distributed_step.metadata.get("async")):
-            self._coord = self._connect_coordination()
+            self._coord = self._connect_coordination(
+                "staleness pacing (window=%d)" % self._staleness)
 
-    def _connect_coordination(self):
+    def _connect_coordination(self, purpose: str = "staleness pacing"):
         from autodist_tpu.runtime.coordination import CoordinationClient
         host = (const.ENV.ADT_COORDINATOR_ADDR.val.split(":")[0]
                 or "127.0.0.1")
         try:
             client = CoordinationClient(host, const.ENV.ADT_COORDSVC_PORT.val)
-            logging.info("staleness pacing active (window=%d) via %s",
-                         self._staleness, host)
+            logging.info("%s active via %s", purpose, host)
             return client
         except OSError as e:
             logging.warning("coordination service unreachable (%s); "
-                            "staleness pacing disabled", e)
+                            "%s disabled", e, purpose)
             return None
 
     @property
@@ -98,6 +99,7 @@ class Runner:
             self._coord.report_step(worker, self._step_count)
             self._coord.heartbeat(worker)
             self._coord.wait_staleness(self._step_count, self._staleness)
+        self._maybe_check_mirrors()
         if self._tracing and self._trace_started:
             jax.block_until_ready(metrics)
             jax.profiler.stop_trace()
@@ -105,6 +107,61 @@ class Runner:
             self._tracing = False  # trace only the first step, like FULL_TRACE runs
         host_metrics = self._remapper.remap_fetch(metrics)
         return (new_state, host_metrics) if state is not None else host_metrics
+
+    def _maybe_check_mirrors(self):
+        """Sync multi-process PS keeps every process's host mirror
+        bit-identical by determinism, not by serving; every
+        ``ADT_PS_MIRROR_CHECK_EVERY`` steps compare an md5 digest of the
+        mirrors across processes via the coordination service and fail
+        fast on divergence (heterogeneous host XLA codegen would
+        otherwise silently fork the replicas)."""
+        every = const.ENV.ADT_PS_MIRROR_CHECK_EVERY.val
+        store = getattr(self._dstep, "ps_store", None)
+        if (every <= 0 or store is None or store.serving
+                or const.ENV.ADT_NUM_PROCESSES.val < 2
+                or self._step_count % every != 0
+                or self._mirror_coord is False):  # disabled after a timeout
+            return
+        # a DEDICATED client: self._coord doubles as the "staleness pacing
+        # on" flag in run(), which must stay off unless staleness > 0
+        if self._mirror_coord is None:
+            self._mirror_coord = self._connect_coordination("mirror check")
+            if self._mirror_coord is None:
+                self._mirror_coord = False
+                return
+        digest = store.mirror_digest()
+        worker = const.ENV.ADT_WORKER.val or "chief"
+        # keys are scoped by strategy id (unique per run — a long-lived
+        # service may retain a previous run's digests) with ONE key per
+        # worker, overwritten each check (bounded KV growth); all
+        # processes check at the same step multiples, and sync PS steps
+        # are collective-lockstep, so the steps line up
+        prefix = "mirror/%s" % getattr(self._dstep.strategy, "id", "run")
+        self._mirror_coord.put("%s/%s" % (prefix, worker),
+                               "%d:%s" % (self._step_count, digest))
+        if worker == "chief":
+            return  # workers compare against the chief's copy
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            val = self._mirror_coord.get("%s/chief" % prefix)
+            if val is not None:
+                chief_step, chief_digest = val.split(":", 1)
+                if int(chief_step) >= self._step_count:
+                    if (int(chief_step) == self._step_count
+                            and chief_digest != digest):
+                        raise RuntimeError(
+                            "PS mirror divergence at step %d: %s has %s, "
+                            "chief has %s" % (self._step_count, worker,
+                                              digest, chief_digest))
+                    return  # matched, or chief raced past — next check aligns
+            time.sleep(0.01)
+        # never saw a chief digest for this step: warn once and stop
+        # checking rather than stalling 30s at every future check step
+        logging.warning("mirror check: chief digest for step %d never "
+                        "appeared; disabling further checks",
+                        self._step_count)
+        self._mirror_coord.close()
+        self._mirror_coord = False
 
     def gather_params(self):
         return self._dstep.gather_params(self.state)

@@ -31,6 +31,9 @@ BUILDERS = {
     "PartitionedAR": lambda: S.PartitionedAR(),
     "PartitionedPS": lambda: S.PartitionedPS(),
     "Parallax": lambda: S.Parallax(),
+    # host-resident sync PS (mirror mode; with ADT_PS_MIRROR_CHECK_EVERY
+    # set, the Runner cross-checks mirror digests over the coordsvc)
+    "PS": lambda: S.PS(),
     # bounded staleness: exercises the Runner's cross-process pacing
     # client against a live coordination service
     "PSStale": lambda: S.PS(staleness=2),

@@ -143,3 +143,18 @@ print("STILL_ALIVE", flush=True)
     assert "WATCHDOG_UP" in proc.stdout
     assert "STILL_ALIVE" not in proc.stdout, proc.stdout
     assert proc.returncode == 1
+
+
+def test_queue_cap_rejects_then_recovers(server):
+    """QPUSH past the server-side cap is rejected loudly (a queue nobody
+    drains — dead owner — must not eat the host's memory), and draining
+    makes room again."""
+    c = _client()
+    for _ in range(4096):
+        c.qpush("capq", b"x")
+    with pytest.raises(RuntimeError, match="queue full"):
+        c.qpush("capq", b"y")
+    assert c.qlen("capq") == 4096
+    assert c.qpop("capq") == b"x"
+    c.qpush("capq", b"y")  # room again
+    c.close()

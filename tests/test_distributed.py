@@ -43,7 +43,8 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _launch_pair(tmp_path, builder, n_steps=6, external=False):
+def _launch_pair(tmp_path, builder, n_steps=6, external=False,
+                 extra_env=None):
     """Run chief+worker. ``external=False`` models the chief-launched flow
     (file handoff by preset id — the parent stands in for the Coordinator's
     fresh remote_copy by clearing any stale file); ``external=True`` models
@@ -74,6 +75,8 @@ def _launch_pair(tmp_path, builder, n_steps=6, external=False):
         })
         if external:
             env["ADT_EXTERNAL_LAUNCH"] = "1"
+        if extra_env:
+            env.update(extra_env)
         if pid == 1:
             env["ADT_WORKER"] = "localhost"
         procs.append(subprocess.Popen(
@@ -96,6 +99,7 @@ def _launch_pair(tmp_path, builder, n_steps=6, external=False):
     results = [json.loads(o.read_text()) for o in outs]
     for r, log in zip(results, logs):
         r["log"] = log
+        r["strategy_id"] = strategy_id
     return results
 
 
@@ -214,6 +218,31 @@ def test_two_process_async_multi_owner(tmp_path):
         client.close()
 
 
+def test_two_process_mirror_check(tmp_path):
+    """Sync host-PS across two real processes with the mirror-digest
+    cross-check active (ADT_PS_MIRROR_CHECK_EVERY): every process's host
+    mirror must stay bit-identical by deterministic replay; each publishes
+    an md5 digest of its mirrors to the coordination service every N steps
+    and a worker whose digest differs from the chief's aborts. Here the
+    run must SURVIVE the check (identical mirrors) and both digests must
+    be on the service afterwards, equal."""
+    from autodist_tpu.runtime.coordination import CoordinationClient
+    with _coordination_service() as svc_port:
+        chief, worker = _launch_pair(
+            tmp_path, "PS", n_steps=6, external=True,
+            extra_env={"ADT_PS_MIRROR_CHECK_EVERY": "2"})
+        np.testing.assert_array_equal(chief["losses"], worker["losses"])
+        assert chief["losses"][-1] < chief["losses"][0]
+        prefix = "mirror/%s" % chief["strategy_id"]
+        client = CoordinationClient("127.0.0.1", svc_port)
+        chief_v = client.get("%s/chief" % prefix)
+        worker_v = client.get("%s/localhost" % prefix)
+        client.close()
+        assert chief_v is not None and worker_v is not None
+        # final check step: same step id, same digest
+        assert chief_v == worker_v, (chief_v, worker_v)
+
+
 def test_two_process_staleness_pacing(tmp_path):
     """PS(staleness=2) across two real processes: the Runner's pacing
     client reports steps/heartbeats to a live coordination service (the
@@ -228,7 +257,8 @@ def test_two_process_staleness_pacing(tmp_path):
         # BOTH pacing clients connected (min_step alone can't distinguish
         # one reporter from two) and every step was reported
         for r in (chief, worker):
-            assert "staleness pacing active" in r["log"], r["log"][-2000:]
+            assert "staleness pacing (window=2) active" in r["log"], \
+                r["log"][-2000:]
         client = CoordinationClient("127.0.0.1", svc_port)
         assert client.min_step() == 5
         client.close()

@@ -219,3 +219,22 @@ def test_ps_opt_state_gathers_in_original_layout():
     assert by_name["0/mu/w1"].shape == (16, 16)
     assert by_name["0/nu/w2"].shape == (16, 4)
     assert np.any(by_name["0/mu/w1"] != 0)  # a step actually happened
+
+
+def test_mirror_digest_tracks_values():
+    """mirror_digest: equal for identically-stepped stores, changed by an
+    extra step — the primitive behind the cross-process divergence check
+    (ADT_PS_MIRROR_CHECK_EVERY)."""
+    r1, _, batch = _build(strategy.PS())
+    for _ in range(2):
+        r1.run(batch)
+    d1 = r1.distributed_step.ps_store.mirror_digest()
+    adt.reset()
+    r2, _, batch2 = _build(strategy.PS())
+    for _ in range(2):
+        r2.run(batch2)
+    d2 = r2.distributed_step.ps_store.mirror_digest()
+    assert d1 == d2  # deterministic replay => identical mirrors
+    r2.run(batch2)
+    assert r2.distributed_step.ps_store.mirror_digest() != d2
+    adt.reset()
