@@ -400,10 +400,22 @@ class GraphTransformer:
             local_batch = jax.tree_util.tree_map(local_aval,
                                                  item.example_batch)
             discovered = set()
+            # the taps/safety traces run OUTSIDE the step's shard_map but
+            # the loss may use mesh collectives (ring attention, Megatron
+            # psum); bind the axis names at size 1 so those traces run.
+            # Size 1 — not the real sizes — because the trace feeds FULL
+            # (unsharded) params: under size-1 axes "local = global", so
+            # model-parallel compute (expert splits, column/row matmuls)
+            # sees consistent shapes. Only SHAPES are read off these
+            # traces, and batch dims are pre-divided by local_aval, so
+            # axis-size-dependent VALUES (mean weights, offsets) are
+            # irrelevant.
+            from autodist_tpu.utils.axis_env import bound_axes
             try:
-                sparse_specs = embedding_lib.discover(
-                    loss_plain, item.params, local_batch,
-                    sparse_candidates)
+                with bound_axes():
+                    sparse_specs = embedding_lib.discover(
+                        loss_plain, item.params, local_batch,
+                        sparse_candidates)
                 discovered = set(sparse_specs)
                 if sparse_specs:
                     # a table with OTHER differentiable uses (tied output
@@ -411,9 +423,10 @@ class GraphTransformer:
                     # the sparse wire would drop — keep those dense
                     full_names, _, _ = variable_utils.flatten_named(
                         item.params)
-                    safe = embedding_lib.safe_sparse_names(
-                        loss_plain, item.params, local_batch, sparse_specs,
-                        full_names)
+                    with bound_axes():
+                        safe = embedding_lib.safe_sparse_names(
+                            loss_plain, item.params, local_batch,
+                            sparse_specs, full_names)
                     tied = sorted(set(sparse_specs) - safe)
                     if tied:
                         logging.warning(

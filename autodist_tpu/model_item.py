@@ -111,11 +111,14 @@ def _gather_indexed_invars_mapped(jaxpr, invar_roots: Dict[Any, set]) -> set:
         if prim == "gather":
             hit.update(roots(eqn.invars[0]))
         for name, val in eqn.params.items():
+            # sub-jaxprs appear as ClosedJaxpr (.jaxpr), as a PLAIN Jaxpr
+            # (e.g. shard_map's "jaxpr" param), or in lists of either
             subs = []
-            if hasattr(val, "jaxpr"):
-                subs.append(val.jaxpr)
-            elif isinstance(val, (list, tuple)):
-                subs.extend(item.jaxpr for item in val if hasattr(item, "jaxpr"))
+            for item in (val if isinstance(val, (list, tuple)) else (val,)):
+                if hasattr(item, "jaxpr"):
+                    subs.append(item.jaxpr)
+                elif hasattr(item, "eqns") and hasattr(item, "invars"):
+                    subs.append(item)
             for sub in subs:
                 if len(sub.invars) == len(eqn.invars):
                     inner_map = {}
@@ -133,18 +136,32 @@ def _gather_indexed_invars_mapped(jaxpr, invar_roots: Dict[Any, set]) -> set:
     return hit
 
 
+def _axis_env_jaxpr(loss_fn: Callable, params, example_batch):
+    """Trace with every framework axis name bound (size 1), for loss fns
+    that use mesh collectives (``psum("model")``, ``axis_index("seq")``
+    in ring attention, ...) and therefore cannot trace bare. Size-1 axes
+    leave shapes untouched, and the jaxpr comes out un-wrapped so the
+    gather walker sees the same program as inside the step."""
+    from autodist_tpu.utils.axis_env import bound_axes
+    with bound_axes():
+        return jax.make_jaxpr(loss_fn)(params, example_batch)
+
+
 def detect_sparse_vars(loss_fn: Callable, params, example_batch) -> set:
     """Names of params that are indexed by a ``gather`` in the forward pass."""
     try:
         closed = jax.make_jaxpr(loss_fn)(params, example_batch)
-    except Exception as e:  # noqa: BLE001 — detection is best-effort
-        logging.warning(
-            "sparse-var detection failed (%s: %s); treating ALL vars dense — "
-            "Parallax will route embeddings to AllReduce and sparse wire "
-            "paths stay off; if the model has embedding tables, fix the "
-            "trace failure or mark them via VarInfo.sparse",
-            type(e).__name__, e)
-        return set()
+    except Exception:  # noqa: BLE001 — retry under a bound axis env
+        try:
+            closed = _axis_env_jaxpr(loss_fn, params, example_batch)
+        except Exception as e:  # noqa: BLE001 — detection is best-effort
+            logging.warning(
+                "sparse-var detection failed (%s: %s); treating ALL vars "
+                "dense — Parallax will route embeddings to AllReduce and "
+                "sparse wire paths stay off; if the model has embedding "
+                "tables, fix the trace failure or mark them via "
+                "VarInfo.sparse", type(e).__name__, e)
+            return set()
     jaxpr = closed.jaxpr
     flat_params, _ = tree_flatten_with_path(params)
     n_param_leaves = len(flat_params)

@@ -8,6 +8,13 @@ everything else stays dense — the standard Switch-Transformer shape
 (arXiv 2101.03961). Expert parallelism is an axis the reference's
 data-parallel-only strategy space never had
 (reference ``docs/design/architecture.rst:46-48``).
+
+The token embedding and the output head are UNTIED (as in ``models/lm.py``)
+so the vocab-sized table can ride the sparse (ids, values) gradient wire
+(``ops/embedding.embedding_lookup``) — a tied table has a dense gradient
+path through the logits matmul and is auto-kept dense. Positions are read
+with a static slice (every row is used each step; a gather would only
+trip sparse detection for a table that is effectively dense).
 """
 import dataclasses
 from typing import Any, Dict, List, Optional, Tuple
@@ -64,6 +71,9 @@ def init_params(cfg: MoEConfig, seed: int = 0) -> Dict:
         "pos_embed": normal(cfg.max_seq_len, d, scale=0.02),
         "final_ln": {"scale": np.ones((d,), np.float32),
                      "bias": np.zeros((d,), np.float32)},
+        # untied head (see module docstring): the token table stays
+        # gather-only so its gradient can sync as (ids, values)
+        "lm_head": normal(d, cfg.vocab_size, scale=0.02),
     }
     for i in range(cfg.num_layers):
         params["layer_%d" % i] = {
@@ -93,11 +103,12 @@ def ep_rules(expert_axis: str = const.EXPERT_AXIS) -> List[Tuple[str, Dict[int, 
 
 def forward(params, input_ids, cfg: MoEConfig):
     """Logits plus the summed Switch aux loss across layers."""
+    from autodist_tpu.ops.embedding import embedding_lookup
     dt = cfg.dtype
     seq_len = input_ids.shape[-1]
-    x = jnp.take(params["embed"], input_ids, axis=0)
+    x = embedding_lookup(params["embed"], input_ids, name="embed")
     x = (x * np.sqrt(cfg.d_model)).astype(dt)
-    x = x + params["pos_embed"].astype(dt)[jnp.arange(seq_len)][None]
+    x = x + params["pos_embed"][:seq_len].astype(dt)[None]
     aux_total = jnp.zeros((), jnp.float32)
     for i in range(cfg.num_layers):
         lp = params["layer_%d" % i]
@@ -118,8 +129,8 @@ def forward(params, input_ids, cfg: MoEConfig):
         aux_total = aux_total + aux
         x = x + moe_out
     x = _layer_norm(x, params["final_ln"])
-    logits = jnp.tensordot(x, params["embed"].astype(dt),
-                           axes=((x.ndim - 1,), (1,)))
+    logits = jnp.tensordot(x, params["lm_head"].astype(dt),
+                           axes=((x.ndim - 1,), (0,)))
     return logits, aux_total
 
 

@@ -98,7 +98,10 @@ def forward(params, input_ids, cfg: TPLMConfig, n_microbatches: int = 1,
     seq_len = input_ids.shape[-1]
     x = tensor.vocab_parallel_embed(params["embed"], input_ids, model_axis)
     x = (x * np.sqrt(cfg.d_model)).astype(dt)
-    x = x + params["pos_embed"].astype(dt)[jnp.arange(seq_len)][None]
+    # static slice, not a gather: every position row is used each step, so
+    # a sparse wire would be pure overhead and the gather only tripped
+    # sparse detection ("sync DENSE" warnings) for a dense-use table
+    x = x + params["pos_embed"][:seq_len].astype(dt)[None]
 
     def stage_fn(blocks_local, h):
         return pipeline.stacked_scan(

@@ -155,10 +155,18 @@ def forward(params, input_ids, cfg: TPLMConfig,
     seq_len = input_ids.shape[-1]
     x = tensor.vocab_parallel_embed(params["embed"], input_ids, model_axis)
     x = (x * np.sqrt(cfg.d_model)).astype(dt)
-    positions = jnp.arange(seq_len)
     if seq_parallel:
-        positions = positions + sequence.position_offset(seq_len)
-    x = x + params["pos_embed"].astype(dt)[positions][None]
+        # each seq shard reads its own row range (offset is axis-dependent,
+        # so this is a real gather); the named lookup keeps it on the
+        # framework's sparse surface instead of tripping the dense-sync
+        # warning — the cost gate then keeps it dense (all rows are read)
+        from autodist_tpu.ops.embedding import embedding_lookup
+        positions = jnp.arange(seq_len) + sequence.position_offset(seq_len)
+        x = x + embedding_lookup(params["pos_embed"], positions,
+                                 name="pos_embed").astype(dt)[None]
+    else:
+        # static slice, not a gather: every position row is used each step
+        x = x + params["pos_embed"][:seq_len].astype(dt)[None]
     for i in range(cfg.num_layers):
         lp = params["layer_%d" % i]
         h = _layer_norm(x, lp["ln1"])

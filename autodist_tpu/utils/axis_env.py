@@ -1,0 +1,37 @@
+"""Bind mesh axis names for out-of-mesh tracing.
+
+Several analyses (sparse-var detection in ``model_item``, sparse-wire
+discovery and tied-table safety in ``kernel/graph_transformer``) trace the
+user's loss function OUTSIDE the training step's ``shard_map``. A loss
+that uses mesh collectives — ``psum("model")`` in Megatron layers,
+``axis_index("seq")`` in ring attention — cannot trace bare: the axis
+names are unbound. This context manager binds them (jax's axis
+environment, the same mechanism ``pmap``/``shard_map`` use), so shapes
+and jaxprs come out exactly as inside the step, without wrapping the
+function in a ``shard_map`` that the jaxpr analyses would then have to
+see through.
+"""
+import contextlib
+from typing import Dict, Optional
+
+from autodist_tpu import const
+
+FRAMEWORK_AXES = (const.DATA_AXIS, const.MODEL_AXIS, const.PIPELINE_AXIS,
+                  const.SEQUENCE_AXIS, const.EXPERT_AXIS)
+
+
+@contextlib.contextmanager
+def bound_axes(sizes: Optional[Dict[str, int]] = None):
+    """Bind every framework axis name (default size 1; pass the real mesh
+    sizes when shape math depends on them). Falls back to a no-op if the
+    private jax API moved — callers' own try/except then reports the
+    unbound-axis failure exactly as before."""
+    try:
+        from jax._src.core import extend_axis_env_nd
+    except ImportError:  # pragma: no cover - jax moved the API
+        yield
+        return
+    sizes = sizes or {}
+    frame = [(name, int(sizes.get(name, 1))) for name in FRAMEWORK_AXES]
+    with extend_axis_env_nd(frame):
+        yield

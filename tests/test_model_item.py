@@ -105,3 +105,55 @@ def test_spec_serialization_round_trip():
     assert spec["optimizer_name"] == "adam"
     assert len(spec["vars"]) == 3
     assert spec["mode"] == "loss_fn"
+
+
+def test_detect_sparse_vars_under_mesh_collectives():
+    """A loss using mesh collectives (ring attention, Megatron psum) can't
+    trace bare — detection retries under a size-1 axis environment and
+    must still see THROUGH the shard_map wrapper to the gather inside
+    (regression: the shard_map eqn stores a plain Jaxpr, not ClosedJaxpr)."""
+    import jax
+    import jax.numpy as jnp
+    from autodist_tpu.model_item import detect_sparse_vars
+
+    params = {"emb": jnp.ones((16, 4)), "w": jnp.ones((4, 2))}
+    batch = {"ids": jnp.zeros((8,), jnp.int32),
+             "y": jnp.zeros((8, 2))}
+
+    def loss_fn(p, b):
+        feat = jnp.take(p["emb"], b["ids"], axis=0)
+        out = feat @ p["w"]
+        # unbound outside a mesh: forces the axis-env retry path
+        out = jax.lax.psum(out, "model")
+        return jnp.mean((out - b["y"]) ** 2)
+
+    assert detect_sparse_vars(loss_fn, params, batch) == {"emb"}
+
+
+def test_gather_walker_sees_through_shard_map():
+    """The gather walker must recurse into a shard_map eqn, whose body is
+    a PLAIN Jaxpr (not ClosedJaxpr) — the sub-jaxpr extraction's second
+    branch. Wrap the loss in an explicit jax.shard_map and assert the
+    table is still detected."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from autodist_tpu.model_item import detect_sparse_vars
+
+    params = {"emb": jnp.ones((16, 4)), "w": jnp.ones((4, 2))}
+    batch = {"ids": jnp.zeros((8,), jnp.int32), "y": jnp.zeros((8, 2))}
+
+    def loss_fn(p, b):
+        feat = jnp.take(p["emb"], b["ids"], axis=0)
+        return jnp.mean((feat @ p["w"] - b["y"]) ** 2)
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("model",))
+    wrapped = jax.shard_map(loss_fn, mesh=mesh, in_specs=(P(), P()),
+                            out_specs=P(), check_vma=False)
+    # sanity: the wrapper really produces a shard_map eqn with a plain
+    # Jaxpr body (the regression this test pins down)
+    jaxpr = jax.make_jaxpr(wrapped)(params, batch).jaxpr
+    sm = [e for e in jaxpr.eqns if e.primitive.name == "shard_map"]
+    assert sm and not hasattr(sm[0].params["jaxpr"], "jaxpr")
+    assert detect_sparse_vars(wrapped, params, batch) == {"emb"}
