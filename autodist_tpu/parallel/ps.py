@@ -286,15 +286,19 @@ class PSStore:
                 shards[key], opt_states[key], grads[key])
         return new_vals, new_opts
 
+    @staticmethod
+    def _shard_slice(plan: PSVarPlan, si: int, full: np.ndarray) -> np.ndarray:
+        """One shard's slice of a full array along the plan axis."""
+        lo, hi = plan.shard_ranges()[si]
+        idx = [slice(None)] * full.ndim
+        idx[plan.axis] = slice(lo, hi)
+        return np.ascontiguousarray(full[tuple(idx)])
+
     def _split(self, plan: PSVarPlan, full: np.ndarray) -> List[np.ndarray]:
         if not plan.partitioned:
             return [np.asarray(full)]
-        out = []
-        for lo, hi in plan.shard_ranges():
-            idx = [slice(None)] * full.ndim
-            idx[plan.axis] = slice(lo, hi)
-            out.append(np.ascontiguousarray(full[tuple(idx)]))
-        return out
+        return [self._shard_slice(plan, si, full)
+                for si in range(len(plan.shard_ranges()))]
 
     def init_params(self, full_params) -> None:
         """Take ownership of the PS leaves of a host params tree."""
@@ -325,7 +329,7 @@ class PSStore:
             for name, plan in self.plans.items():
                 info = self._var_infos[name]
                 new_states = []
-                for si, (lo, hi) in enumerate(plan.shard_ranges()):
+                for si in range(len(plan.shard_ranges())):
                     template = self._optimizer.init(
                         {"v": jnp.asarray(self._values[name][si])})
                     t_names, t_leaves, t_def = variable_utils.flatten_named(template)
@@ -345,9 +349,7 @@ class PSStore:
                             continue
                         if (plan.partitioned and src.ndim > plan.axis
                                 and src.shape[plan.axis] == info.shape[plan.axis]):
-                            idx = [slice(None)] * src.ndim
-                            idx[plan.axis] = slice(lo, hi)
-                            src = src[tuple(idx)]
+                            src = self._shard_slice(plan, si, src)
                         out.append(jnp.asarray(src))
                     new_states.append(variable_utils.unflatten_named(t_def, out))
                 self._opt[name] = new_states
@@ -375,25 +377,49 @@ class PSStore:
             for name in out:
                 self.stats["bytes_pulled"] += out[name].nbytes
         else:
-            out = {}
+            shard_vals: Dict[str, Dict[int, np.ndarray]] = {}
             for host, grp in self._serve_groups.items():
                 if grp["owned"]:
-                    out.update(self._local_full(grp["vars"]))
-                    continue
-                from autodist_tpu.runtime import ps_service as pss
-                deadline = time.monotonic() + 60.0
-                res = grp["service"].fetch()
-                while res is None:  # owner hasn't published yet
-                    if time.monotonic() > deadline:
-                        raise TimeoutError(
-                            "async PS: owner %s never published" % host)
-                    time.sleep(0.002)
+                    blobs = self._local_shard_blobs(grp["pairs"])
+                else:
+                    from autodist_tpu.runtime import ps_service as pss
+                    deadline = time.monotonic() + 60.0
                     res = grp["service"].fetch()
-                _version, blob = res
-                vals = pss.unpack_arrays(blob)
-                self.stats["bytes_pulled"] += len(blob)
-                out.update({n: vals[n] for n in grp["vars"]})
+                    while res is None:  # owner hasn't published yet
+                        if time.monotonic() > deadline:
+                            raise TimeoutError(
+                                "async PS: owner %s never published" % host)
+                        time.sleep(0.002)
+                        res = grp["service"].fetch()
+                    _version, blob = res
+                    blobs = pss.unpack_arrays(blob)
+                    self.stats["bytes_pulled"] += len(blob)
+                for key, arr in blobs.items():
+                    if "!" in key:
+                        continue  # opt-state leaf (checkpoint wire)
+                    name, si = key.rsplit("::", 1)
+                    shard_vals.setdefault(name, {})[int(si)] = arr
+            out = self._assemble(shard_vals)
         self.stats["pulls"] += 1
+        return out
+
+    def _assemble(self, shard_vals: Dict[str, Dict[int, np.ndarray]]
+                  ) -> Dict[str, np.ndarray]:
+        """Reassemble full variables from per-shard pieces (possibly
+        published by different owners), in plan shard order. Missing
+        shards fall back to the local mirror (pre-publish window)."""
+        out = {}
+        for name, plan in self.plans.items():
+            n_shards = len(plan.shard_ranges()) if plan.partitioned else 1
+            pieces = []
+            for si in range(n_shards):
+                arr = shard_vals.get(name, {}).get(si)
+                if arr is None:
+                    with self._lock:
+                        arr = np.asarray(self._values[name][si])
+                pieces.append(np.asarray(arr))
+            out[name] = (pieces[0] if n_shards == 1
+                         else np.concatenate(pieces, axis=plan.axis))
         return out
 
     def push(self, grads: Dict[str, Any]) -> None:
@@ -421,17 +447,36 @@ class PSStore:
             self.apply_local(host_grads)
         else:
             from autodist_tpu.runtime import ps_service as pss
+            host_grads: Dict[str, Any] = {}  # one D2H transfer per var
+
+            def fetch(name):
+                if name not in host_grads:
+                    g = grads[name]
+                    host_grads[name] = (
+                        tuple(np.asarray(jax.device_get(x)) for x in g)
+                        if isinstance(g, tuple)
+                        else np.asarray(jax.device_get(g)))
+                return host_grads[name]
+
             for host, grp in self._serve_groups.items():
                 payload = {}
-                for name in grp["vars"]:
+                for name, si in grp["pairs"]:
                     if name not in grads:
                         continue
-                    g = grads[name]
+                    g = fetch(name)
+                    plan = self.plans[name]
                     if isinstance(g, tuple):
-                        payload[name + "#idx"] = np.asarray(jax.device_get(g[0]))
-                        payload[name + "#vals"] = np.asarray(jax.device_get(g[1]))
+                        # sparse (ids, values): one whole pair per owner
+                        # group — the owner scatter-applies only into its
+                        # own shard index ranges (shard_filter)
+                        payload[name + "#idx"] = g[0]
+                        payload[name + "#vals"] = g[1]
+                    elif plan.partitioned:
+                        # ship only this owner's slice of the gradient
+                        payload["%s::%d" % (name, si)] = self._shard_slice(
+                            plan, si, g)
                     else:
-                        payload[name] = np.asarray(jax.device_get(g))
+                        payload["%s::0" % name] = g
                 if not payload:
                     continue
                 blob = pss.pack_arrays(payload)
@@ -466,53 +511,79 @@ class PSStore:
             self._my_pushes += 1
         self.stats["pushes"] += 1
 
-    def apply_local(self, grads: Dict[str, Any]) -> None:
+    def apply_local(self, grads: Dict[str, Any], shard_filter=None) -> None:
         """The PS-side update op: apply gradients to the resident shards
-        through the optimizer, on the host CPU. Dense grads are full
-        arrays; sparse grads are ``(indices, values)`` pairs — or their
-        packed ``name#idx``/``name#vals`` wire form — scatter-added into
-        the shard's index range (the reference's IndexedSlices split,
-        ``kernel/partitioner.py:660-684``)."""
+        through the optimizer, on the host CPU. Gradients arrive as full
+        dense arrays (mirror mode), pre-sliced ``name::si`` shard slices
+        (per-shard serving pushes), or sparse ``(indices, values)`` pairs
+        — also their packed ``name#idx``/``name#vals`` wire form —
+        scatter-added into the shard's index range (the reference's
+        IndexedSlices split, ``kernel/partitioner.py:660-684``).
+        ``shard_filter`` restricts the apply to the given (name, si) set
+        — an owner loop touches only the shards it owns."""
         items: Dict[str, Any] = {}
+        slices: Dict[str, Dict[int, Any]] = {}
         for name, g in grads.items():
             if name.endswith("#idx"):
                 base = name[:-4]
                 items[base] = (g, grads[base + "#vals"])
             elif name.endswith("#vals"):
                 continue
+            elif ("::" in name and name not in self.plans
+                  and name.rsplit("::", 1)[0] in self.plans
+                  and name.rsplit("::", 1)[1].isdigit()):
+                # wire shard-slice key; a real variable literally named
+                # "w::1" is in self.plans itself and takes the dense branch
+                base, si = name.rsplit("::", 1)
+                slices.setdefault(base, {})[int(si)] = g
             else:
                 items[name] = g
         with jax.default_device(self._cpu):
             # collect every (var, shard) then apply in ONE jitted dispatch
             shards, opts, gshards, order = {}, {}, {}, []
+
+            def add(name, si, gs):
+                key = "%s::%d" % (name, si)
+                shards[key] = jnp.asarray(self._values[name][si])
+                opts[key] = self._opt[name][si]
+                gshards[key] = jnp.asarray(gs)
+                order.append((name, si, key))
+
             for name, g in items.items():
                 plan = self.plans[name]
                 if isinstance(g, tuple):
                     g = self._densify(name, plan, g)
                 else:
                     g = np.asarray(g)
-                for si, (lo, hi) in enumerate(plan.shard_ranges()):
-                    if plan.partitioned:
-                        idx = [slice(None)] * g.ndim
-                        idx[plan.axis] = slice(lo, hi)
-                        gs = np.ascontiguousarray(g[tuple(idx)])
-                    else:
-                        gs = g
-                    key = "%s::%d" % (name, si)
-                    shards[key] = jnp.asarray(self._values[name][si])
-                    opts[key] = self._opt[name][si]
-                    gshards[key] = jnp.asarray(gs)
-                    order.append((name, si, key))
+                for si in range(len(plan.shard_ranges())):
+                    if shard_filter is not None \
+                            and (name, si) not in shard_filter:
+                        continue
+                    gs = (self._shard_slice(plan, si, g)
+                          if plan.partitioned else g)
+                    add(name, si, gs)
+            for name, by_si in slices.items():
+                for si, gs in sorted(by_si.items()):
+                    if shard_filter is not None \
+                            and (name, si) not in shard_filter:
+                        continue
+                    add(name, si, np.asarray(gs))
+            if not order:
+                return
             new_vals, new_opts = self._apply_batch(shards, opts, gshards)
-            per_var: Dict[str, Tuple[list, list]] = {}
+            per_var: Dict[str, Dict[int, Tuple]] = {}
             for name, si, key in order:
-                vlist, olist = per_var.setdefault(name, ([], []))
-                vlist.append(np.asarray(new_vals[key]))
-                olist.append(new_opts[key])
-            for name, (vlist, olist) in per_var.items():
-                # swap ALL shards of the var at once: a concurrent reader
-                # must never see a value whose shards span two versions
+                per_var.setdefault(name, {})[si] = (
+                    np.asarray(new_vals[key]), new_opts[key])
+            for name, by_si in per_var.items():
+                # swap the var's updated shards in one locked mutation;
+                # shards owned by OTHER processes are left untouched
+                # (per-shard ownership — their owners update them)
                 with self._lock:
+                    vlist = list(self._values[name])
+                    olist = list(self._opt[name])
+                    for si, (v, o) in by_si.items():
+                        vlist[si], olist[si] = v, o
                     self._values[name] = vlist
                     self._opt[name] = olist
                 self.stats["applies"] += 1
@@ -531,34 +602,62 @@ class PSStore:
             self._start_serving()
 
     def _start_serving(self) -> None:
+        """Group by owner host PER SHARD (``reduction_destination`` is
+        per-shard in the plan): a partitioned variable's shards can be
+        owned — stored, applied, published — by different hosts, exactly
+        the reference's sharded-PS task placement
+        (``ps_synchronizer.py:636-762``). Pulls reassemble each variable
+        across its owners' published blobs."""
         from autodist_tpu.runtime import ps_service as pss
         service_for_host, my_host = self._serve_config
         if self._serve_groups is not None:  # re-init: restart owner loops
             self.close()
         groups: Dict[str, list] = {}
-        for name, plan in self.plans.items():
-            hosts = {d.split(":")[0] for d in plan.destinations if d}
-            if len(hosts) > 1:
-                logging.warning(
-                    "async PS: var %s has shards on multiple hosts %s; "
-                    "whole-var ownership goes to %s", name, sorted(hosts),
-                    sorted(hosts)[0])
-            host = sorted(hosts)[0] if hosts else my_host
-            groups.setdefault(host, []).append(name)
+        for name, plan in sorted(self.plans.items()):
+            for si, dest in enumerate(plan.destinations):
+                host = dest.split(":")[0] if dest else my_host
+                groups.setdefault(host, []).append((name, si))
         self._serve_groups = {}
-        for host, names in sorted(groups.items()):
+        for host, pairs in sorted(groups.items()):
             svc = service_for_host(host)
             owned = (host == my_host)
-            grp = {"vars": sorted(names), "service": svc, "owned": owned,
+            grp = {"pairs": sorted(pairs), "service": svc, "owned": owned,
                    "worker": None}
             if owned:
+                shard_set = frozenset(grp["pairs"])
                 grp["worker"] = pss.AsyncPSWorker(
-                    svc, self.apply_local,
-                    functools.partial(self._local_full, grp["vars"])).start()
+                    svc,
+                    functools.partial(self.apply_local,
+                                      shard_filter=shard_set),
+                    functools.partial(self._local_shard_blobs,
+                                      grp["pairs"], with_opt=True)).start()
             self._serve_groups[host] = grp
         logging.info("async PS serving: %d owner groups, this process (%s) "
                      "owns %s", len(self._serve_groups), my_host,
                      [h for h, g in self._serve_groups.items() if g["owned"]])
+
+    def _local_shard_blobs(self, pairs,
+                           with_opt: bool = False) -> Dict[str, np.ndarray]:
+        """{'name::si': shard value} for the given (name, si) pairs — the
+        owner's publish payload (only the shards it owns). With
+        ``with_opt``, the shard's optimizer-state leaves ride along as
+        ``name::si!<leaf>`` so a chief-side checkpoint can reconstruct a
+        COMPLETE opt state for variables whose shards it does not own
+        (per-shard ownership means no single process applies to every
+        shard — without the wire, peer shards' moments would silently
+        checkpoint as their frozen local init)."""
+        from autodist_tpu.kernel.common import variable_utils
+        out = {}
+        with self._lock:
+            for name, si in pairs:
+                key = "%s::%d" % (name, si)
+                out[key] = np.asarray(self._values[name][si])
+                if with_opt:
+                    names, leaves, _ = variable_utils.flatten_named(
+                        self._opt[name][si])
+                    for ln, leaf in zip(names, leaves):
+                        out["%s!%s" % (key, ln)] = np.asarray(leaf)
+        return out
 
     @property
     def serving(self) -> bool:
@@ -617,18 +716,21 @@ class PSStore:
         if self._serve_groups is None:
             return self._local_full()
         from autodist_tpu.runtime import ps_service as pss
-        out = {}
+        shard_vals: Dict[str, Dict[int, np.ndarray]] = {}
         for host, grp in self._serve_groups.items():
             if grp["owned"]:
-                out.update(self._local_full(grp["vars"]))
-                continue
-            res = grp["service"].fetch()
-            if res is None:
-                out.update(self._local_full(grp["vars"]))  # pre-publish
+                blobs = self._local_shard_blobs(grp["pairs"])
             else:
-                vals = pss.unpack_arrays(res[1])
-                out.update({n: vals[n] for n in grp["vars"] if n in vals})
-        return out
+                res = grp["service"].fetch()
+                if res is None:
+                    continue  # pre-publish: _assemble falls back to mirror
+                blobs = pss.unpack_arrays(res[1])
+            for key, arr in blobs.items():
+                if "!" in key:
+                    continue  # opt-state leaf (checkpoint wire)
+                name, si = key.rsplit("::", 1)
+                shard_vals.setdefault(name, {})[int(si)] = arr
+        return self._assemble(shard_vals)
 
     def full_opt_leaf(self, slot_path: str, var_name: str):
         """Reconstruct one optimizer-state subtree in the var's full layout
@@ -638,6 +740,13 @@ class PSStore:
         plan = self.plans[var_name]
         with self._lock:  # atomic snapshot vs the apply thread's swap
             states = list(self._opt[var_name])
+        if self._serve_groups is not None:
+            # per-shard ownership: this process's local opt state is only
+            # authoritative for the shards it owns; peer-owned shards'
+            # moments come off the owner's published blob (the ::si!leaf
+            # keys _local_shard_blobs ships with every value publish)
+            states = [self._remote_opt_state(var_name, si, st)
+                      for si, st in enumerate(states)]
         # the per-shard little trees hold the same subtree under ".../v"
         prefix = slot_path[: -len(var_name)].rstrip("/")
         sub0 = self._subtree_at(states[0], prefix)
@@ -657,6 +766,33 @@ class PSStore:
                 return np.concatenate(arrs, axis=plan.axis)
             return a0  # shared (count-like) leaf
         return jax.tree_util.tree_map(merge, *subs)
+
+    def _remote_opt_state(self, var_name: str, si: int, local_state):
+        """The authoritative little-tree opt state for one shard: local
+        when this process owns the shard, else rebuilt from the owner's
+        latest published ``name::si!leaf`` entries (falling back to the
+        local state pre-publish). The local state provides the tree
+        structure; leaves are filled by flattened name."""
+        from autodist_tpu.kernel.common import variable_utils
+        from autodist_tpu.runtime import ps_service as pss
+        for grp in self._serve_groups.values():
+            if (var_name, si) not in grp["pairs"]:
+                continue
+            if grp["owned"]:
+                return local_state
+            res = grp["service"].fetch()
+            if res is None:
+                return local_state  # owner pre-publish
+            blobs = pss.unpack_arrays(res[1])
+            want = "%s::%d!" % (var_name, si)
+            remote = {k[len(want):]: v for k, v in blobs.items()
+                      if k.startswith(want)}
+            if not remote:
+                return local_state  # older publish without opt leaves
+            names, leaves, treedef = variable_utils.flatten_named(local_state)
+            filled = [remote.get(n, leaf) for n, leaf in zip(names, leaves)]
+            return variable_utils.unflatten_named(treedef, filled)
+        return local_state
 
     @staticmethod
     def _subtree_at(little_tree, slot_prefix: str):

@@ -46,6 +46,10 @@ BUILDERS = {
     # over both hosts, so each process runs an apply loop for its own
     # group and fetches the peer's
     "PSAsyncLB": lambda: S.PSLoadBalancing(sync=False),
+    # async + partitioned: ONE variable's shards round-robin across both
+    # hosts — per-SHARD ownership (each owner applies/publishes only its
+    # shard ranges; pulls reassemble across owners)
+    "PSAsyncPart": lambda: S.PartitionedPS(sync=False),
 }
 
 

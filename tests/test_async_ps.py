@@ -179,3 +179,59 @@ def test_async_rejects_mixed_strategies():
     ad = adt.AutoDist(strategy_builder=Mixed())
     with pytest.raises(ValueError, match="async PS"):
         ad.build(loss_fn, optax.sgd(0.1), params, batch)
+
+
+def test_per_shard_ownership_and_opt_checkpoint_wire():
+    """A partitioned var with shards owned by DIFFERENT hosts: each owner
+    applies only its shard range, and a checkpoint on either side sees the
+    PEER's live optimizer moments via the published ::si!leaf entries —
+    not its own frozen local init (per-shard ownership means no single
+    process applies to every shard)."""
+    infos = {"w": VarInfo(name="w", shape=(4, 2), dtype="float32")}
+    plans = {"w": PSVarPlan(var_name="w",
+                            destinations=("hostA:CPU:0", "hostB:CPU:0"),
+                            shard_sizes=(2, 2), sync=False)}
+    opt = optax.adam(0.1)
+    init = {"w": np.ones((4, 2), np.float32)}
+    services = {}
+
+    def service_for_host(host):
+        return services.setdefault(host, pss.LocalPSService())
+
+    a = PSStore(dict(plans), infos, opt)
+    a.init_params(init)
+    a.enable_serving(service_for_host, my_host="hostA")
+    b = PSStore(dict(plans), infos, opt)
+    b.init_params(init)
+    b.enable_serving(service_for_host, my_host="hostB")
+    try:
+        g = np.arange(8, dtype=np.float32).reshape(4, 2) + 1.0
+        a.push({"w": jnp.asarray(g)})
+        deadline = time.monotonic() + 10
+        while a.applied_total() < 1 or b.applied_total() < 1:
+            assert time.monotonic() < deadline, "apply loops never ran"
+            time.sleep(0.005)
+        a.drain()
+        b.drain()
+        # each owner applied ONLY its own shard range: hostA's local copy
+        # of shard 1 is untouched (still ones), hostB's shard 0 likewise
+        with a._lock:
+            np.testing.assert_array_equal(a._values["w"][1], np.ones((2, 2)))
+            assert not np.allclose(a._values["w"][0], 1.0)
+        with b._lock:
+            np.testing.assert_array_equal(b._values["w"][0], np.ones((2, 2)))
+            assert not np.allclose(b._values["w"][1], 1.0)
+        # pull reassembles the var across owners: BOTH halves updated
+        assembled = a.pull()["w"]
+        assert not np.allclose(assembled[:2], 1.0)
+        assert not np.allclose(assembled[2:], 1.0)
+        # checkpoint from hostA: the hostB-owned shard's Adam moments come
+        # off the wire (non-zero), not hostA's frozen local init
+        mu = a.full_opt_leaf("0/mu/w", "w")
+        assert not np.allclose(np.asarray(mu)[2:], 0.0), \
+            "peer shard moments are frozen init — opt wire not working"
+        np.testing.assert_allclose(
+            np.asarray(mu), 0.1 * g, rtol=1e-5)  # adam mu after one grad
+    finally:
+        a.close()
+        b.close()
