@@ -17,6 +17,7 @@ Usage (the 3-line-change pattern of ``examples/linear_regression.py``):
 """
 import contextlib
 import json
+import os
 import time
 from typing import Callable, Optional
 
@@ -75,6 +76,42 @@ class AutoDist:
         self._runner: Optional[Runner] = None
         self._coordinator = None
         patch.patch_optax() if const.ENV.ADT_PATCH_OPTAX.val else None
+        self._early_launch()
+
+    def _early_launch(self):
+        """Chief-launched multi-node jobs: launch the workers and join the
+        distributed runtime NOW, at construction — before the user creates
+        any jnp array. The chief's ``jax.distributed`` join blocks until
+        every worker connects, and joining is impossible once the XLA
+        backend is initialized, so the order is forced: preallocate the
+        strategy id, launch workers (they relaunch this script; their own
+        ``AutoDist()`` joins from the env), join, and only then let the
+        user build — ``_setup`` ships the serialized strategy afterwards
+        (workers wait in their strategy poll). The reference's analogous
+        flow (``coordinator.py:46-110``) had no such constraint because TF
+        servers were separate processes."""
+        from autodist_tpu.runtime import server_starter
+        if (self._resource_spec.is_single_node() or not const.is_chief()
+                or const.ENV.ADT_EXTERNAL_LAUNCH.val
+                or const.ENV.ADT_DEBUG_REMOTE.val
+                or server_starter.initialized()):
+            return
+        import datetime
+        sid = const.ENV.ADT_STRATEGY_ID.val or datetime.datetime.now(
+            datetime.timezone.utc).strftime("%Y%m%dT%H%M%S%f")
+        # the build path reads the preset id from env when serializing
+        os.environ[const.ENV.ADT_STRATEGY_ID.name_str] = sid
+        from autodist_tpu.runtime.cluster import SSHCluster
+        from autodist_tpu.runtime.coordinator import Coordinator
+        cluster = SSHCluster(self._resource_spec)
+        self._coordinator = Coordinator(sid, cluster)
+        self._coordinator.launch_clients(copy_strategy=False)
+        cluster.start()  # joins as process 0; returns once workers connect
+        # atexit runs LIFO: this must fire BEFORE cluster.terminate (the
+        # registration inside start()) so a clean exit flags the watchers
+        # before terminate's SIGTERM makes a trailing worker "die"
+        import atexit
+        atexit.register(self._coordinator.stop_watchdog)
 
     @property
     def resource_spec(self) -> ResourceSpec:
@@ -136,17 +173,21 @@ class AutoDist:
         strategy_id = const.ENV.ADT_STRATEGY_ID.val
         if not strategy_id:
             raise RuntimeError("worker process missing ADT_STRATEGY_ID")
-        # the Coordinator copies the file before launching this process, but
-        # local-FS latency can still race the first read — wait bounded-time
-        deadline = time.monotonic() + 60.0
+        # chief-launched workers start BEFORE the strategy exists (the
+        # chief must launch + join the runtime before it can trace), so
+        # this poll bounds the chief's whole build + the file copy — the
+        # default must absorb a large model's trace/compile time
+        wait_s = float(os.environ.get("ADT_STRATEGY_WAIT_S", "600"))
+        deadline = time.monotonic() + wait_s
         while True:
             try:
                 return Strategy.deserialize(strategy_id)
             except (FileNotFoundError, json.JSONDecodeError):
                 if time.monotonic() > deadline:
                     raise RuntimeError(
-                        "strategy %s not available after 60s; did the chief "
-                        "fail before serializing?" % strategy_id)
+                        "strategy %s not available after %.0fs; did the "
+                        "chief fail before serializing?"
+                        % (strategy_id, wait_s))
                 time.sleep(0.2)
 
     def _setup(self, strategy: Strategy):
@@ -155,6 +196,12 @@ class AutoDist:
         do externally-launched jobs — their workers already exist, so
         SSH-launching clients would register duplicate process ids with the
         running jax.distributed job."""
+        if self._coordinator is not None:
+            # chief-launched flow: workers were launched (and the runtime
+            # joined) at construction; now that the strategy exists on
+            # disk, ship it — the workers are waiting in their poll
+            self._coordinator.distribute_strategy()
+            return
         if (self._resource_spec.is_single_node() or not const.is_chief()
                 or const.ENV.ADT_EXTERNAL_LAUNCH.val):
             return

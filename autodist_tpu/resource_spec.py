@@ -78,6 +78,11 @@ class SSHConfig:
         self.key_file = info.get("key_file", "")
         self.pkey = None
         self.env = dict(info.get("env", {}))
+        # "ssh" (default) or "local": local routes remote_exec/remote_copy
+        # through bash/cp on this machine — colocated processes (tests,
+        # single-host multi-process, loopback nodes) launch for real
+        # without an sshd
+        self.transport = info.get("transport", "ssh")
         # Make sure remote processes see the TPU runtime.
         self.env.setdefault("PYTHONNOUSERSITE", "True")
 

@@ -24,6 +24,7 @@ import os
 import shlex
 import signal
 import subprocess
+import time
 from typing import Dict, List, Optional
 
 from autodist_tpu import const
@@ -35,9 +36,17 @@ class Cluster:
     """Process layout + lifecycle for one training job."""
 
     def __init__(self, resource_spec: ResourceSpec,
-                 coordinator_port: int = const.DEFAULT_COORDINATOR_PORT,
-                 coordsvc_port=None):
+                 coordinator_port=None, coordsvc_port=None):
         self._spec = resource_spec
+        # explicit arg > ADT_COORDINATOR_ADDR's port > default — two
+        # colocated jobs (or parallel test runs) must not both bind the
+        # default port; the env is already honored on the worker side
+        if coordinator_port is None:
+            addr = const.ENV.ADT_COORDINATOR_ADDR.val
+            if addr and ":" in addr:
+                coordinator_port = int(addr.rsplit(":", 1)[1])
+            else:
+                coordinator_port = const.DEFAULT_COORDINATOR_PORT
         self._port = coordinator_port
         # single source of truth for the native coordination-service port
         # (server bring-up here, watchdog client in the Coordinator);
@@ -111,9 +120,18 @@ class Cluster:
         atexit.register(self.terminate)
         self._started = True
 
-    def terminate(self):
-        """SIGTERM launched remote process groups (reference ``cluster.py:176``)."""
+    def terminate(self, grace_s: float = 10.0):
+        """Terminate launched worker process groups (reference
+        ``cluster.py:176``), giving a clean-finishing job a grace window
+        first: the last collective syncs all processes, but trailing
+        local work (writing outputs) is not lockstep — killing on sight
+        truncates a worker that is milliseconds from a clean exit."""
+        deadline = time.monotonic() + grace_s
         for p in self._procs:
+            try:
+                p.wait(timeout=max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                pass
             if p.poll() is None:
                 try:
                     os.killpg(os.getpgid(p.pid), signal.SIGTERM)
@@ -122,6 +140,21 @@ class Cluster:
         self._procs.clear()
 
     # ------------------------------------------------------ remote helpers
+
+    def _is_local(self, address: str) -> bool:
+        """Loopback addresses (and nodes whose ssh_config says
+        ``transport: local``) execute through local bash/cp instead of
+        ssh/scp — the chief->worker launch path runs for real on one
+        machine, no sshd required (the reference's 2-node CI stage,
+        ``Jenkinsfile`` 'test-distributed', needed real machines)."""
+        conf = self._spec.ssh_config_map.for_host(address)
+        if conf is not None:
+            # an explicit ssh_config wins: a loopback address with ssh
+            # config is the port-forward-to-remote-host pattern (ssh -p
+            # 2222 127.0.0.1 reaches a DIFFERENT machine) and must keep
+            # going through ssh unless the config opts into local
+            return conf.transport == "local"
+        return address in ("localhost", "127.0.0.1", "::1")
 
     def _ssh_base(self, address: str) -> List[str]:
         conf: Optional[SSHConfig] = self._spec.ssh_config_map.for_host(address)
@@ -151,9 +184,13 @@ class Cluster:
                                   for k, v in sorted(merged.items())) + " "
         venv = ("source %s/bin/activate && " % conf.python_venv
                 if conf and conf.python_venv else "")
-        full = self._ssh_base(address) + [
-            "bash -c %s" % shlex.quote(venv + env_prefix + command)]
-        logging.info("remote_exec[%s]: %s", address, " ".join(full))
+        line = venv + env_prefix + command
+        if self._is_local(address):
+            full = ["bash", "-c", line]
+            logging.info("local_exec[%s]: %s", address, line)
+        else:
+            full = self._ssh_base(address) + ["bash -c %s" % shlex.quote(line)]
+            logging.info("remote_exec[%s]: %s", address, " ".join(full))
         if const.ENV.ADT_DEBUG_REMOTE.val:
             return None
         if wait:
@@ -164,7 +201,19 @@ class Cluster:
         return proc
 
     def remote_copy(self, local_path: str, remote_dir: str, address: str) -> bool:
-        """SCP a file to a remote node (reference ``remote_copy``)."""
+        """SCP a file to a remote node (reference ``remote_copy``); plain
+        cp for local-transport nodes (self-copy skipped)."""
+        if self._is_local(address):
+            logging.info("local_copy[%s]: %s -> %s", address, local_path,
+                         remote_dir)
+            if const.ENV.ADT_DEBUG_REMOTE.val:
+                return True
+            import shutil
+            os.makedirs(remote_dir, exist_ok=True)
+            dest = os.path.join(remote_dir, os.path.basename(local_path))
+            if os.path.abspath(local_path) != os.path.abspath(dest):
+                shutil.copy2(local_path, dest)
+            return True
         conf = self._spec.ssh_config_map.for_host(address)
         cmd = ["scp", "-oStrictHostKeyChecking=no", "-oBatchMode=yes"]
         if conf:

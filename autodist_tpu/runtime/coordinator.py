@@ -22,7 +22,12 @@ from autodist_tpu.utils import logging
 class Coordinator:
     def __init__(self, strategy, cluster: Cluster,
                  heartbeat_timeout: float = 60.0):
-        self._strategy = strategy
+        # a Strategy object, or just its id — the chief-launched flow
+        # preallocates the id and launches workers BEFORE the strategy is
+        # built (the chief's jax.distributed join blocks until every
+        # worker connects, and building requires tracing, which would
+        # initialize XLA before the join)
+        self._strategy_id = getattr(strategy, "id", strategy)
         self._cluster = cluster
         self._threads: List[threading.Thread] = []
         self._heartbeat_timeout = heartbeat_timeout
@@ -59,19 +64,29 @@ class Coordinator:
         t.start()
         self._threads.append(t)
 
-    def launch_clients(self):
+    def distribute_strategy(self):
+        """Copy the serialized strategy to every worker host (chief-side;
+        workers poll for the file by id). In the chief-launched flow this
+        runs AFTER the workers are already up — they wait in their
+        strategy poll until the file lands."""
+        strategy_path = os.path.join(const.DEFAULT_SERIALIZATION_DIR,
+                                     self._strategy_id)
+        for address in self._cluster.process_addresses:
+            if not self._cluster.is_chief(address):
+                self._cluster.remote_copy(
+                    strategy_path, const.DEFAULT_SERIALIZATION_DIR, address)
+
+    def launch_clients(self, copy_strategy: bool = True):
         """Relaunch this script on every non-chief host."""
         script = os.path.abspath(sys.argv[0])
         argv_rest = " ".join(sys.argv[1:])
-        strategy_path = os.path.join(const.DEFAULT_SERIALIZATION_DIR,
-                                     self._strategy.id)
+        if copy_strategy:
+            self.distribute_strategy()
         for address in self._cluster.process_addresses:
             if self._cluster.is_chief(address):
                 continue
-            self._cluster.remote_copy(strategy_path,
-                                      const.DEFAULT_SERIALIZATION_DIR, address)
             env = self._cluster.worker_env(address)
-            env[const.ENV.ADT_STRATEGY_ID.name_str] = self._strategy.id
+            env[const.ENV.ADT_STRATEGY_ID.name_str] = self._strategy_id
             # propagate the debugging/testing knobs only when explicitly set
             # locally — an empty string would override the worker's default
             # (reference coordinator.py:70-79)
@@ -88,10 +103,14 @@ class Coordinator:
                          address, self._cluster.process_id(address))
 
     def _proc_wait_async(self, proc, address: str):
-        """Fail-fast watcher (reference ``coordinator.py:98-110``)."""
+        """Fail-fast watcher (reference ``coordinator.py:98-110``). A
+        worker death after the job finished cleanly (``stop_watchdog``
+        set — e.g. the chief's exit-time terminate SIGTERMing a trailing
+        worker) is shutdown, not failure, and must not abort a
+        successful run with exit code 1."""
         def watch():
             code = proc.wait()
-            if code != 0:
+            if code != 0 and not self._stop_watchdog.is_set():
                 logging.error("worker %s exited with code %s — aborting job",
                               address, code)
                 os._exit(1)

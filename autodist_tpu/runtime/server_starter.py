@@ -46,6 +46,10 @@ def init_distributed(coordinator_address: str, num_processes: int,
     _INITIALIZED = True
 
 
+def initialized() -> bool:
+    return _INITIALIZED
+
+
 def maybe_init_distributed():
     """Worker-side auto-join from the env the Coordinator set
     (chief side passes explicit args via Cluster.start)."""
