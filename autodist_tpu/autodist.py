@@ -46,9 +46,25 @@ def get_default_autodist():
 
 
 def reset():
-    """Clear process-global state (for tests; the reference isolates with
-    fresh subprocesses instead, ``tests/integration/test_all.py:53-69``)."""
-    _DEFAULT_AUTODIST.clear()
+    """Clear process-global state (for tests and sequential programmatic
+    use; the reference isolates with fresh subprocesses instead,
+    ``tests/integration/test_all.py:53-69``). Clearing the registry alone
+    is not isolation — serving threads, coordination sockets, a capture
+    context leaked by an exception mid-trace, and the optimizer-capture
+    registry would all bleed into the next build, so reset tears each
+    down."""
+    inst = _DEFAULT_AUTODIST.get(0)
+    _DEFAULT_AUTODIST.clear()  # clear FIRST: reset is the documented
+    # recovery path and must work even when teardown (or a half-finished
+    # __init__ that registered itself before failing) raises
+    if inst is not None:
+        try:
+            inst.close()
+        except AttributeError:
+            pass  # __init__ failed before those attributes existed
+    from autodist_tpu.ops import embedding
+    embedding.clear_capture()
+    patch.clear_captured()
 
 
 class AutoDist:
@@ -300,6 +316,19 @@ class AutoDist:
                     lambda: CoordinationClient(coord_host, port),
                     prefix="ps:" + host)
         dstep.ps_store.enable_serving(service_for_host, my_host)
+
+    def close(self):
+        """Tear down everything this instance started: the runner's
+        coordination clients, the host-PS store's serving threads and
+        service sockets, and the coordinator's watchers. Called by
+        ``autodist_tpu.reset()``; safe to call twice."""
+        runner = getattr(self, "_runner", None)
+        if runner is not None:
+            runner.close()
+            self._runner = None
+        coordinator = getattr(self, "_coordinator", None)
+        if coordinator is not None:
+            coordinator.stop_watchdog()
 
     def function(self, loss_fn: Callable, *, optimizer, params, example_batch=None,
                  has_aux: bool = False) -> Callable:

@@ -170,8 +170,11 @@ def train_batches(record_path: str, data: RatingsData, pos_per_batch: int,
     sampler = NegativeSampler(data, neg_per_pos=neg_per_pos, seed=seed)
     ds = RecordFileDataset(record_path, batch_size=pos_per_batch,
                            shuffle=True, seed=seed, num_threads=num_threads)
-    for batch in ds:
-        yield sampler.batch(batch["user"], batch["item"])
+    try:
+        for batch in ds:
+            yield sampler.batch(batch["user"], batch["item"])
+    finally:
+        ds.close()  # abandoned iterators must not leak native threads
 
 
 def evaluate_hit_ndcg(score_fn, holdout: Dict[int, int], data: RatingsData,
@@ -187,7 +190,7 @@ def evaluate_hit_ndcg(score_fn, holdout: Dict[int, int], data: RatingsData,
     sampler = NegativeSampler(data, neg_per_pos=num_negatives,
                               seed=seed + 1)
     users = np.asarray(sorted(holdout), np.int32)
-    hits, ndcg = 0.0, 0.0
+    hits, ndcg, false_neg = 0.0, 0.0, 0
     for c0 in range(0, len(users), chunk):
         u = users[c0:c0 + chunk]
         pos = np.asarray([holdout[int(x)] for x in u], np.int32)
@@ -199,6 +202,10 @@ def evaluate_hit_ndcg(score_fn, holdout: Dict[int, int], data: RatingsData,
             if not bad.any():
                 break
             neg_i[bad] = rng.randint(0, data.num_items, int(bad.sum()))
+        else:
+            # residual collisions are REPORTED, never silently accepted
+            false_neg += int((sampler._is_positive(neg_u, neg_i) | (
+                neg_i == np.repeat(pos, num_negatives))).sum())
         all_u = np.concatenate([u, neg_u])
         all_i = np.concatenate([pos, neg_i])
         scores = np.asarray(score_fn(all_u, all_i), np.float32)
@@ -208,4 +215,5 @@ def evaluate_hit_ndcg(score_fn, holdout: Dict[int, int], data: RatingsData,
         hits += float((rank < k).sum())
         ndcg += float((np.log(2.0) / np.log(rank + 2.0))[rank < k].sum())
     n = float(len(users))
-    return {"hr": hits / n, "ndcg": ndcg / n, "users": int(n)}
+    return {"hr": hits / n, "ndcg": ndcg / n, "users": int(n),
+            "false_negatives": false_neg}

@@ -256,6 +256,14 @@ class GraphTransformer:
 
     # ---------------------------------------------------------------- helpers
 
+    def _replica_info(self):
+        """Replication bookkeeping via the Replicator kernel (the
+        reference's Partitioner -> Replicator -> Synchronizer pipeline)."""
+        from autodist_tpu.kernel.replicator import Replicator
+        batch_axes = tuple(
+            self._strategy.graph_config.batch_axes or (self._axis,))
+        return Replicator.apply(self._mesh, batch_axes, self._seq_axis)
+
     def _build_synchronizers(self, layouts, ps_names=frozenset(),
                              sparse_wire=frozenset()) -> Dict[str, Synchronizer]:
         """Per-variable synchronizer kernels from strategy node configs
@@ -381,21 +389,15 @@ class GraphTransformer:
             loss_plain = (lambda p, b: item.loss_fn(p, b)[0]) if item.has_aux \
                 else item.loss_fn
             # taps live INSIDE shard_map: discover against the per-device
-            # (local) batch shape, not the host-global one
-            g_batch_axes = tuple(
-                self._strategy.graph_config.batch_axes or (self._axis,))
-            bf = int(np.prod([self._mesh.shape[a] for a in g_batch_axes]))
-            sf = (int(self._mesh.shape[self._seq_axis])
-                  if self._seq_axis else 1)
+            # (local) batch shape, not the host-global one. ReplicaInfo is
+            # the SAME source the shard_map in_specs use below, so the tap
+            # shapes cannot disagree with the actual batch split.
+            rep = self._replica_info()
 
             def local_aval(leaf):
-                shape = list(np.shape(leaf))
-                if len(shape) >= 1 and shape[0] % bf == 0:
-                    shape[0] //= bf
-                if sf > 1 and len(shape) >= 2 and shape[1] % sf == 0:
-                    shape[1] //= sf
                 return jax.ShapeDtypeStruct(
-                    tuple(shape), np.asarray(leaf).dtype
+                    rep.local_shape(np.shape(leaf)),
+                    np.asarray(leaf).dtype
                     if not hasattr(leaf, "dtype") else leaf.dtype)
             local_batch = jax.tree_util.tree_map(local_aval,
                                                  item.example_batch)
@@ -691,17 +693,11 @@ class GraphTransformer:
                                             sync_state_init())
         state_specs = TrainState(step=P(), params=param_specs,
                                  opt_state=opt_specs, sync_state=sync_specs)
-        seq_axis = self._seq_axis
-        batch_axes = tuple(self._strategy.graph_config.batch_axes or (axis,))
-
-        def batch_pspec(leaf):
-            nd = np.ndim(leaf)
-            if nd == 0:
-                return P()
-            if seq_axis and nd >= 2:
-                return P(batch_axes, seq_axis)
-            return P(batch_axes)
-        batch_specs = jax.tree_util.tree_map(batch_pspec, item.example_batch)
+        # replication bookkeeping (replica count, batch specs, local
+        # shapes) has a single owner: the Replicator kernel
+        rep = self._replica_info()
+        batch_specs = jax.tree_util.tree_map(
+            lambda leaf: rep.batch_spec(np.ndim(leaf)), item.example_batch)
 
         # metrics out-structure from an abstract eval of the loss (may fail
         # for SP losses that need a bound axis; scalar-loss fallback)

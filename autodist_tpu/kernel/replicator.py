@@ -1,19 +1,22 @@
-"""Replicator — data-parallel replication.
+"""Replicator — data-parallel replication bookkeeping.
 
-Analog of reference ``autodist/kernel/replicator.py:60-156``, which re-imports
-the GraphDef once per local device under ``AutoDist-Replica-i/`` name scopes
-and rewires savers/variables/feeds per replica. Under SPMD there is nothing
-to copy: the mesh's data axis *is* the replica set — one traced program runs
-on every device with the batch sharded along that axis, and XLA's SPMD
-partitioner performs the replication the reference did with
-``import_graph_def`` × N. What remains of the Replicator is the bookkeeping:
-replica count/devices and the batch-sharding spec it contributes to the
-lowering (in-graph replication ≡ local mesh devices; between-graph
-replication ≡ the same axis spanning processes — reference
-``docs/design/architecture.rst:43-47``).
+Analog of reference ``autodist/kernel/replicator.py:60-156``, which
+re-imports the GraphDef once per local device under ``AutoDist-Replica-i/``
+name scopes and rewires savers/variables/feeds per replica. Under SPMD
+there is nothing to copy: the mesh's batch axes *are* the replica set —
+one traced program runs on every device with the batch sharded along those
+axes, and XLA's SPMD partitioner performs the replication the reference
+did with ``import_graph_def`` x N. What remains — and what this kernel
+owns for the GraphTransformer — is the replication bookkeeping: the
+replica count, the per-leaf batch PartitionSpec (including the sequence
+axis for SP losses), and the batch/sequence division factors used to
+derive per-device local shapes (in-graph replication ≡ local mesh
+devices; between-graph replication ≡ the same axes spanning processes —
+reference ``docs/design/architecture.rst:43-47``).
 """
-from typing import List
+from typing import Optional, Tuple
 
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from autodist_tpu import const
@@ -21,31 +24,62 @@ from autodist_tpu.kernel.kernel import Kernel
 
 
 class Replicator(Kernel):
-    def __init__(self, key, replica_devices: List[str], mesh,
-                 mesh_axis: str = const.DATA_AXIS):
+    def __init__(self, key, mesh, batch_axes: Tuple[str, ...],
+                 seq_axis: Optional[str] = None):
         super().__init__(key)
-        self._replica_devices = replica_devices
         self._mesh = mesh
-        self._axis = mesh_axis
+        self._batch_axes = tuple(batch_axes)
+        self._seq_axis = seq_axis
 
-    def _apply(self):
-        return ReplicaInfo(self._replica_devices, self._mesh, self._axis)
+    def _apply(self) -> "ReplicaInfo":
+        return ReplicaInfo(self._mesh, self._batch_axes, self._seq_axis)
 
 
 class ReplicaInfo:
-    def __init__(self, replica_devices, mesh, mesh_axis):
-        self.replica_devices = list(replica_devices)
+    """The lowering's single source for replica facts (consumed by
+    ``GraphTransformer.transform``)."""
+
+    def __init__(self, mesh, batch_axes: Tuple[str, ...],
+                 seq_axis: Optional[str] = None):
         self.mesh = mesh
-        self.mesh_axis = mesh_axis
+        self.batch_axes = tuple(batch_axes)
+        self.seq_axis = seq_axis
 
     @property
     def num_replicas(self) -> int:
-        return len(self.replica_devices)
+        """Replicas = total batch-axis extent (the reference's replica
+        count was its device-list length)."""
+        return int(np.prod([self.mesh.shape[a] for a in self.batch_axes]))
 
     @property
-    def batch_spec(self) -> P:
-        """Shard the leading (batch) dim across replicas."""
-        return P(self.mesh_axis)
+    def batch_factor(self) -> int:
+        """Leading-dim division factor from host-global to per-device."""
+        return self.num_replicas
+
+    @property
+    def seq_factor(self) -> int:
+        """Sequence-dim division factor (1 without sequence parallelism)."""
+        return int(self.mesh.shape[self.seq_axis]) if self.seq_axis else 1
+
+    def batch_spec(self, ndim: int) -> P:
+        """PartitionSpec for one batch leaf: leading dim over the batch
+        axes; dim 1 over the sequence axis for rank>=2 leaves under SP."""
+        if ndim == 0:
+            return P()
+        if self.seq_axis and ndim >= 2:
+            return P(self.batch_axes, self.seq_axis)
+        return P(self.batch_axes)
+
+    def local_shape(self, shape: Tuple[int, ...]) -> Tuple[int, ...]:
+        """Per-device shape of a batch leaf, when divisible — the inverse
+        of the sharding ``batch_spec`` declares."""
+        shape = list(shape)
+        if len(shape) >= 1 and shape[0] % self.batch_factor == 0:
+            shape[0] //= self.batch_factor
+        if self.seq_factor > 1 and len(shape) >= 2 \
+                and shape[1] % self.seq_factor == 0:
+            shape[1] //= self.seq_factor
+        return tuple(shape)
 
     def replica_name(self, i: int) -> str:
         return const.REPLICA_PREFIX.format(i)

@@ -60,6 +60,13 @@ def current_capture() -> Optional[SparseCapture]:
     return getattr(_TLS, "capture", None)
 
 
+def clear_capture() -> None:
+    """Drop any capture context leaked on this thread (an exception can
+    escape a trace before ``capture``'s finally restores the previous
+    context chain) — called by ``autodist_tpu.reset()``."""
+    _TLS.capture = None
+
+
 @contextlib.contextmanager
 def capture(taps: Optional[Dict[str, List]] = None, record: bool = False):
     prev = current_capture()

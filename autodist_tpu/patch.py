@@ -21,6 +21,13 @@ from typing import Any, Optional, Tuple
 # NamedTuples — not weakref-able). Bounded LRU so sweeps don't leak.
 _CAPTURED: "collections.OrderedDict[int, Tuple[Any, str, dict]]" = collections.OrderedDict()
 _CAPTURED_MAX = 128
+
+
+def clear_captured() -> None:
+    """Drop recorded optimizer constructions (``autodist_tpu.reset()``):
+    entries are keyed by object id, and a stale entry can mis-describe a
+    NEW optimizer whose id the allocator reused."""
+    _CAPTURED.clear()
 _PATCHED = False
 
 # The widely-used optax optimizer constructors (the analog of the

@@ -163,6 +163,22 @@ class Runner:
         self._mirror_coord.close()
         self._mirror_coord = False
 
+    def close(self):
+        """Release everything the runner opened: coordination-service
+        clients (pacing + mirror check) and the host-PS store's serving
+        threads/sockets. Idempotent."""
+        for attr in ("_coord", "_mirror_coord"):
+            client = getattr(self, attr)
+            if client not in (None, False):
+                try:
+                    client.close()
+                except OSError:
+                    pass
+            setattr(self, attr, None)
+        store = getattr(self._dstep, "ps_store", None)
+        if store is not None:
+            store.close()
+
     def gather_params(self):
         return self._dstep.gather_params(self.state)
 

@@ -46,16 +46,25 @@ def run_real_data(args, builder):
 
 
 def _run_real_data_inner(args, builder, train, holdout, record_path):
+    import math
+    import jax
     from autodist_tpu.data import movielens
+    # AutoDist BEFORE any device query (multi-node chief-launch joins the
+    # distributed runtime at construction)
+    ad = adt.AutoDist(resource_spec_file=args.resource_spec,
+                      strategy_builder=builder)
     cfg = ncf.NCFConfig(num_users=train.num_users, num_items=train.num_items)
     loss_fn, params, _, apply_fn = ncf.make_train_setup(cfg)
 
-    pos_per_batch = max(1, args.batch_size // (1 + args.neg_per_pos))
+    # global batch = pos x (1 + negatives) and must divide by the replica
+    # count; round pos to the smallest multiple that makes it so
+    group = 1 + args.neg_per_pos
+    n_dev = len(jax.devices())
+    step = n_dev // math.gcd(group, n_dev)
+    pos_per_batch = max(step, (args.batch_size // group) // step * step)
     batches = movielens.train_batches(record_path, train, pos_per_batch,
                                       neg_per_pos=args.neg_per_pos)
     first = next(batches)
-    ad = adt.AutoDist(resource_spec_file=args.resource_spec,
-                      strategy_builder=builder)
     runner = ad.build(loss_fn, optax.adam(1e-3), params, first)
     runner.init(params)
     hook = ExamplesPerSecondHook(len(first["user"]), every_n_steps=20,

@@ -1,0 +1,111 @@
+"""reset() must actually isolate sequential in-process combos.
+
+The reference runs every strategy x case combo in a fresh subprocess
+(``tests/integration/test_all.py:53-69``); our matrix runs in-process on
+``reset()``, so reset has to tear down every piece of process-global
+state a combo can leak: async-PS serving threads, coordination sockets,
+a capture context left by an exception mid-trace, and the id-keyed
+optimizer-capture registry.
+"""
+import numpy as np
+import jax.numpy as jnp
+import optax
+import pytest
+
+import autodist_tpu as adt
+from autodist_tpu import patch, strategy
+from autodist_tpu.ops import embedding
+
+
+def _linreg(seed=0):
+    rng = np.random.RandomState(seed)
+    params = {"w": jnp.zeros((8, 2), jnp.float32)}
+
+    def loss_fn(p, b):
+        return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+    batch = {"x": rng.randn(16, 8).astype(np.float32),
+             "y": rng.randn(16, 2).astype(np.float32)}
+    return params, loss_fn, batch
+
+
+def test_reset_stops_async_serving_threads():
+    """An async-PS combo leaves an owner apply thread and a published
+    service behind; reset() must stop the thread (a live one would keep
+    applying stale gradients into the next combo's process)."""
+    params, loss_fn, batch = _linreg()
+    ad = adt.AutoDist(strategy_builder=strategy.PS(sync=False))
+    runner = ad.build(loss_fn, optax.sgd(0.1), params, batch)
+    runner.init(params)
+    runner.run(batch)
+    store = runner.distributed_step.ps_store
+    workers = [g["worker"] for g in store._serve_groups.values()
+               if g["worker"] is not None]
+    assert workers and all(w._thread.is_alive() for w in workers)
+    adt.reset()
+    assert all(not w._thread.is_alive() for w in workers)
+
+
+def test_reset_clears_leaked_capture_context():
+    """A capture context orphaned by an exception mid-trace must not leak
+    taps into the next build's lookups."""
+    embedding._TLS.capture = embedding.SparseCapture(record=True)
+    assert embedding.current_capture() is not None
+    adt.reset()
+    assert embedding.current_capture() is None
+
+
+def test_reset_clears_optimizer_capture_registry():
+    """The optimizer registry keys by object id; across a reset the
+    allocator can reuse a freed id for a DIFFERENT optimizer, which would
+    then inherit the stale record."""
+    patch.patch_optax()
+    opt = optax.adam(1e-3)
+    name, _ = patch.lookup_optimizer(opt)
+    assert name  # captured
+    adt.reset()
+    assert patch.lookup_optimizer(opt)[0] is None
+
+
+def test_combo_results_identical_after_interleaved_combos():
+    """State-bleed canary: combo A's trajectory must be bit-identical
+    whether it runs first or after unrelated combos (async PS + sparse)
+    with resets in between."""
+    def run_a():
+        params, loss_fn, batch = _linreg()
+        ad = adt.AutoDist(strategy_builder=strategy.AllReduce())
+        runner = ad.build(loss_fn, optax.adam(1e-2), params, batch)
+        runner.init(params)
+        losses = [float(runner.run(batch)["loss"]) for _ in range(4)]
+        out = {k: np.asarray(v) for k, v in runner.gather_params().items()}
+        adt.reset()
+        return losses, out
+
+    first_losses, first_params = run_a()
+
+    # unrelated combos in between: async serving + a sparse-wire build
+    params, loss_fn, batch = _linreg(seed=7)
+    ad = adt.AutoDist(strategy_builder=strategy.PS(sync=False))
+    r = ad.build(loss_fn, optax.sgd(0.1), params, batch)
+    r.init(params)
+    r.run(batch)
+    adt.reset()
+
+    rng = np.random.RandomState(3)
+    sp_params = {"emb": jnp.asarray(rng.randn(64, 8), jnp.float32)}
+
+    def sp_loss(p, b):
+        from autodist_tpu.ops.embedding import embedding_lookup
+        return jnp.mean(embedding_lookup(p["emb"], b["ids"], name="emb") ** 2)
+
+    sp_batch = {"ids": rng.randint(0, 64, (16,)).astype(np.int32)}
+    ad = adt.AutoDist(strategy_builder=strategy.Parallax())
+    r = ad.build(sp_loss, optax.sgd(0.1), sp_params, sp_batch)
+    r.init(sp_params)
+    r.run(sp_batch)
+    adt.reset()
+
+    again_losses, again_params = run_a()
+    np.testing.assert_array_equal(first_losses, again_losses)
+    for k in first_params:
+        np.testing.assert_array_equal(first_params[k], again_params[k])
