@@ -80,8 +80,20 @@ def main():
     ad = adt.AutoDist(resource_spec_file=spec_yaml,
                       strategy_builder=BUILDERS[builder_name]())
     params, loss_fn, batch = make_case()
-    step = ad.function(loss_fn, optimizer=optax.sgd(0.1), params=params)
+    import os
+    opt = (optax.adam(1e-2) if os.environ.get("ADT_TEST_OPTIMIZER") == "adam"
+           else optax.sgd(0.1))
+    step = ad.function(loss_fn, optimizer=opt, params=params)
     losses = [float(step(batch)["loss"]) for _ in range(n_steps)]
+    save_dir = os.environ.get("ADT_TEST_SAVE_DIR")
+    if save_dir:
+        # checkpoint after training (async-PS completeness test: the saved
+        # opt state must include peer-owned shards' moments, which only
+        # exist locally as frozen init — they come off the wire). EVERY
+        # process calls save(): the gathers are collectives under sync
+        # builders; the default chief_only gates the file writes
+        from autodist_tpu.checkpoint.saver import Saver
+        Saver(directory=save_dir).save(step.get_runner())
     gathered = step.get_runner().gather_params()
     result = {
         "process_count": jax.process_count(),

@@ -257,6 +257,34 @@ def test_two_process_async_per_shard_ownership(tmp_path):
                 assert len(hosts) == 1, (name, si, hosts)
 
 
+def test_two_process_async_checkpoint_completeness(tmp_path):
+    """A chief-side checkpoint under per-shard async ownership must carry
+    LIVE Adam moments for every shard — including shards owned by the
+    worker, whose moments exist on the chief only as frozen zero init and
+    must come off the owner's published blob. A broken opt wire would
+    save half-zero moments (silent optimizer corruption on resume)."""
+    ckpt_dir = tmp_path / "ckpt"
+    with _coordination_service():
+        chief, worker = _launch_pair(
+            tmp_path, "PSAsyncPart", n_steps=10, external=True,
+            extra_env={"ADT_TEST_SAVE_DIR": str(ckpt_dir),
+                       "ADT_TEST_OPTIMIZER": "adam"})
+        for r in (chief, worker):
+            assert r["losses"][-1] < r["losses"][0]
+        metas = sorted(ckpt_dir.glob("ckpt-*.meta.json"))
+        assert metas, "chief saved no checkpoint"
+        stem = str(metas[-1])[: -len(".meta.json")]
+        opt = np.load(stem + ".opt.npz")
+        # the partitioned var's mu must be non-zero in EVERY shard range
+        mu_keys = [k for k in opt.files if "/mu/" in k and "w1" in k]
+        assert mu_keys, opt.files
+        mu = opt[mu_keys[0]]
+        half = mu.shape[0] // 2
+        assert np.abs(mu[:half]).max() > 0, "first shard moments are zero"
+        assert np.abs(mu[half:]).max() > 0, \
+            "second (peer-owned) shard moments are zero — opt wire broken"
+
+
 def test_two_process_mirror_check(tmp_path):
     """Sync host-PS across two real processes with the mirror-digest
     cross-check active (ADT_PS_MIRROR_CHECK_EVERY): every process's host
