@@ -512,7 +512,19 @@ class GraphTransformer:
             return st
 
         # ----- the local (per-device) step executed under shard_map
-        grad_fn = jax.value_and_grad(item.loss_fn, has_aux=item.has_aux)
+        # gradient rematerialization (graph_config.remat): compute grads
+        # through jax.checkpoint so the backward recomputes activations
+        # instead of storing them — the HBM-for-FLOPs trade
+        remat = self._strategy.graph_config.remat
+
+        def remat_wrap(f):
+            if not remat:
+                return f
+            from autodist_tpu.strategy.remat import remat_transform
+            return remat_transform(remat)(f)
+
+        grad_fn = jax.value_and_grad(remat_wrap(item.loss_fn),
+                                     has_aux=item.has_aux)
         if sparse_wire:
             def loss_with_taps(full_params, taps, batch):
                 with embedding_lib.capture(taps) as cap:
@@ -520,7 +532,7 @@ class GraphTransformer:
                 loss, aux = (out if item.has_aux else (out, None))
                 return loss, (aux, cap.ids)
             sparse_grad_fn = jax.value_and_grad(
-                loss_with_taps, argnums=(0, 1), has_aux=True)
+                remat_wrap(loss_with_taps), argnums=(0, 1), has_aux=True)
         optimizer = item.optimizer
         has_aux = item.has_aux
         axis = self._axis

@@ -16,10 +16,11 @@ from autodist_tpu.strategy.tensor_parallel_strategy import TensorParallel
 from autodist_tpu.strategy.pipeline_parallel_strategy import PipelineParallel
 from autodist_tpu.strategy.expert_parallel_strategy import ExpertParallel
 from autodist_tpu.strategy.auto_strategy import AutoStrategy
+from autodist_tpu.strategy.remat import WithRemat
 
 __all__ = ["Strategy", "StrategyBuilder", "StrategyCompiler", "VarConfig",
            "GraphConfig", "PSSynchronizer", "AllReduceSynchronizer",
            "PS", "PSLoadBalancing", "PartitionedPS", "UnevenPartitionedPS",
            "AllReduce", "PartitionedAR", "RandomAxisPartitionAR", "Parallax",
            "SequenceParallelAR", "TensorParallel", "PipelineParallel",
-           "ExpertParallel", "AutoStrategy"]
+           "ExpertParallel", "AutoStrategy", "WithRemat"]

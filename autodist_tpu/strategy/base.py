@@ -177,17 +177,25 @@ class GraphConfig:
     # axis. Expert-parallel strategies set ['data', 'expert'] so every
     # device sees distinct tokens
     batch_axes: Optional[List[str]] = None
+    # gradient rematerialization: None (store all activations), "full"
+    # (jax.checkpoint — recompute the forward in the backward, minimum
+    # HBM), or "dots" (save matmul outputs only). A graph-level transform
+    # the TF reference had no equivalent for; on TPU it is the standard
+    # HBM-for-FLOPs trade that lets bigger batches/models fit
+    remat: Optional[str] = None
 
     def to_dict(self):
         return {"replicas": list(self.replicas), "mesh_shape": self.mesh_shape,
-                "seq_axis": self.seq_axis, "batch_axes": self.batch_axes}
+                "seq_axis": self.seq_axis, "batch_axes": self.batch_axes,
+                "remat": self.remat}
 
     @classmethod
     def from_dict(cls, d):
         return cls(replicas=list(d.get("replicas", [])),
                    mesh_shape=d.get("mesh_shape"),
                    seq_axis=d.get("seq_axis"),
-                   batch_axes=d.get("batch_axes"))
+                   batch_axes=d.get("batch_axes"),
+                   remat=d.get("remat"))
 
 
 # ----------------------------------------------------------------- strategy

@@ -1,0 +1,53 @@
+"""WithRemat — gradient rematerialization as a composable strategy wrapper.
+
+A TPU-native graph-level knob the reference had no equivalent for (its
+strategy space was purely about gradient synchronization): wraps ANY
+strategy builder and sets ``graph_config.remat``, making the lowering
+compute gradients through ``jax.checkpoint`` — the backward pass
+recomputes forward activations instead of storing them, trading FLOPs for
+HBM so larger batches/models fit. Policies:
+
+- ``"full"``  — save nothing but inputs (maximum HBM saving, ~1/3 more
+  FLOPs for a transformer);
+- ``"dots"``  — save matmul outputs without batch dims
+  (``jax.checkpoint_policies.dots_with_no_batch_dims_saveable``): the
+  usual sweet spot — elementwise/norm activations are recomputed, the
+  expensive contractions are not.
+
+The knob rides the serialized strategy like every other field, so workers
+lower the identical rematerialized program.
+
+    ad = adt.AutoDist(strategy_builder=WithRemat(strategy.AllReduce(),
+                                                 policy="dots"))
+"""
+from autodist_tpu.strategy.base import Strategy, StrategyBuilder
+
+REMAT_POLICIES = ("full", "dots")
+
+
+def remat_transform(policy: str):
+    """Policy name -> function wrapper. The single source for the policy
+    set — WithRemat validates against it and the lowering applies it, so
+    the two can never drift."""
+    import jax
+    if policy == "full":
+        return jax.checkpoint
+    if policy == "dots":
+        return lambda f: jax.checkpoint(
+            f, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    raise ValueError("unknown remat policy %r (have %s)"
+                     % (policy, list(REMAT_POLICIES)))
+
+
+class WithRemat(StrategyBuilder):
+    def __init__(self, inner: StrategyBuilder, policy: str = "full"):
+        if policy not in REMAT_POLICIES:
+            raise ValueError("unknown remat policy %r (have %s)"
+                             % (policy, list(REMAT_POLICIES)))
+        self._inner = inner
+        self._policy = policy
+
+    def build(self, model_item, resource_spec) -> Strategy:
+        strategy = self._inner.build(model_item, resource_spec)
+        strategy.graph_config.remat = self._policy
+        return strategy

@@ -1,0 +1,89 @@
+"""Gradient rematerialization (strategy.WithRemat / graph_config.remat).
+
+Remat must (a) change the lowered program — the backward recomputes
+forward contractions instead of reading stored activations — while (b)
+computing bit-identical gradients, and (c) ride the serialized strategy
+like every other field so workers lower the same program.
+"""
+import re
+
+import numpy as np
+import jax.numpy as jnp
+import optax
+import pytest
+
+import autodist_tpu as adt
+from autodist_tpu import strategy
+
+
+def _mlp(seed=0, depth=4, width=32):
+    rng = np.random.RandomState(seed)
+    params = {"w%d" % i: jnp.asarray(rng.randn(width, width) * 0.3,
+                                     jnp.float32) for i in range(depth)}
+
+    def loss_fn(p, batch):
+        h = batch["x"]
+        for i in range(depth):
+            h = jnp.tanh(h @ p["w%d" % i])
+        return jnp.mean((h - batch["y"]) ** 2)
+
+    batch = {"x": rng.randn(16, width).astype(np.float32),
+             "y": rng.randn(16, width).astype(np.float32)}
+    return params, loss_fn, batch
+
+
+def _lowered_and_losses(builder, n_steps=3):
+    params, loss_fn, batch = _mlp()
+    ad = adt.AutoDist(strategy_builder=builder)
+    runner = ad.build(loss_fn, optax.sgd(0.1), params, batch)
+    runner.init(params)
+    hlo = runner.distributed_step.lowered_text(
+        runner.state, runner.remapper.remap_feed(batch))
+    losses = [float(runner.run(batch)["loss"]) for _ in range(n_steps)]
+    gathered = {k: np.asarray(v) for k, v in runner.gather_params().items()}
+    remat = runner.distributed_step.strategy.graph_config.remat
+    adt.reset()
+    return hlo, losses, gathered, remat
+
+
+def test_remat_recomputes_but_matches_exactly():
+    hlo0, losses0, params0, r0 = _lowered_and_losses(strategy.AllReduce())
+    hlo1, losses1, params1, r1 = _lowered_and_losses(
+        strategy.WithRemat(strategy.AllReduce(), policy="full"))
+    assert r0 is None and r1 == "full"
+    # the rematerialized program recomputes the forward's contractions in
+    # the backward: strictly more dot ops than the store-activations plan
+    dots0 = len(re.findall(r"\bstablehlo\.dot_general\b", hlo0))
+    dots1 = len(re.findall(r"\bstablehlo\.dot_general\b", hlo1))
+    assert dots1 > dots0, (dots0, dots1)
+    # same math to the bit
+    np.testing.assert_array_equal(losses0, losses1)
+    for k in params0:
+        np.testing.assert_array_equal(params0[k], params1[k])
+
+
+def test_remat_dots_policy_lowers_and_matches():
+    _, losses0, params0, _ = _lowered_and_losses(strategy.AllReduce())
+    _, losses1, params1, r = _lowered_and_losses(
+        strategy.WithRemat(strategy.AllReduce(), policy="dots"))
+    assert r == "dots"
+    np.testing.assert_array_equal(losses0, losses1)
+    for k in params0:
+        np.testing.assert_array_equal(params0[k], params1[k])
+
+
+def test_remat_serializes_with_strategy():
+    from autodist_tpu.strategy.base import Strategy
+    params, loss_fn, batch = _mlp()
+    ad = adt.AutoDist(strategy_builder=strategy.WithRemat(
+        strategy.PSLoadBalancing(), policy="dots"))
+    runner = ad.build(loss_fn, optax.sgd(0.1), params, batch)
+    sid = runner.distributed_step.strategy.id
+    loaded = Strategy.deserialize(sid)
+    assert loaded.graph_config.remat == "dots"
+    adt.reset()
+
+
+def test_remat_rejects_unknown_policy():
+    with pytest.raises(ValueError, match="remat policy"):
+        strategy.WithRemat(strategy.AllReduce(), policy="everything")
