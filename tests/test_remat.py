@@ -87,3 +87,38 @@ def test_remat_serializes_with_strategy():
 def test_remat_rejects_unknown_policy():
     with pytest.raises(ValueError, match="remat policy"):
         strategy.WithRemat(strategy.AllReduce(), policy="everything")
+
+
+def test_remat_composes_with_sequence_parallel():
+    """Long context is where remat matters most: WithRemat around
+    SequenceParallelAR — jax.checkpoint over a loss containing ring
+    attention's collective_permute — must lower, run, and match the
+    non-remat SP trajectory to float tolerance (recompute changes XLA's
+    fusion boundaries, so ulp-level drift is expected — unlike the plain
+    MLP case, where the programs happen to agree bit-for-bit)."""
+    import jax
+    from autodist_tpu.models import lm
+
+    cfg = lm.LMConfig.tiny()
+    sp_loss, params, batch, _ = lm.make_sp_train_setup(
+        cfg, seq_len=32, batch_size=8, attention="ring")
+
+    def run(builder):
+        ad = adt.AutoDist(strategy_builder=builder)
+        runner = ad.build(sp_loss, optax.sgd(0.1), params, batch)
+        runner.init(params)
+        losses = [float(runner.run(batch)["loss"]) for _ in range(2)]
+        got = {jax.tree_util.keystr(p): np.asarray(v)
+               for p, v in jax.tree_util.tree_flatten_with_path(
+                   runner.gather_params())[0]}
+        adt.reset()
+        return losses, got
+
+    plain_losses, plain = run(strategy.SequenceParallelAR(seq_shards=4))
+    remat_losses, remat = run(strategy.WithRemat(
+        strategy.SequenceParallelAR(seq_shards=4), policy="full"))
+    np.testing.assert_allclose(plain_losses, remat_losses,
+                               rtol=1e-6, atol=1e-6)
+    for k in plain:
+        np.testing.assert_allclose(plain[k], remat[k], rtol=1e-5,
+                                   atol=1e-6, err_msg=k)
