@@ -128,6 +128,25 @@ BUILDERS = [
 ]
 
 
+def run_combo(builder_name: str, case_name: str, n_steps: int = 4):
+    """One combo's full trajectory — THE shared definition used both by
+    the in-process matrix equivalence check and the fresh-subprocess run
+    in tests/test_matrix_subprocess.py (both sides must execute the same
+    code for the comparison to mean anything)."""
+    import optax
+    autodist_tpu.reset()
+    params, loss_fn, batch = dict(CASES)[case_name]()
+    builder = dict(BUILDERS)[builder_name]()
+    runner = autodist_tpu.AutoDist(strategy_builder=builder).build(
+        loss_fn, optax.adam(1e-2), params, batch)
+    runner.init(params)
+    losses = [float(runner.run(batch)["loss"]) for _ in range(n_steps)]
+    flat = jax.tree_util.tree_flatten_with_path(runner.gather_params())[0]
+    params_out = {jax.tree_util.keystr(p): np.asarray(v) for p, v in flat}
+    autodist_tpu.reset()
+    return {"losses": losses, "params": params_out}
+
+
 # ------------------------------------------------------------------ matrix
 
 
