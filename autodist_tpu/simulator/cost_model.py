@@ -25,6 +25,17 @@ CHIP_PEAK_FLOPS = {
     "v5p": 459e12,
     "cpu": 5e10,
 }
+# HBM per chip by generation (public figures); "cpu" is host RAM order
+CHIP_HBM_BYTES = {
+    "v4": 32e9,
+    "v5e": 16e9,
+    "v5p": 95e9,
+    "cpu": 64e9,
+}
+# extra compute for gradient rematerialization: "full" re-runs the whole
+# forward in the backward (fwd+bwd ~3x fwd -> ~4x), "dots" recomputes
+# only the cheap non-contraction work (~3.5x)
+REMAT_COMPUTE_FACTOR = {None: 1.0, "full": 4.0 / 3.0, "dots": 3.5 / 3.0}
 DEFAULT_MXU_EFFICIENCY = 0.4      # achieved/peak for typical training steps
 WIRE_DTYPE_BYTES = 4              # gradients travel fp32 unless compressed
 # host<->device link for the host-offloaded PS path (no-proxy PS keeps
@@ -42,6 +53,16 @@ class CostBreakdown:
     allreduce_s: float
     ps_s: float
     latency_s: float
+    # per-device HBM estimate (params + optimizer + gradient buffer +
+    # activations) and whether it fits the chip — strategies change all
+    # four terms: host-PS offloads params/opt, ZeRO partitions them,
+    # remat shrinks activations
+    hbm_bytes: float = 0.0
+    hbm_capacity: float = float("inf")
+
+    @property
+    def feasible(self) -> bool:
+        return self.hbm_bytes <= self.hbm_capacity
 
     @property
     def step_time_s(self) -> float:
@@ -54,12 +75,16 @@ class CostModel:
     def __init__(self, model_item, resource_spec,
                  chip_kind: Optional[str] = None,
                  mxu_efficiency: float = DEFAULT_MXU_EFFICIENCY,
-                 flops_per_step: Optional[float] = None):
+                 flops_per_step: Optional[float] = None,
+                 hbm_capacity_bytes: Optional[float] = None):
         self._item = model_item
         self._spec = resource_spec
         self._chip = chip_kind or self._guess_chip()
         self._eff = mxu_efficiency
         self._flops = flops_per_step
+        self._hbm_capacity = (hbm_capacity_bytes if hbm_capacity_bytes
+                              is not None else CHIP_HBM_BYTES[self._chip])
+        self._act_cache = None
 
     def _guess_chip(self) -> str:
         kind = str(self._spec.slice_info.get("type", "")).lower()
@@ -84,16 +109,30 @@ class CostModel:
             pass
         return 32
 
+    def _loss_jaxpr(self):
+        """ONE cached trace of the loss (under a bound axis env so
+        collective-using losses trace too) shared by the FLOPs and
+        activation estimates — two traces could silently diverge when one
+        falls back and the other succeeds."""
+        if not hasattr(self, "_jaxpr_cache"):
+            try:
+                import jax
+                from autodist_tpu.utils.axis_env import bound_axes
+                with bound_axes():
+                    self._jaxpr_cache = jax.make_jaxpr(self._item.loss_fn)(
+                        self._item.params, self._item.example_batch)
+            except Exception:  # noqa: BLE001 — callers fall back
+                self._jaxpr_cache = None
+        return self._jaxpr_cache
+
     def flops_per_step(self) -> float:
         if self._flops is not None:
             return self._flops
-        try:
-            import jax
+        closed = self._loss_jaxpr()
+        if closed is not None:
             from autodist_tpu.kernel.common.utils import count_flops_estimate
-            closed = jax.make_jaxpr(self._item.loss_fn)(
-                self._item.params, self._item.example_batch)
             fwd = count_flops_estimate(closed.jaxpr)
-        except Exception:  # noqa: BLE001 — fall back to a params-based bound
+        else:
             # dense fwd ~ 2 * params * batch (the REAL batch size, not a
             # guess — a hardcoded 32 misranks compute- vs comm-bound
             # candidates for large-batch CNNs)
@@ -104,6 +143,143 @@ class CostModel:
     def compute_time(self, num_devices: int) -> float:
         peak = CHIP_PEAK_FLOPS[self._chip] * self._eff
         return self.flops_per_step() / max(num_devices, 1) / peak
+
+    # shape-only ops fuse away in XLA and hold no residual of their own
+    _FUSED_OPS = frozenset({
+        "broadcast_in_dim", "reshape", "transpose", "convert_element_type",
+        "squeeze", "expand_dims", "slice", "rev", "copy", "stop_gradient",
+        "reduce_precision"})
+
+    def _activation_profile(self):
+        """(saved-residual bytes, dot/conv output bytes, batch input
+        bytes) from the loss jaxpr — the activation-memory inputs for the
+        three remat modes. The walk counts LEAF eqn outputs only (a call
+        primitive's outputs are its body's outputs — counting both would
+        double), multiplies scan bodies by their trip count (a scanned
+        48-layer stack saves 48 layers of residuals, not one), and skips
+        shape-only ops XLA fuses away. Still a heuristic — no liveness
+        analysis — but for TRAINING the sum of non-trivial forward
+        outputs approximates the residual set autodiff actually keeps,
+        which is exactly the memory remat trades away."""
+        if self._act_cache is not None:
+            return self._act_cache
+        import numpy as np
+
+        def aval_bytes(v):
+            aval = getattr(v, "aval", None)
+            if aval is None or not hasattr(aval, "shape"):
+                return 0
+            return int(np.prod(aval.shape or (1,))) * np.dtype(
+                aval.dtype).itemsize
+
+        total, dots = 0.0, 0.0
+
+        def sub_jaxprs(eqn):
+            subs = []
+            for val in eqn.params.values():
+                for item in (val if isinstance(val, (list, tuple))
+                             else (val,)):
+                    if hasattr(item, "jaxpr"):
+                        subs.append(item.jaxpr)
+                    elif hasattr(item, "eqns") and hasattr(item, "invars"):
+                        subs.append(item)
+            return subs
+
+        def walk(jaxpr, mult):
+            nonlocal total, dots
+            for eqn in jaxpr.eqns:
+                name = eqn.primitive.name
+                subs = sub_jaxprs(eqn)
+                if name == "scan":
+                    inner_mult = mult * int(eqn.params.get("length", 1) or 1)
+                    for sub in subs:
+                        walk(sub, inner_mult)
+                elif subs:  # pjit/checkpoint/custom_vjp/while/cond bodies
+                    for sub in subs:
+                        walk(sub, mult)
+                elif name in self._FUSED_OPS:
+                    continue
+                else:
+                    b = mult * sum(aval_bytes(ov) for ov in eqn.outvars)
+                    total += b
+                    if name in ("dot_general", "conv_general_dilated"):
+                        dots += b
+
+        closed = self._loss_jaxpr()
+        if closed is not None:
+            import jax
+            walk(closed.jaxpr, 1)
+            batch_in = float(sum(
+                int(np.prod(np.shape(l) or (1,))) * np.dtype(
+                    np.asarray(l).dtype).itemsize
+                for l in jax.tree_util.tree_leaves(self._item.example_batch)))
+        else:  # params-based bound
+            total = 2.0 * self._item.total_bytes()
+            dots = total / 2
+            batch_in = total / 8
+        self._act_cache = (float(total), float(dots), float(batch_in))
+        return self._act_cache
+
+    def hbm_bytes(self, strategy: Strategy) -> float:
+        """Per-device HBM estimate under a strategy: device-resident
+        params + optimizer state + one gradient buffer + activations.
+        Host-PS (no proxy) offloads optimizer state (values are still
+        pulled to device each step); partitioned storage divides by the
+        replica count (ZeRO); ``graph_config.remat`` shrinks the
+        activation term ("dots": contraction outputs only; "full":
+        batch residuals plus the peak recompute window)."""
+        import jax
+        import numpy as np
+        infos = self._item.var_infos
+        n = max(len(strategy.graph_config.replicas), 1)
+        opt_total = 0.0
+        try:
+            spec = self._item.opt_state_spec
+            opt_total = sum(
+                int(np.prod(l.shape or (1,))) * np.dtype(l.dtype).itemsize
+                for l in jax.tree_util.tree_leaves(spec))
+        except Exception:  # noqa: BLE001 — no optimizer attached
+            pass
+        params_total = float(self._item.total_bytes())
+
+        mesh_shape = strategy.graph_config.mesh_shape or {}
+        device_params = 0.0
+        device_param_fraction_num = 0.0
+        for node in strategy.node_config:
+            info = infos.get(node.var_name)
+            if info is None:
+                continue
+            syncs = ([node.synchronizer] if node.synchronizer else
+                     [p.synchronizer for p in node.part_configs])
+            host_ps = any(isinstance(s, PSSynchronizer)
+                          and not s.local_replication for s in syncs)
+            share = (1.0 / n) if node.partitioner and not host_ps else 1.0
+            if node.mp_axes:
+                # model-parallel storage: each device holds 1/extent of
+                # every sharded dim (tensor/pipeline/expert axes)
+                for _dim, axis in dict(node.mp_axes).items():
+                    share /= max(int(mesh_shape.get(axis, 1)), 1)
+            if host_ps:
+                # pulled copy lives on device during the step, but the
+                # optimizer state does not
+                device_params += info.byte_size
+            else:
+                device_params += info.byte_size * share
+                device_param_fraction_num += info.byte_size * share
+        opt_bytes = (opt_total * device_param_fraction_num / params_total
+                     if params_total else 0.0)
+        grad_bytes = device_params  # one gradient buffer alongside params
+
+        total_act, dot_act, batch_in = self._activation_profile()
+        remat = strategy.graph_config.remat
+        if remat == "full":
+            act = batch_in + (total_act - dot_act) * 0.1  # peak recompute
+        elif remat == "dots":
+            act = dot_act + batch_in
+        else:
+            act = total_act + batch_in
+        act /= n  # activations scale with the per-device batch shard
+        return device_params + opt_bytes + grad_bytes + act
 
     def _wire_bytes(self, info, sync, compressed: bool = True) -> float:
         from autodist_tpu.kernel.synchronization import compressor as compressor_lib
@@ -185,6 +361,10 @@ class CostModel:
         ps_s = pcie_s + (ps_bytes * 2.0 * (n - 1) / n / dcn_bw
                          if (n > 1 and not single) else 0.0)
         latency_s = PER_COLLECTIVE_LATENCY_S * (len(groups) + num_ps_transfers)
-        return CostBreakdown(compute_s=self.compute_time(n),
+        remat_factor = REMAT_COMPUTE_FACTOR.get(
+            strategy.graph_config.remat, 1.0)
+        return CostBreakdown(compute_s=self.compute_time(n) * remat_factor,
                              allreduce_s=allreduce_s, ps_s=ps_s,
-                             latency_s=latency_s)
+                             latency_s=latency_s,
+                             hbm_bytes=self.hbm_bytes(strategy),
+                             hbm_capacity=self._hbm_capacity)

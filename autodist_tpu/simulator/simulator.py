@@ -35,12 +35,26 @@ class Simulator:
 
     def rank(self, candidates: Sequence[Tuple[str, Strategy]]
              ) -> List[SimulationResult]:
+        """Feasible (fits-in-HBM) candidates rank ahead of infeasible
+        ones regardless of estimated speed — a fast strategy that OOMs is
+        not a strategy; within each group, cheapest step time wins. If
+        nothing fits, the ranking still returns (cheapest first) with a
+        warning rather than failing the build."""
         results = [self.simulate(s, label) for label, s in candidates]
-        results.sort(key=lambda r: r.step_time_s)
+        results.sort(key=lambda r: (not r.breakdown.feasible, r.step_time_s))
+        if results and not results[0].breakdown.feasible:
+            logging.warning(
+                "no candidate strategy fits the HBM estimate (best %s needs "
+                "%.1f GB of %.1f GB); ranking by speed anyway",
+                results[0].label, results[0].breakdown.hbm_bytes / 1e9,
+                results[0].breakdown.hbm_capacity / 1e9)
         for r in results:
             logging.debug("simulated %-28s step=%.3fms (compute=%.3f ar=%.3f "
-                          "ps=%.3f)", r.label, r.step_time_s * 1e3,
+                          "ps=%.3f hbm=%.2fGB%s)", r.label,
+                          r.step_time_s * 1e3,
                           r.breakdown.compute_s * 1e3,
                           r.breakdown.allreduce_s * 1e3,
-                          r.breakdown.ps_s * 1e3)
+                          r.breakdown.ps_s * 1e3,
+                          r.breakdown.hbm_bytes / 1e9,
+                          "" if r.breakdown.feasible else " INFEASIBLE")
         return results

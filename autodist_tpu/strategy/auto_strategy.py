@@ -21,6 +21,7 @@ def default_candidates() -> List[Tuple[str, StrategyBuilder]]:
     from autodist_tpu.strategy.partitioned_ps_strategy import PartitionedPS
     from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing
     from autodist_tpu.strategy.ps_strategy import PS
+    from autodist_tpu.strategy.remat import WithRemat
     return [
         ("PS", PS()),
         ("PSLoadBalancing", PSLoadBalancing()),
@@ -32,6 +33,13 @@ def default_candidates() -> List[Tuple[str, StrategyBuilder]]:
         ("PartitionedAR", PartitionedAR()),
         ("Parallax", Parallax()),
         ("Parallax/bf16", Parallax(compressor="HorovodCompressor")),
+        # activation-memory relief: ranks behind the plain variants on
+        # speed (extra recompute FLOPs) but ahead on the HBM feasibility
+        # gate when ACTIVATIONS dominate — ZeRO/host-PS above relieve
+        # param/optimizer memory instead; the gate picks whichever relief
+        # fits and is fastest
+        ("AllReduce/remat", WithRemat(AllReduce(chunk_size=512),
+                                      policy="dots")),
     ]
 
 
