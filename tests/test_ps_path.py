@@ -238,3 +238,41 @@ def test_mirror_digest_tracks_values():
     r2.run(batch2)
     assert r2.distributed_step.ps_store.mirror_digest() != d2
     adt.reset()
+
+
+def test_ps_chained_optimizer_clips_per_var_as_documented():
+    """Cross-variable optimizer coupling (global-norm clipping) decouples
+    on the host-PS path: each variable's update applies through its OWN
+    little optimizer tree, so the clip norm is per-variable — exactly the
+    reference's semantics with per-PS-device update ops, and exactly what
+    the PSStore docstring promises. Pin both sides with hand math: AR
+    clips by the GLOBAL norm, PS by each var's own."""
+    clip_c = 0.05
+    opt = optax.chain(optax.clip_by_global_norm(clip_c), optax.sgd(1.0))
+    loss_fn, params, batch = _model()
+
+    # hand-computed grads
+    g = jax.grad(loss_fn)(
+        {k: jnp.asarray(v) for k, v in params.items()}, batch)
+    flat = {k: np.asarray(v) for k, v in g.items()}
+    global_norm = np.sqrt(sum(float((a ** 2).sum()) for a in flat.values()))
+
+    r_ar, _, _ = _build(strategy.AllReduce(), opt=opt)
+    r_ar.run(batch)
+    got_ar = r_ar.gather_params()
+    adt.reset()
+    r_ps, _, _ = _build(strategy.PS(), opt=opt)
+    r_ps.run(batch)
+    got_ps = r_ps.gather_params()
+    adt.reset()
+
+    for k, g_k in flat.items():
+        var_norm = float(np.sqrt((g_k ** 2).sum()))
+        ar_scale = min(1.0, clip_c / global_norm)
+        ps_scale = min(1.0, clip_c / var_norm)
+        np.testing.assert_allclose(
+            np.asarray(got_ar[k]), params[k] - ar_scale * g_k,
+            rtol=1e-5, atol=1e-6, err_msg="AR global clip at %s" % k)
+        np.testing.assert_allclose(
+            np.asarray(got_ps[k]), params[k] - ps_scale * g_k,
+            rtol=1e-5, atol=1e-6, err_msg="PS per-var clip at %s" % k)
