@@ -206,6 +206,20 @@ def bench_model(label, pairs=8, iters=4, deadline=None):
     }
 
 
+def probe_main():
+    """Trivial device matmul — the parent's preflight. A tunnel that
+    cannot run this will time out every model; recording that fact in
+    the artifact separates 'framework broken' from 'device unreachable'."""
+    import jax
+    if os.environ.get("ADT_BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["ADT_BENCH_PLATFORM"])
+    t0 = time.perf_counter()
+    x = jax.numpy.ones((64, 64)) @ jax.numpy.ones((64, 64))
+    jax.block_until_ready(x)
+    print(RESULT_TAG + json.dumps(
+        {"probe_s": round(time.perf_counter() - t0, 2)}), flush=True)
+
+
 def child_main(label):
     """Run one model and print its result dict, tagged, as the last line."""
     import jax
@@ -225,7 +239,34 @@ def child_main(label):
     print(RESULT_TAG + json.dumps(res), flush=True)
 
 
-def _emit(models):
+def _run_tagged_child(args, timeout, child_box, env=None):
+    """Spawn a tagged child of this script (probe or model), enforce the
+    hard timeout (killing the child's whole process group, guarded
+    against it exiting in the race window), and return
+    (parsed result dict | None, error string | None)."""
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)] + list(args),
+        stdout=subprocess.PIPE, env=env, start_new_session=True, text=True)
+    child_box[0] = proc
+    try:
+        try:
+            out, _ = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            proc.communicate()
+            return None, "timeout"
+    finally:
+        child_box[0] = None
+    tagged = [ln for ln in out.splitlines() if ln.startswith(RESULT_TAG)]
+    if proc.returncode == 0 and tagged:
+        return json.loads(tagged[-1][len(RESULT_TAG):]), None
+    return None, "child rc=%s, no result" % proc.returncode
+
+
+def _emit(models, preflight=None):
     """Print the cumulative result line (full schema, always valid)."""
     skipped = sorted(k for k, m in models.items() if "skipped" in m)
     failed = sorted(k for k, m in models.items() if "error" in m)
@@ -250,6 +291,8 @@ def _emit(models):
         # crashes are NOT budget skips: flag them distinctly so a green
         # vs_baseline over the survivors cannot mask a real failure
         result["failed_models"] = failed
+    if preflight is not None:
+        result["preflight"] = preflight
     print(json.dumps(result), flush=True)
 
 
@@ -260,7 +303,12 @@ def main():
         "ADT_BENCH_MODELS", ",".join(MODEL_LABELS)).split(",") if s]
     t_start = time.perf_counter()
     models = {label: {"skipped": "not reached"} for label in labels}
-    _emit(models)  # a parseable line exists from second zero
+    preflight = [None]
+
+    def emit():
+        _emit(models, preflight[0])
+
+    emit()  # a parseable line exists from second zero
 
     child_box = [None]
 
@@ -278,6 +326,21 @@ def main():
     signal.signal(signal.SIGTERM, _on_term)
     signal.signal(signal.SIGINT, _on_term)
 
+    # preflight: can the device run a trivial matmul right now? An
+    # unreachable tunnel will time out every model; the artifact should
+    # say which failure this is
+    try:
+        res, err = _run_tagged_child(["--probe"], 150, child_box)
+        if err == "timeout":
+            preflight[0] = {"error": "device unreachable (probe timeout)"}
+            print("  PREFLIGHT: device unreachable", file=sys.stderr,
+                  flush=True)
+        else:
+            preflight[0] = res if res is not None else {"error": err}
+    except Exception as e:  # noqa: BLE001
+        preflight[0] = {"error": str(e)[:120]}
+    emit()
+
     attempted = False
     # tunnel stalls are transient: models that error out on the first
     # pass get ONE retry each while budget remains (second pass)
@@ -294,7 +357,7 @@ def main():
             if attempted and remaining < 180:
                 if "error" not in models.get(label, {}):
                     models[label] = {"skipped": "bench budget"}
-                    _emit(models)
+                    emit()
                 print("  skipping %s: %.0fs elapsed, budget %.0fs"
                       % (label, elapsed, budget_s),
                       file=sys.stderr, flush=True)
@@ -303,7 +366,7 @@ def main():
                 print("  retrying %s" % label, file=sys.stderr, flush=True)
             _run_model(label, models, remaining, per_model_cap, child_box)
             attempted = True
-            _emit(models)
+            emit()
         queue = [l for l in labels if "error" in models.get(l, {})]
         if not queue:
             break
@@ -319,32 +382,19 @@ def _run_model(label, models, remaining, per_model_cap, child_box):
     env = dict(os.environ, ADT_BENCH_MODEL_BUDGET_S=str(soft))
     t_model = time.perf_counter()
     try:
-        proc = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--model", label],
-            stdout=subprocess.PIPE, env=env, start_new_session=True,
-            text=True)
-        child_box[0] = proc
-        try:
-            out, _ = proc.communicate(timeout=hard)
-        except subprocess.TimeoutExpired:
-            os.killpg(proc.pid, signal.SIGKILL)
-            proc.communicate()
+        res, err = _run_tagged_child(["--model", label], hard, child_box,
+                                     env=env)
+        if err == "timeout":
             models[label] = {"error": "timeout after %.0fs" % hard}
             print("  %s TIMED OUT (%.0fs hard limit)" % (label, hard),
                   file=sys.stderr, flush=True)
-            return
-        finally:
-            child_box[0] = None
-        tagged = [ln for ln in out.splitlines()
-                  if ln.startswith(RESULT_TAG)]
-        if proc.returncode == 0 and tagged:
-            models[label] = json.loads(tagged[-1][len(RESULT_TAG):])
+        elif res is not None:
+            models[label] = res
             print("  %s done in %.0fs" % (
                 label, time.perf_counter() - t_model),
                 file=sys.stderr, flush=True)
         else:
-            models[label] = {
-                "error": "child rc=%s, no result" % proc.returncode}
+            models[label] = {"error": err}
     except Exception as e:  # noqa: BLE001 — one flaky model must not
         # cost the whole artifact
         models[label] = {"error": "%s: %s"
@@ -354,5 +404,7 @@ def _run_model(label, models, remaining, per_model_cap, child_box):
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--model":
         child_main(sys.argv[2])
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--probe":
+        probe_main()
     else:
         main()
