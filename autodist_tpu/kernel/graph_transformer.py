@@ -345,6 +345,26 @@ class GraphTransformer:
         # (possibly uneven) shard sizes instead of the padded device split.
         ps_plans = ps_lib.plan_host_ps(self._strategy, var_infos)
         ps_names = frozenset(ps_plans)
+        if ps_plans:
+            # the host store applies the optimizer PER VARIABLE (one
+            # little {"v": shard} tree each). A structure-sensitive
+            # optimizer (optax.multi_transform / masked wrappers) decides
+            # its transform from the tree it sees — on a little tree the
+            # label function resolves wrong and a variable would SILENTLY
+            # train under the wrong transform. Refuse loudly instead.
+            spec_repr = str(jax.tree_util.tree_structure(
+                item.opt_state_spec)) if item.optimizer is not None else ""
+            if any(s in spec_repr for s in (
+                    "MaskedState", "PartitionState",
+                    "MultiTransformState")):  # optax<0.2 name for the same
+                raise ValueError(
+                    "structure-sensitive optimizers (optax.multi_transform"
+                    "/masked) are not supported on the host-resident PS "
+                    "path: the store applies updates per variable, so "
+                    "tree-structure-based labels would resolve incorrectly."
+                    " Use local_proxy_variable=True (device-resident PS), "
+                    "an AllReduce family strategy, or per-variable "
+                    "optimizers without masking.")
         for n in ps_names:
             layouts[n] = VarLayout(name=n)
         ps_store = (ps_lib.PSStore(ps_plans, var_infos, item.optimizer)

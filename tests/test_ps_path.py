@@ -276,3 +276,35 @@ def test_ps_chained_optimizer_clips_per_var_as_documented():
         np.testing.assert_allclose(
             np.asarray(got_ps[k]), params[k] - ps_scale * g_k,
             rtol=1e-5, atol=1e-6, err_msg="PS per-var clip at %s" % k)
+
+
+def test_ps_rejects_structure_sensitive_optimizer():
+    """optax.multi_transform decides each leaf's transform from the TREE
+    it sees; the host store applies per-variable little trees, where a
+    label function resolves wrong — a variable would silently train
+    under the wrong transform. The build must refuse loudly; the proxied
+    (device-resident) path, which applies the optimizer on the full
+    tree, accepts the same optimizer."""
+    loss_fn, params, batch = _model()
+    opt = optax.multi_transform(
+        {"slow": optax.sgd(0.01), "fast": optax.sgd(0.5)},
+        lambda p: {k: ("fast" if k == "b" else "slow") for k in p})
+    ad = adt.AutoDist(strategy_builder=strategy.PS())
+    with pytest.raises(ValueError, match="structure-sensitive"):
+        ad.build(loss_fn, opt, params, batch)
+    adt.reset()
+    r, _, _ = _build(strategy.PS(local_proxy_variable=True), opt=opt)
+    g = jax.grad(loss_fn)({k: jnp.asarray(v) for k, v in params.items()},
+                          batch)
+    r.run(batch)
+    got = r.gather_params()
+    # the full-tree labels really applied: "b" stepped at the fast rate,
+    # "w1" at the slow one (finite loss alone cannot catch mislabeled
+    # transforms)
+    np.testing.assert_allclose(np.asarray(got["b"]),
+                               params["b"] - 0.5 * np.asarray(g["b"]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got["w1"]),
+                               params["w1"] - 0.01 * np.asarray(g["w1"]),
+                               rtol=1e-5, atol=1e-6)
+    adt.reset()
