@@ -4,7 +4,7 @@ Every model exposes ``make_train_setup(...) -> (loss_fn, params,
 example_batch, apply_fn)``, plugging directly into
 ``AutoDist.build(loss_fn, optimizer, params, example_batch)``.
 """
-from autodist_tpu.models import bert, cnn, lm, ncf, resnet  # noqa: F401
+from autodist_tpu.models import bert, cnn, dlrm, lm, ncf, resnet  # noqa: F401
 
 def _bert(cfg_ctor, **kw):
     cfg_kw = {k: kw.pop(k) for k in ("dtype",) if k in kw}
@@ -23,6 +23,7 @@ REGISTRY = {
     "bert_large": lambda **kw: _bert(bert.BertConfig.large, **kw),
     "lm": lambda **kw: lm.make_train_setup(**kw),
     "ncf": lambda **kw: ncf.make_train_setup(**kw),
+    "dlrm": lambda **kw: dlrm.make_train_setup(**kw),
 }
 
 

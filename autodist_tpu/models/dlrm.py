@@ -1,0 +1,119 @@
+"""DLRM — deep learning recommendation model (large-embedding flagship).
+
+The BASELINE target config the reference's benchmark suite pointed at
+("DLRM/Wide&Deep large-embedding recommender (auto-strategy)"): dense
+features through a bottom MLP, many per-feature embedding tables, explicit
+pairwise dot-product feature interactions, and a top MLP over
+[bottom output, interactions] (arXiv 1906.00091). The tables are the
+sparse/PS stress case at its most extreme — total embedding bytes dwarf
+the dense parameters by orders of magnitude, which is exactly the regime
+``AutoStrategy``'s cost model routes to load-balanced / partitioned PS
+with the (ids, values) sparse wire, while the small dense MLPs ride
+AllReduce (the Parallax split, chosen automatically).
+
+Every lookup goes through ``SparseEmbed`` so gradients synchronize
+batch-sized; interactions are one batched matmul (MXU-friendly), not the
+per-pair gathers of the original CUDA implementation.
+"""
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu.models.layers import SparseEmbed
+
+
+@dataclasses.dataclass
+class DLRMConfig:
+    # vocab size per sparse feature (ml/criteo-style: wildly uneven)
+    table_sizes: Tuple[int, ...] = (1_000_000, 500_000, 100_000, 10_000,
+                                    10_000, 1_000, 1_000, 100)
+    embed_dim: int = 64
+    num_dense: int = 13
+    bottom_mlp: Tuple[int, ...] = (512, 256, 64)
+    top_mlp: Tuple[int, ...] = (512, 256)
+    dtype: Any = jnp.float32
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("table_sizes", (64, 48, 32, 16))
+        kw.setdefault("embed_dim", 8)
+        kw.setdefault("num_dense", 4)
+        kw.setdefault("bottom_mlp", (16, 8))
+        kw.setdefault("top_mlp", (16,))
+        return cls(**kw)
+
+    def __post_init__(self):
+        if self.bottom_mlp[-1] != self.embed_dim:
+            raise ValueError(
+                "bottom_mlp must end at embed_dim (%d != %d): the bottom "
+                "output joins the embeddings in the interaction"
+                % (self.bottom_mlp[-1], self.embed_dim))
+
+
+class DLRM(nn.Module):
+    config: DLRMConfig
+
+    @nn.compact
+    def __call__(self, dense, sparse_ids):
+        """dense [B, num_dense] float; sparse_ids [B, num_tables] int."""
+        cfg = self.config
+        x = dense.astype(cfg.dtype)
+        for i, width in enumerate(cfg.bottom_mlp):
+            x = nn.relu(nn.Dense(width, dtype=cfg.dtype,
+                                 name="bottom_%d" % i)(x))
+        embs = [SparseEmbed(size, cfg.embed_dim, dtype=cfg.dtype,
+                            name="table_%d" % t)(sparse_ids[:, t])
+                for t, size in enumerate(cfg.table_sizes)]
+        # explicit pairwise dot interactions: one batched matmul over the
+        # stacked feature vectors, lower triangle (excluding self-pairs)
+        z = jnp.stack([x] + embs, axis=1)           # [B, F, d]
+        inter = jnp.einsum("bfd,bgd->bfg", z, z)    # [B, F, F]
+        f = z.shape[1]
+        li, lj = jnp.tril_indices(f, k=-1)
+        inter = inter[:, li, lj]                    # [B, F*(F-1)/2]
+        h = jnp.concatenate([x, inter.astype(cfg.dtype)], axis=-1)
+        for i, width in enumerate(cfg.top_mlp):
+            h = nn.relu(nn.Dense(width, dtype=cfg.dtype,
+                                 name="top_%d" % i)(h))
+        return nn.Dense(1, dtype=jnp.float32, name="click")(h)[..., 0]
+
+
+def make_train_setup(config: Optional[DLRMConfig] = None,
+                     batch_size: int = 256, seed: int = 0,
+                     hot_fraction: float = 0.05):
+    """(loss_fn, params, example_batch, apply_fn) — click-through binary
+    objective. Synthetic ids are power-law-ish (a ``hot_fraction`` of each
+    vocabulary receives most lookups), matching real CTR id skew — the
+    distribution PS load balancing and the sparse wire actually face."""
+    cfg = config or DLRMConfig()
+    model = DLRM(cfg)
+    rng = jax.random.PRNGKey(seed)
+    d0 = jnp.zeros((1, cfg.num_dense), jnp.float32)
+    s0 = jnp.zeros((1, len(cfg.table_sizes)), jnp.int32)
+    variables = model.init(rng, d0, s0)
+
+    def loss_fn(params, batch):
+        logits = model.apply(params, batch["dense"], batch["sparse"])
+        labels = batch["label"].astype(jnp.float32)
+        loss = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(
+            jnp.exp(-jnp.abs(logits)))
+        return jnp.mean(loss)
+
+    npr = np.random.RandomState(seed)
+    sparse = np.stack(
+        [np.where(npr.rand(batch_size) < 0.8,
+                  npr.randint(0, max(1, int(size * hot_fraction)),
+                              batch_size),
+                  npr.randint(0, size, batch_size))
+         for size in cfg.table_sizes], axis=1).astype(np.int32)
+    example_batch = {
+        "dense": npr.randn(batch_size, cfg.num_dense).astype(np.float32),
+        "sparse": sparse,
+        "label": npr.randint(0, 2, (batch_size,)).astype(np.int32),
+    }
+    apply_fn = lambda p, d, s: model.apply(p, d, s)  # noqa: E731
+    return loss_fn, dict(variables), example_batch, apply_fn
