@@ -35,6 +35,10 @@ class DLRMConfig:
     num_dense: int = 13
     bottom_mlp: Tuple[int, ...] = (512, 256, 64)
     top_mlp: Tuple[int, ...] = (512, 256)
+    # Wide&Deep (arXiv 1606.07792): add a linear "wide" term — a 1-dim
+    # embedding per sparse feature plus a linear map over the dense
+    # features — to the deep tower's logit
+    wide: bool = False
     dtype: Any = jnp.float32
 
     @classmethod
@@ -79,7 +83,19 @@ class DLRM(nn.Module):
         for i, width in enumerate(cfg.top_mlp):
             h = nn.relu(nn.Dense(width, dtype=cfg.dtype,
                                  name="top_%d" % i)(h))
-        return nn.Dense(1, dtype=jnp.float32, name="click")(h)[..., 0]
+        logit = nn.Dense(1, dtype=jnp.float32, name="click")(h)[..., 0]
+        if cfg.wide:
+            # the wide linear term: memorization over raw ids + dense
+            for t, size in enumerate(cfg.table_sizes):
+                logit = logit + SparseEmbed(
+                    size, 1, dtype=jnp.float32,
+                    name="wide_table_%d" % t)(sparse_ids[:, t])[..., 0]
+            # no bias: the click head's bias already covers the additive
+            # scalar degree of freedom
+            logit = logit + nn.Dense(
+                1, use_bias=False, dtype=jnp.float32, name="wide_dense")(
+                dense.astype(jnp.float32))[..., 0]
+        return logit
 
 
 def make_train_setup(config: Optional[DLRMConfig] = None,

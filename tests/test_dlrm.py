@@ -58,3 +58,22 @@ def test_hot_id_skew_in_synthetic_batch():
     _, _, batch, _ = dlrm.make_train_setup(cfg, batch_size=512)
     hot = (batch["sparse"][:, 0] < 500).mean()
     assert hot > 0.7, hot
+
+
+def test_wide_and_deep_variant():
+    """wide=True adds the linear memorization term (1-dim per-table
+    embeddings + dense linear, arXiv 1606.07792); the wide tables ride
+    the sparse wire alongside the deep ones and training converges."""
+    cfg = dlrm.DLRMConfig.tiny(table_sizes=(4096, 512), embed_dim=32,
+                               bottom_mlp=(16, 32), wide=True)
+    loss_fn, params, batch, _ = dlrm.make_train_setup(cfg, batch_size=16)
+    assert "wide_table_0" in params["params"]
+    assert "wide_dense" in params["params"]
+    ad = adt.AutoDist(strategy_builder=strategy.Parallax())
+    runner = ad.build(loss_fn, optax.adam(1e-2), params, batch)
+    runner.init(params)
+    wire = set(runner.distributed_step.metadata["sparse_wire"])
+    assert "params/wide_table_0/embedding" in wire, wire
+    losses = [float(runner.run(batch)["loss"]) for _ in range(10)]
+    assert losses[-1] < losses[0], losses
+    adt.reset()
