@@ -120,6 +120,11 @@ class AutoDist:
         from autodist_tpu.runtime.cluster import SSHCluster
         from autodist_tpu.runtime.coordinator import Coordinator
         cluster = SSHCluster(self._resource_spec)
+        # the chief's own process count: worker processes get it from
+        # worker_env; multi-process wiring on the chief (async-PS serving,
+        # staleness pacing, mirror checks) reads the same env
+        os.environ[const.ENV.ADT_NUM_PROCESSES.name_str] = str(
+            cluster.num_processes)
         self._coordinator = Coordinator(sid, cluster)
         self._coordinator.launch_clients(copy_strategy=False)
         cluster.start()  # joins as process 0; returns once workers connect
@@ -242,6 +247,13 @@ class AutoDist:
         logging.debug("compiled strategy:\n%s", compiled)
         self._setup(compiled)
         is_async = self._validate_async(compiled, item)
+        if (const.ENV.ADT_ELASTIC.val > 0 and not is_async
+                and const.ENV.ADT_NUM_PROCESSES.val > 1):
+            raise ValueError(
+                "ADT_ELASTIC requires an async host-PS strategy (e.g. "
+                "PS(sync=False)): sync strategies are collective-lockstep, "
+                "so a relaunched worker cannot rejoin mid-run — resume "
+                "those from a checkpoint instead")
         if is_async:
             # async PS cannot ride global collectives (they are lockstep):
             # each process runs its OWN local mesh — the reference's

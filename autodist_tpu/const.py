@@ -77,6 +77,13 @@ class ENV(Enum):
     # comma-separated mesh axis names to treat as DCN (cross-host) for the
     # spec=DCN hierarchical reduce; default: detected from process layout
     ADT_DCN_AXES = ("ADT_DCN_AXES", str, "")
+    # elastic async-PS jobs: max RESTARTS per worker before the chief
+    # fail-fasts (0 = reference fail-fast semantics). Elastic jobs skip the
+    # jax.distributed join entirely — async PS couples processes only
+    # through the parameter service, which is what makes a worker
+    # restartable at all; sync strategies are collective-lockstep and stay
+    # fail-fast (resume them from a checkpoint instead).
+    ADT_ELASTIC = ("ADT_ELASTIC", int, 0)
 
     @property
     def val(self):

@@ -112,11 +112,17 @@ class Cluster:
                 logging.warning("coordination service unavailable: %s", e)
                 self._coordsvc = None
         from autodist_tpu.runtime import server_starter
-        server_starter.init_distributed(
-            coordinator_address=self.coordinator_address,
-            num_processes=self.num_processes,
-            process_id=self.process_id(
-                const.ENV.ADT_WORKER.val or self._spec.chief))
+        if const.ENV.ADT_ELASTIC.val > 0:
+            # elastic async-PS jobs keep the process set OPEN (workers may
+            # die and be relaunched); jax.distributed would pin it shut
+            logging.info("elastic mode: chief not joining jax.distributed")
+            server_starter.mark_elastic_started()
+        else:
+            server_starter.init_distributed(
+                coordinator_address=self.coordinator_address,
+                num_processes=self.num_processes,
+                process_id=self.process_id(
+                    const.ENV.ADT_WORKER.val or self._spec.chief))
         atexit.register(self.terminate)
         self._started = True
 
@@ -158,7 +164,8 @@ class Cluster:
 
     def _ssh_base(self, address: str) -> List[str]:
         conf: Optional[SSHConfig] = self._spec.ssh_config_map.for_host(address)
-        cmd = ["ssh", "-oStrictHostKeyChecking=no", "-oBatchMode=yes"]
+        cmd = ["ssh", "-oStrictHostKeyChecking=no", "-oBatchMode=yes",
+               "-oConnectTimeout=10"]
         if conf:
             if conf.key_file:
                 cmd += ["-i", conf.key_file]

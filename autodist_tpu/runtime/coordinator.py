@@ -18,10 +18,27 @@ from autodist_tpu import const
 from autodist_tpu.runtime.cluster import Cluster
 from autodist_tpu.utils import logging
 
+def _ere_escape(text: str) -> str:
+    """Escape POSIX extended-regex metacharacters only (re.escape also
+    backslashes ordinary characters like spaces, which POSIX ERE leaves
+    undefined)."""
+    return "".join("\\" + c if c in r".[]^$*+?(){}|\\" else c
+                   for c in text)
+
+
+def _reap_pattern(command: str) -> str:
+    """pkill -f pattern matching ``command`` as a cmdline substring but
+    NOT matching the pkill wrapper's own command line (first character
+    wrapped in a regex bracket class, so the pattern text differs from
+    the text it matches)."""
+    esc = _ere_escape(command)
+    return "[%s]%s" % (command[0], esc[len(_ere_escape(command[0])):])
+
 
 class Coordinator:
     def __init__(self, strategy, cluster: Cluster,
-                 heartbeat_timeout: float = 60.0):
+                 heartbeat_timeout: float = 60.0,
+                 max_restarts: int = None):
         # a Strategy object, or just its id — the chief-launched flow
         # preallocates the id and launches workers BEFORE the strategy is
         # built (the chief's jax.distributed join blocks until every
@@ -34,6 +51,17 @@ class Coordinator:
         # the cluster owns the service port (it starts the server)
         self._coordsvc_port = cluster.coordsvc_port
         self._stop_watchdog = threading.Event()
+        # elastic recovery (beyond the reference's fail-fast-only
+        # supervision): per-worker restart budget, sound only for async-PS
+        # jobs (no collective lockstep to re-join; a relaunched worker
+        # pulls current values from the parameter service on its first
+        # step). _restart_unsound_reason() re-checks the strategy and the
+        # elastic bring-up before the budget is ever used.
+        self._max_restarts = (const.ENV.ADT_ELASTIC.val
+                              if max_restarts is None else max_restarts)
+        self._restarts: dict = {}          # address -> restarts used
+        self._restart_at: dict = {}        # address -> last relaunch time
+        self._launch_cmds: dict = {}       # address -> (command, env)
         atexit.register(self.join)
 
     def start_watchdog(self):
@@ -56,9 +84,25 @@ class Coordinator:
                     dead = client.dead_workers(self._heartbeat_timeout)
                 except OSError:
                     return
-                if dead:
+                # elastic-aware: a worker with restart budget left may be
+                # mid-relaunch (import + trace + compile easily exceeds the
+                # heartbeat window) — the process watcher owns its fate;
+                # abort only for workers that cannot be restarted AND are
+                # not inside a fresh incarnation's bring-up grace (the
+                # stale heartbeat belongs to the previous incarnation)
+                import time as _time
+                now = _time.monotonic()
+                fatal = [
+                    d for d in dead
+                    if self._max_restarts <= self._restarts.get(d, 0)
+                    and now - self._restart_at.get(d, float("-inf"))
+                    > 2 * self._heartbeat_timeout]
+                if dead and not fatal:
+                    logging.warning("workers %s missed heartbeats; restart "
+                                    "budget remains — not aborting", dead)
+                if fatal:
                     logging.error("workers %s missed heartbeats — aborting",
-                                  dead)
+                                  fatal)
                     os._exit(1)
         t = threading.Thread(target=watch, daemon=True)
         t.start()
@@ -91,12 +135,16 @@ class Coordinator:
             # locally — an empty string would override the worker's default
             # (reference coordinator.py:70-79)
             for e in (const.ENV.ADT_MIN_LOG_LEVEL, const.ENV.ADT_IS_TESTING,
-                      const.ENV.ADT_PATCH_OPTAX):
+                      const.ENV.ADT_PATCH_OPTAX, const.ENV.ADT_ELASTIC):
                 raw = os.environ.get(e.name_str)
                 if raw is not None:
                     env[e.name_str] = raw
-            proc = self._cluster.remote_exec(
-                "python -u %s %s" % (script, argv_rest), address, env=env)
+            # from the cluster field, not the chief's env: an explicit
+            # coordsvc_port constructor arg must reach the workers too
+            env[const.ENV.ADT_COORDSVC_PORT.name_str] = str(self._coordsvc_port)
+            command = "python -u %s %s" % (script, argv_rest)
+            self._launch_cmds[address] = (command, env)
+            proc = self._cluster.remote_exec(command, address, env=env)
             if proc is not None:
                 self._proc_wait_async(proc, address)
             logging.info("launched worker client on %s (process %d)",
@@ -107,16 +155,135 @@ class Coordinator:
         worker death after the job finished cleanly (``stop_watchdog``
         set — e.g. the chief's exit-time terminate SIGTERMing a trailing
         worker) is shutdown, not failure, and must not abort a
-        successful run with exit code 1."""
+        successful run with exit code 1. With an elastic budget
+        (``ADT_ELASTIC``), a restartable worker is relaunched instead."""
         def watch():
             code = proc.wait()
             if code != 0 and not self._stop_watchdog.is_set():
+                try:
+                    restarted = self._try_restart(address, code, proc)
+                except Exception as e:  # noqa: BLE001 — a broken restart
+                    # path must degrade to fail-fast, never to a silently
+                    # dead watcher (the worker IS down at this point)
+                    logging.error("elastic restart of %s failed: %s", address, e)
+                    restarted = False
+                if restarted:
+                    return
                 logging.error("worker %s exited with code %s — aborting job",
                               address, code)
                 os._exit(1)
         t = threading.Thread(target=watch, daemon=True)
         t.start()
         self._threads.append(t)
+
+    # ------------------------------------------------------ elastic recovery
+
+    def _try_restart(self, address: str, code, old_proc=None) -> bool:
+        """Relaunch a dead worker when (a) restart budget remains and
+        (b) the job's strategy makes a restart SOUND. Returns True when a
+        relaunch happened (the new process is supervised like the first)."""
+        used = self._restarts.get(address, 0)
+        if self._max_restarts <= used or address not in self._launch_cmds:
+            return False
+        command, env = self._launch_cmds[address]
+        # reap FIRST — right after proc.wait() returned, before the (file
+        # IO) soundness gate — to keep the pgid-reuse window minimal and
+        # ensure no orphan survivor outlives this decision either way
+        self._reap_incarnation(address, command, old_proc)
+        reason = self._restart_unsound_reason(address)
+        if reason is not None:
+            logging.error("worker %s died (code %s) but elastic restart is "
+                          "unsound for this job: %s — failing fast",
+                          address, code, reason)
+            return False
+        self._restarts[address] = used + 1
+        import time as _time
+        self._restart_at[address] = _time.monotonic()
+        logging.warning("worker %s exited with code %s — relaunching worker "
+                        "(restart %d/%d)", address, code,
+                        self._restarts[address], self._max_restarts)
+        proc = self._cluster.remote_exec(command, address, env=env)
+        if proc is None:  # dry-run mode: nothing to supervise
+            return True
+        self._proc_wait_async(proc, address)
+        return True
+
+    def _reap_incarnation(self, address: str, command: str, old_proc):
+        """Make sure the PREVIOUS incarnation is really gone before its
+        replacement starts: the watcher observes the LOCAL launcher process
+        (for ssh transport, the ssh client), which can die — network blip,
+        ssh killed — while the remote worker keeps training. Two live
+        incarnations under one worker identity would both push gradients.
+
+        Local transport: SIGKILL the old process group. setsid at launch
+        makes pgid == the launcher pid, and the group id stays valid while
+        ANY member survives — even after the leader was reaped by
+        ``proc.wait()``. If the WHOLE group is gone the pid could in
+        principle be recycled, but a recycled pid is a process-group id
+        only if its new holder itself called setsid — this killpg runs
+        immediately after ``proc.wait()`` returned, so the window is tiny.
+
+        Remote transport: pkill the exact launched command line on the
+        remote host (the reference's stale-server cleanup approach,
+        ``utils/server_starter.py:29-46``). bash exec-optimizes the
+        env-prefixed remote command, so only the command's own argv
+        survives in /proc cmdline — matching the full command string,
+        ERE-escaped with the self-match bracket trick, is the reliable
+        handle (``_reap_pattern``)."""
+        import shlex
+        import signal as _signal
+        if old_proc is not None:
+            try:
+                os.killpg(old_proc.pid, _signal.SIGKILL)
+            except (ProcessLookupError, PermissionError, OSError):
+                pass
+        if not self._cluster._is_local(address):
+            self._cluster.remote_exec(
+                "pkill -f %s || true" % shlex.quote(_reap_pattern(command)),
+                address, wait=True)
+
+    def _restart_unsound_reason(self, address: str):
+        """None when every variable syncs through async host-PS owned by a
+        surviving host; otherwise why a restart would corrupt the job.
+        Sync strategies are collective-lockstep (the peers are wedged in a
+        collective the dead worker will never re-enter at the same program
+        point), and any PS group owned by the dead worker took its
+        authoritative state down with it — both must fail fast (resume
+        from a checkpoint instead).
+
+        Deliberately CONSERVATIVE: this reads the raw serialized strategy,
+        so a config the running job itself skips (e.g. a sync node for a
+        frozen/pruned var) can refuse a restart the job could survive —
+        over-strictness degrades to the reference's fail-fast, never to a
+        corrupted run."""
+        from autodist_tpu.strategy.base import PSSynchronizer, Strategy
+        if const.ENV.ADT_ELASTIC.val <= 0:
+            # Coordinator(max_restarts=...) without the ADT_ELASTIC
+            # bring-up: every process joined jax.distributed, whose pinned
+            # process set a relaunched worker cannot re-enter — it would
+            # churn the budget on confusing join failures
+            return ("ADT_ELASTIC was not set at bring-up, so processes "
+                    "joined jax.distributed (pinned process set)")
+        try:
+            strategy = Strategy.deserialize(self._strategy_id)
+        except (OSError, ValueError) as e:
+            return "strategy %s unreadable (%s)" % (self._strategy_id, e)
+        if strategy.graph_config.mesh_shape:
+            return "model-parallel mesh axes are collective-lockstep"
+
+        def leaf_nodes(node):
+            return node.part_configs or [node]
+        for node in strategy.node_config:
+            for leaf in leaf_nodes(node):
+                sync = leaf.synchronizer or node.synchronizer
+                if not isinstance(sync, PSSynchronizer) or sync.sync:
+                    return ("var %r is not async host-PS" % node.var_name)
+                dest_host = (sync.reduction_destination or "").split(":")[0]
+                if dest_host == address:
+                    return ("dead worker %s OWNS the PS group of %r — its "
+                            "authoritative state died with it"
+                            % (address, node.var_name))
+        return None
 
     def stop_watchdog(self):
         """End heartbeat supervision — call when the job finishes cleanly,

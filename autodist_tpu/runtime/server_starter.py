@@ -17,6 +17,7 @@ from autodist_tpu import const
 from autodist_tpu.utils import logging
 
 _INITIALIZED = False
+_ELASTIC_STARTED = False  # elastic bring-up done (no jax.distributed join)
 
 
 def init_distributed(coordinator_address: str, num_processes: int,
@@ -47,12 +48,37 @@ def init_distributed(coordinator_address: str, num_processes: int,
 
 
 def initialized() -> bool:
-    return _INITIALIZED
+    """True once this process's distributed bring-up has happened — a
+    jax.distributed join, or an elastic bring-up (which deliberately has
+    none). Guards AutoDist's chief-launched flow against re-entry: a
+    second AutoDist() in the same process must not relaunch workers."""
+    return _INITIALIZED or _ELASTIC_STARTED
+
+
+def mark_elastic_started():
+    global _ELASTIC_STARTED
+    _ELASTIC_STARTED = True
 
 
 def maybe_init_distributed():
     """Worker-side auto-join from the env the Coordinator set
-    (chief side passes explicit args via Cluster.start)."""
+    (chief side passes explicit args via Cluster.start). Elastic jobs
+    never join: jax.distributed pins a fixed process set for the job's
+    lifetime, while elastic async PS needs workers to come and go — they
+    couple through the coordination service alone."""
+    if const.ENV.ADT_ELASTIC.val > 0:
+        if const.ENV.ADT_EXTERNAL_LAUNCH.val:
+            # external launchers own process lifecycles (no Coordinator to
+            # relaunch anything) AND their strategy handoff is a collective
+            # broadcast that requires the jax.distributed join — silently
+            # skipping it here would wedge the handoff confusingly
+            raise ValueError(
+                "ADT_ELASTIC requires the chief-launched flow; externally-"
+                "launched jobs (ADT_EXTERNAL_LAUNCH) restart workers "
+                "through their own launcher instead")
+        logging.info("elastic mode: skipping jax.distributed join "
+                     "(process coupling is via the parameter service)")
+        return
     addr = const.ENV.ADT_COORDINATOR_ADDR.val
     n = const.ENV.ADT_NUM_PROCESSES.val
     if addr and n > 1:

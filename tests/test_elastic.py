@@ -1,0 +1,198 @@
+"""Elastic async-PS worker recovery (beyond the reference's fail-fast).
+
+The reference's supervision is fail-fast only (``coordinator.py:98-110``;
+SURVEY §5 "no elasticity"). Async host-PS makes per-worker restart SOUND:
+processes couple only through the parameter service (no collective
+lockstep, no jax.distributed process pinning), and a relaunched worker's
+first pull fetches the owner's CURRENT published values — so with
+``ADT_ELASTIC=<budget>`` the chief relaunches a dead worker instead of
+aborting. Sync strategies (and PS groups owned by the dead worker) stay
+fail-fast: the peers are wedged mid-collective / the authoritative state
+died with the owner.
+
+The e2e test runs the REAL chief-launched flow over the local transport:
+the launched worker kills itself mid-run (first incarnation only), the
+chief relaunches it, and the restarted worker trains to completion.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+USER_SCRIPT = """
+import json, os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import optax
+import autodist_tpu as adt
+from autodist_tpu import strategy
+
+spec, outdir = sys.argv[1], sys.argv[2]
+ad = adt.AutoDist(resource_spec_file=spec,
+                  strategy_builder=strategy.PS(sync=False))
+import jax.numpy as jnp
+rng = np.random.RandomState(0)
+params = {"w": jnp.asarray(rng.randn(8, 4) * 0.3, jnp.float32)}
+
+def loss_fn(p, batch):
+    return jnp.mean((batch["x"] @ p["w"] - batch["y"]) ** 2)
+
+batch = {"x": rng.randn(8, 8).astype(np.float32),
+         "y": rng.randn(8, 4).astype(np.float32)}
+step = ad.function(loss_fn, optimizer=optax.sgd(0.05), params=params)
+is_worker = bool(os.environ.get("ADT_WORKER"))
+marker = os.path.join(outdir, "crashed_once")
+
+if is_worker:
+    restarted = os.path.exists(marker)
+    losses = []
+    for i in range(12):
+        losses.append(float(step(batch)["loss"]))
+        if i == 2 and not restarted:
+            with open(marker, "w") as f:
+                f.write("x")
+            os._exit(3)  # first incarnation dies mid-run
+    with open(os.path.join(outdir, "out_worker.json"), "w") as f:
+        json.dump({"losses": losses, "restarted": restarted}, f)
+    print("WORKER_DONE", restarted, flush=True)
+else:
+    # the chief keeps stepping (async: no barrier with the worker) and
+    # exits once the (restarted) worker reports in
+    worker_out = os.path.join(outdir, "out_worker.json")
+    losses = []
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and not os.path.exists(worker_out):
+        losses.append(float(step(batch)["loss"]))
+        time.sleep(0.05)
+    applied = ad.runner.distributed_step.ps_store.applied_total()
+    with open(os.path.join(outdir, "out_chief.json"), "w") as f:
+        json.dump({"losses": losses, "applied": applied,
+                   "worker_done": os.path.exists(worker_out)}, f)
+    print("CHIEF_DONE", flush=True)
+"""
+
+SPEC_YAML = """
+nodes:
+  - address: 127.0.0.1
+    chief: true
+    cpus: [0, 1]
+  - address: localhost
+    cpus: [0, 1]
+"""
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_worker_crash_relaunches_and_recovers(tmp_path):
+    script = tmp_path / "user_script.py"
+    script.write_text(USER_SCRIPT)
+    spec = tmp_path / "spec.yml"
+    spec.write_text(SPEC_YAML)
+    env = dict(os.environ)
+    for k in ("JAX_PLATFORMS", "ADT_DEBUG_REMOTE", "ADT_WORKER"):
+        env.pop(k, None)
+    env.update({
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "ADT_COORDINATOR_ADDR": "127.0.0.1:%d" % _free_port(),
+        "ADT_COORDSVC_PORT": str(_free_port()),
+        "ADT_ELASTIC": "1",
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(HERE)] +
+            ([os.environ["PYTHONPATH"]] if os.environ.get("PYTHONPATH")
+             else [])),
+    })
+    proc = subprocess.run(
+        [sys.executable, str(script), str(spec), str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "relaunching worker" in proc.stderr, proc.stderr[-3000:]
+    worker = json.loads((tmp_path / "out_worker.json").read_text())
+    chief = json.loads((tmp_path / "out_chief.json").read_text())
+    # the SECOND incarnation wrote the output (first one crashed at step 2)
+    assert worker["restarted"] is True
+    assert (tmp_path / "crashed_once").exists()
+    assert chief["worker_done"] is True
+    # both trajectories converge; the chief's owner loop applied blobs
+    # from its own steps plus both worker incarnations
+    assert worker["losses"][-1] < worker["losses"][0]
+    assert chief["losses"][-1] < chief["losses"][0]
+    assert chief["applied"] > len(chief["losses"])
+
+
+def _coordinator_for(tmp_path, strategy):
+    """A Coordinator over a 2-node loopback cluster with ``strategy``
+    serialized under a preset id (no processes launched)."""
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.runtime.cluster import Cluster
+    from autodist_tpu.runtime.coordinator import Coordinator
+    spec = tmp_path / "spec.yml"
+    spec.write_text(SPEC_YAML)
+    strategy.id = "elastic-unit-%d" % os.getpid()
+    strategy.serialize()
+    cluster = Cluster(ResourceSpec(str(spec)))
+    return Coordinator(strategy.id, cluster, max_restarts=1)
+
+
+def _ps_strategy(sync, dest="127.0.0.1:CPU:0"):
+    from autodist_tpu.strategy.base import (PSSynchronizer, Strategy,
+                                            VarConfig)
+    return Strategy(node_config=[
+        VarConfig(var_name="w", synchronizer=PSSynchronizer(
+            reduction_destination=dest, sync=sync))])
+
+
+def test_restart_soundness_gate(tmp_path, monkeypatch):
+    """Sync strategies and dead-owner groups refuse restart; pure async
+    with surviving owners allows it; no ADT_ELASTIC bring-up refuses
+    everything (processes joined jax.distributed)."""
+    no_elastic = _coordinator_for(tmp_path, _ps_strategy(sync=False))
+    assert "ADT_ELASTIC" in no_elastic._restart_unsound_reason("localhost")
+
+    monkeypatch.setenv("ADT_ELASTIC", "1")
+    ok = _coordinator_for(tmp_path, _ps_strategy(sync=False))
+    assert ok._restart_unsound_reason("localhost") is None
+
+    sync = _coordinator_for(tmp_path, _ps_strategy(sync=True))
+    assert "not async" in sync._restart_unsound_reason("localhost")
+
+    owner = _coordinator_for(
+        tmp_path, _ps_strategy(sync=False, dest="localhost:CPU:0"))
+    assert "OWNS" in owner._restart_unsound_reason("localhost")
+    # ...but losing a NON-owner is still recoverable in the same job
+    assert owner._restart_unsound_reason("10.0.0.9") is None
+
+
+def test_reap_pattern_matches_command_not_itself():
+    """The remote reap pattern must match the launched command line
+    (what bash exec leaves in /proc cmdline) — including commands with
+    regex metacharacters — but never the pkill wrapper's own cmdline,
+    which embeds the pattern text."""
+    import re
+    from autodist_tpu.runtime.coordinator import _reap_pattern
+    for command in ("python -u /tmp/s.py a b",
+                    "python -u /runs/exp+1/train.py --lr (0.1)"):
+        pat = _reap_pattern(command)
+        assert re.search(pat, command), (pat, command)
+        wrapper = "bash -c pkill -f %s || true" % pat
+        assert not re.search(pat, wrapper), (pat, wrapper)
+
+
+def test_restart_budget_exhausts_to_fail_fast(tmp_path, monkeypatch):
+    """_try_restart honors the budget: first death relaunches (dry-run
+    remote_exec returns None), second falls through to fail-fast."""
+    monkeypatch.setenv("ADT_DEBUG_REMOTE", "1")
+    monkeypatch.setenv("ADT_ELASTIC", "1")
+    coord = _coordinator_for(tmp_path, _ps_strategy(sync=False))
+    coord._launch_cmds["localhost"] = ("python -u x.py", {})
+    assert coord._try_restart("localhost", 3) is True
+    assert coord._try_restart("localhost", 3) is False
