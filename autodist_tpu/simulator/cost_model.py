@@ -76,7 +76,8 @@ class CostModel:
                  chip_kind: Optional[str] = None,
                  mxu_efficiency: float = DEFAULT_MXU_EFFICIENCY,
                  flops_per_step: Optional[float] = None,
-                 hbm_capacity_bytes: Optional[float] = None):
+                 hbm_capacity_bytes: Optional[float] = None,
+                 calibration=None):
         self._item = model_item
         self._spec = resource_spec
         self._chip = chip_kind or self._guess_chip()
@@ -85,6 +86,12 @@ class CostModel:
         self._hbm_capacity = (hbm_capacity_bytes if hbm_capacity_bytes
                               is not None else CHIP_HBM_BYTES[self._chip])
         self._act_cache = None
+        # measured-run correction of the analytic constants: a Calibration,
+        # a path to a saved one, or None (uncalibrated)
+        if isinstance(calibration, str):
+            from autodist_tpu.simulator.calibration import Calibration
+            calibration = Calibration.load(calibration)
+        self.calibration = calibration
 
     def _guess_chip(self) -> str:
         kind = str(self._spec.slice_info.get("type", "")).lower()
@@ -363,7 +370,14 @@ class CostModel:
         latency_s = PER_COLLECTIVE_LATENCY_S * (len(groups) + num_ps_transfers)
         remat_factor = REMAT_COMPUTE_FACTOR.get(
             strategy.graph_config.remat, 1.0)
-        return CostBreakdown(compute_s=self.compute_time(n) * remat_factor,
+        compute_s = self.compute_time(n) * remat_factor
+        cal = self.calibration
+        if cal is not None:
+            compute_s *= cal.compute_scale
+            allreduce_s *= cal.ar_scale
+            ps_s *= cal.ps_scale
+            latency_s *= cal.latency_scale
+        return CostBreakdown(compute_s=compute_s,
                              allreduce_s=allreduce_s, ps_s=ps_s,
                              latency_s=latency_s,
                              hbm_bytes=self.hbm_bytes(strategy),

@@ -33,6 +33,29 @@ class Simulator:
         return SimulationResult(strategy, self._cost_model.estimate(strategy),
                                 label)
 
+    def calibrate(self, measured: Sequence[Tuple[Strategy, float]],
+                  save_path: Optional[str] = None):
+        """Fit the cost model's term scales to measured step times
+        (AutoSync's measured-runs idea over the analytic model — see
+        ``calibration.py``). ``measured`` pairs each strategy with its
+        observed seconds/step on THIS model and hardware. The fitted
+        ``Calibration`` is applied to this simulator (subsequent
+        ``simulate``/``rank`` calls use it), optionally saved to
+        ``save_path`` for reuse via
+        ``AutoStrategy(calibration=...)``."""
+        from autodist_tpu.simulator import calibration as cal_lib
+        prev = self._cost_model.calibration
+        self._cost_model.calibration = None  # fit against RAW terms
+        try:
+            breakdowns = [self._cost_model.estimate(s) for s, _ in measured]
+        finally:
+            self._cost_model.calibration = prev
+        cal = cal_lib.fit_auto_span(breakdowns, [t for _, t in measured])
+        self._cost_model.calibration = cal
+        if save_path:
+            cal.save(save_path)
+        return cal
+
     def rank(self, candidates: Sequence[Tuple[str, Strategy]]
              ) -> List[SimulationResult]:
         """Feasible (fits-in-HBM) candidates rank ahead of infeasible
