@@ -35,6 +35,13 @@ class Runner:
         self._trace_started = False
         self.state: Optional[TrainState] = None
         self._step_count = 0
+        # wall time of every run() call (first element includes compile);
+        # bounded so week-long jobs don't grow a list forever — the first
+        # step and a sliding window of recent steps carry all the signal
+        # step_stats() reports
+        self._first_step_s: Optional[float] = None
+        self._recent_step_s: list = []
+        self._total_step_s = 0.0
         self._coord = None
         self._mirror_coord = None
         self._staleness = int(distributed_step.metadata.get("staleness", 0))
@@ -73,8 +80,11 @@ class Runner:
         self.state = self._dstep.init_state(params, opt_state)
         return self.state
 
+    _RECENT_WINDOW = 512
+
     def run(self, batch, state: Optional[TrainState] = None) -> Any:
         """One training step on a host-global batch; returns host metrics."""
+        t_begin = time.perf_counter()
         st = state if state is not None else self.state
         if st is None:
             raise RuntimeError("Runner.run before init()")
@@ -106,7 +116,47 @@ class Runner:
             self._trace_started = False
             self._tracing = False  # trace only the first step, like FULL_TRACE runs
         host_metrics = self._remapper.remap_fetch(metrics)
+        # remap_fetch pulled the metrics to host, so the step's device work
+        # is complete: this wall time is an honest per-step duration
+        elapsed = time.perf_counter() - t_begin
+        self._total_step_s += elapsed
+        if self._first_step_s is None:
+            self._first_step_s = elapsed  # includes trace + XLA compile
+        else:
+            self._recent_step_s.append(elapsed)
+            if len(self._recent_step_s) > self._RECENT_WINDOW:
+                del self._recent_step_s[:len(self._recent_step_s) // 2]
         return (new_state, host_metrics) if state is not None else host_metrics
+
+    def step_stats(self) -> dict:
+        """Wall-time statistics over this runner's steps (the throughput
+        companion to the reference's examples/sec hooks,
+        ``examples/benchmark/utils/logs/hooks.py:28``): ``first_step_s``
+        isolates trace+compile; ``steady_*`` percentiles describe the
+        post-compile regime over a recent window; ``goodput`` is the
+        fraction of total stepping wall time the job would have needed at
+        steady median speed — compile time, host stalls, and throttle
+        windows all show up as lost goodput."""
+        import statistics
+        n = self._step_count
+        out = {"steps": n, "total_s": round(self._total_step_s, 6),
+               "first_step_s": (round(self._first_step_s, 6)
+                                if self._first_step_s is not None else None)}
+        recent = self._recent_step_s
+        if recent:
+            # method="inclusive": the default exclusive method extrapolates
+            # past the observed range on small samples (a negative p10
+            # after two steps); inclusive keeps percentiles within the data
+            qs = (statistics.quantiles(recent, n=10, method="inclusive")
+                  if len(recent) >= 2 else [recent[0]] * 9)
+            out.update(
+                steady_median_s=round(statistics.median(recent), 6),
+                steady_p10_s=round(qs[0], 6),
+                steady_p90_s=round(qs[-1], 6),
+                goodput=round(min(1.0, statistics.median(recent) * n
+                              / self._total_step_s), 4)
+                if self._total_step_s > 0 else None)
+        return out
 
     def _maybe_check_mirrors(self):
         """Sync multi-process PS keeps every process's host mirror

@@ -89,8 +89,10 @@ def fit(breakdowns: Sequence, measured_s: Sequence[float],
     if len(breakdowns) != len(measured_s) or not breakdowns:
         raise ValueError("need equal, nonzero numbers of breakdowns and "
                          "measured times")
-    if any(t <= 0 for t in measured_s):
-        raise ValueError("measured times must be positive seconds")
+    if not all(t > 0 and math.isfinite(t) for t in measured_s):
+        # NaN passes a `t <= 0` check and would silently corrupt every
+        # golden-section comparison downstream
+        raise ValueError("measured times must be positive finite seconds")
     scales = [1.0, 1.0, 1.0, 1.0]
     terms = [lambda b: b.compute_s, lambda b: b.allreduce_s,
              lambda b: b.ps_s, lambda b: b.latency_s]

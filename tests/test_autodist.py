@@ -1,4 +1,6 @@
 """AutoDist entry-object invariants (analog of reference ``tests/test_autodist.py``)."""
+import jax.numpy as jnp
+import numpy as np
 import pytest
 
 import autodist_tpu
@@ -59,3 +61,52 @@ def test_runner_fit_and_evaluate():
     h2 = runner.fit(itertools.cycle(batches(2)), steps=3)
     assert len(h2) == 3
     autodist_tpu.reset()
+
+
+def test_step_stats_goodput():
+    """Runner.step_stats(): first step isolates compile, steady
+    percentiles describe the post-compile regime, goodput accounts the
+    compile as lost time."""
+    import optax
+    import autodist_tpu as adt
+    from autodist_tpu import strategy
+    adt.reset()
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(8, 4), jnp.float32)}
+    loss = lambda p, b: jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+    batch = {"x": rng.randn(8, 8).astype(np.float32),
+             "y": rng.randn(8, 4).astype(np.float32)}
+    ad = adt.AutoDist(strategy_builder=strategy.AllReduce())
+    runner = ad.build(loss, optax.sgd(0.1), params, batch)
+    runner.init(params)
+    assert runner.step_stats() == {"steps": 0, "total_s": 0.0,
+                                   "first_step_s": None}
+    for _ in range(12):
+        runner.run(batch)
+    stats = runner.step_stats()
+    assert stats["steps"] == 12
+    # compile dominates the first step; steady steps are far faster
+    assert stats["first_step_s"] > 5 * stats["steady_median_s"]
+    assert stats["steady_p10_s"] <= stats["steady_median_s"] <= stats["steady_p90_s"]
+    assert 0.0 < stats["goodput"] <= 1.0
+    # with one compile amortized over 12 steps, goodput is well below 1
+    assert stats["goodput"] < 0.9
+    assert abs(stats["total_s"]
+               - (stats["first_step_s"]
+                  + sum(runner._recent_step_s))) < 1e-6
+    adt.reset()
+
+
+def test_step_stats_small_sample_percentiles_stay_in_range():
+    """Two steady samples must not extrapolate percentiles outside the
+    observed durations (the exclusive-quantiles trap)."""
+    import autodist_tpu as adt
+    from autodist_tpu.runtime.runner import Runner
+    r = Runner.__new__(Runner)
+    r._step_count = 3
+    r._first_step_s = 1.0
+    r._recent_step_s = [0.001, 0.005]
+    r._total_step_s = 1.006
+    stats = r.step_stats()
+    assert stats["steady_p10_s"] >= 0.001
+    assert stats["steady_p90_s"] <= 0.005

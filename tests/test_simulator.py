@@ -324,3 +324,14 @@ def test_calibration_auto_span_handles_structural_mismatch():
     assert cal_lib.rel_rmse(raw, measured, tight) > 0.5  # saturated
     auto = cal_lib.fit_auto_span(raw, measured)
     assert cal_lib.rel_rmse(raw, measured, auto) < 0.1
+
+
+def test_calibration_rejects_nan_measurement():
+    from autodist_tpu.simulator import calibration as cal_lib
+    item, spec = _item(), _spec()
+    sim = Simulator(item, spec)
+    s = S.AllReduce().build(item, spec)
+    raw = sim._cost_model.estimate(s)
+    for bad in (float("nan"), float("inf")):
+        with pytest.raises(ValueError):
+            cal_lib.fit([raw], [bad])
