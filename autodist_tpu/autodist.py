@@ -128,6 +128,13 @@ class AutoDist:
         self._coordinator = Coordinator(sid, cluster)
         self._coordinator.launch_clients(copy_strategy=False)
         cluster.start()  # joins as process 0; returns once workers connect
+        if const.ENV.ADT_ELASTIC.val > 0:
+            # async workers heartbeat time-based (runner.py); the watchdog
+            # turns silence-while-alive (deadlock) into a kill that the
+            # process watcher answers with an elastic relaunch. Sync jobs
+            # don't run it: a >timeout gap between their steps (long eval,
+            # slow data) would read as death.
+            self._coordinator.start_watchdog()
         # atexit runs LIFO: this must fire BEFORE cluster.terminate (the
         # registration inside start()) so a clean exit flags the watchers
         # before terminate's SIGTERM makes a trailing worker "die"

@@ -84,6 +84,9 @@ class ENV(Enum):
     # restartable at all; sync strategies are collective-lockstep and stay
     # fail-fast (resume them from a checkpoint instead).
     ADT_ELASTIC = ("ADT_ELASTIC", int, 0)
+    # liveness window (seconds): workers heartbeat every quarter of it;
+    # the chief's watchdog treats silence longer than it as death/deadlock
+    ADT_HEARTBEAT_TIMEOUT_S = ("ADT_HEARTBEAT_TIMEOUT_S", float, 60.0)
 
     @property
     def val(self):

@@ -31,6 +31,13 @@
 //   WAITMIN <step> <stale>    -> OK                   (blocks until
 //                                                      step <= minstep+stale)
 //   HEARTBEAT <worker>        -> OK
+//   GOODBYE <worker>          -> OK                   (clean deregister:
+//                                                      drops heartbeat +
+//                                                      step records so a
+//                                                      finished worker is
+//                                                      never counted dead
+//                                                      and stops holding
+//                                                      the staleness window)
 //   DEADLIST <timeout_s>      -> VAL <w1,w2,...> | NONE
 //   BPUT <key> <ver> <b64>    -> OK                   (versioned blob store:
 //                                                      async-PS value serving)
@@ -262,6 +269,12 @@ class Server {
     } else if (cmd == "HEARTBEAT" && parts.size() == 2) {
       heartbeats_[parts[1]] = NowSeconds();
       Reply(conn, "OK");
+    } else if (cmd == "GOODBYE" && parts.size() == 2) {
+      heartbeats_.erase(parts[1]);
+      steps_.erase(parts[1]);
+      Reply(conn, "OK");
+      // the departed worker no longer bounds the staleness window
+      WakeStaleWaiters();
     } else if (cmd == "DEADLIST" && parts.size() == 2) {
       double timeout = atof(parts[1].c_str());
       double now = NowSeconds();

@@ -135,6 +135,11 @@ class CoordinationClient:
         window; with staleness=0 this is lockstep sync)."""
         assert self._cmd("WAITMIN %d %d" % (my_step, staleness)) == "OK"
 
+    def goodbye(self, worker: str):
+        """Clean deregister: a finished worker must not be counted dead by
+        the watchdog nor keep bounding the staleness window."""
+        return self._cmd("GOODBYE %s" % worker)
+
     def heartbeat(self, worker: str):
         assert self._cmd("HEARTBEAT %s" % worker) == "OK"
 

@@ -10,8 +10,12 @@ semantics (``coordinator.py:98-110``).
 """
 import atexit
 import os
+import shlex
+import signal
 import sys
 import threading
+import time
+
 from typing import List
 
 from autodist_tpu import const
@@ -37,7 +41,7 @@ def _reap_pattern(command: str) -> str:
 
 class Coordinator:
     def __init__(self, strategy, cluster: Cluster,
-                 heartbeat_timeout: float = 60.0,
+                 heartbeat_timeout: float = None,
                  max_restarts: int = None):
         # a Strategy object, or just its id — the chief-launched flow
         # preallocates the id and launches workers BEFORE the strategy is
@@ -47,7 +51,9 @@ class Coordinator:
         self._strategy_id = getattr(strategy, "id", strategy)
         self._cluster = cluster
         self._threads: List[threading.Thread] = []
-        self._heartbeat_timeout = heartbeat_timeout
+        self._heartbeat_timeout = (
+            const.ENV.ADT_HEARTBEAT_TIMEOUT_S.val
+            if heartbeat_timeout is None else heartbeat_timeout)
         # the cluster owns the service port (it starts the server)
         self._coordsvc_port = cluster.coordsvc_port
         self._stop_watchdog = threading.Event()
@@ -62,6 +68,7 @@ class Coordinator:
         self._restarts: dict = {}          # address -> restarts used
         self._restart_at: dict = {}        # address -> last relaunch time
         self._launch_cmds: dict = {}       # address -> (command, env)
+        self._live_procs: dict = {}        # address -> current launcher proc
         atexit.register(self.join)
 
     def start_watchdog(self):
@@ -71,7 +78,6 @@ class Coordinator:
         from autodist_tpu.runtime.coordination import CoordinationClient
 
         def watch():
-            import time as _time
             try:
                 client = CoordinationClient("127.0.0.1", self._coordsvc_port)
             except OSError as e:
@@ -86,20 +92,53 @@ class Coordinator:
                     return
                 # elastic-aware: a worker with restart budget left may be
                 # mid-relaunch (import + trace + compile easily exceeds the
-                # heartbeat window) — the process watcher owns its fate;
-                # abort only for workers that cannot be restarted AND are
-                # not inside a fresh incarnation's bring-up grace (the
-                # stale heartbeat belongs to the previous incarnation)
-                import time as _time
-                now = _time.monotonic()
-                fatal = [
-                    d for d in dead
-                    if self._max_restarts <= self._restarts.get(d, 0)
-                    and now - self._restart_at.get(d, float("-inf"))
-                    > 2 * self._heartbeat_timeout]
-                if dead and not fatal:
-                    logging.warning("workers %s missed heartbeats; restart "
-                                    "budget remains — not aborting", dead)
+                # heartbeat window) — skip anything inside a fresh
+                # incarnation's bring-up grace (a killed incarnation is
+                # deregistered at relaunch, so this covers only records
+                # the new incarnation itself wrote). Outside the grace: a
+                # worker WITH budget whose process is still alive is
+                # deadlocked — kill AND deregister it so the process
+                # watcher relaunches it without a stale record aging
+                # against the replacement (silence is the only deadlock
+                # signal an async job emits); a worker without budget is
+                # fatal.
+                now = time.monotonic()
+                dead = [d for d in dead if d != "chief"
+                        and now - self._restart_at.get(d, float("-inf"))
+                        > 2 * self._heartbeat_timeout]
+                fatal = [d for d in dead
+                         if self._max_restarts <= self._restarts.get(d, 0)]
+                for d in dead:
+                    if d in fatal:
+                        continue
+                    proc = self._live_procs.get(d)
+                    if proc is not None and proc.poll() is None:
+                        logging.warning(
+                            "worker %s missed heartbeats but its process is "
+                            "alive (deadlock?) — killing it for an elastic "
+                            "restart", d)
+                        try:
+                            os.killpg(proc.pid, signal.SIGKILL)
+                            proc.wait(timeout=5)
+                            killed = True
+                        except Exception:  # noqa: BLE001
+                            killed = False
+                            logging.error(
+                                "could not kill wedged worker %s; keeping "
+                                "its liveness record so this stays visible",
+                                d)
+                        if killed:
+                            # deregister ONLY once the process is confirmed
+                            # gone: erasing the record of a still-wedged
+                            # worker would hide the hang forever
+                            try:
+                                client.goodbye(d)
+                            except OSError:
+                                pass
+                    else:
+                        logging.warning(
+                            "worker %s missed heartbeats; restart budget "
+                            "remains — leaving it to the process watcher", d)
                 if fatal:
                     logging.error("workers %s missed heartbeats — aborting",
                                   fatal)
@@ -135,7 +174,8 @@ class Coordinator:
             # locally — an empty string would override the worker's default
             # (reference coordinator.py:70-79)
             for e in (const.ENV.ADT_MIN_LOG_LEVEL, const.ENV.ADT_IS_TESTING,
-                      const.ENV.ADT_PATCH_OPTAX, const.ENV.ADT_ELASTIC):
+                      const.ENV.ADT_PATCH_OPTAX, const.ENV.ADT_ELASTIC,
+                      const.ENV.ADT_HEARTBEAT_TIMEOUT_S):
                 raw = os.environ.get(e.name_str)
                 if raw is not None:
                     env[e.name_str] = raw
@@ -146,6 +186,7 @@ class Coordinator:
             self._launch_cmds[address] = (command, env)
             proc = self._cluster.remote_exec(command, address, env=env)
             if proc is not None:
+                self._live_procs[address] = proc
                 self._proc_wait_async(proc, address)
             logging.info("launched worker client on %s (process %d)",
                          address, self._cluster.process_id(address))
@@ -197,14 +238,24 @@ class Coordinator:
                           address, code, reason)
             return False
         self._restarts[address] = used + 1
-        import time as _time
-        self._restart_at[address] = _time.monotonic()
+        self._restart_at[address] = time.monotonic()
+        # deregister the dead incarnation's liveness records (a crashed or
+        # SIGKILLed worker never said GOODBYE): its stale heartbeat must
+        # not age against the replacement while it compiles
+        try:
+            from autodist_tpu.runtime.coordination import CoordinationClient
+            c = CoordinationClient("127.0.0.1", self._coordsvc_port)
+            c.goodbye(address)
+            c.close()
+        except OSError:
+            pass  # no service (or unreachable): the bring-up grace covers it
         logging.warning("worker %s exited with code %s — relaunching worker "
                         "(restart %d/%d)", address, code,
                         self._restarts[address], self._max_restarts)
         proc = self._cluster.remote_exec(command, address, env=env)
         if proc is None:  # dry-run mode: nothing to supervise
             return True
+        self._live_procs[address] = proc
         self._proc_wait_async(proc, address)
         return True
 
@@ -230,11 +281,9 @@ class Coordinator:
         survives in /proc cmdline — matching the full command string,
         ERE-escaped with the self-match bracket trick, is the reliable
         handle (``_reap_pattern``)."""
-        import shlex
-        import signal as _signal
         if old_proc is not None:
             try:
-                os.killpg(old_proc.pid, _signal.SIGKILL)
+                os.killpg(old_proc.pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError, OSError):
                 pass
         if not self._cluster._is_local(address):

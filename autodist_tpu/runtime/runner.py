@@ -52,6 +52,34 @@ class Runner:
                 and not distributed_step.metadata.get("async")):
             self._coord = self._connect_coordination(
                 "staleness pacing (window=%d)" % self._staleness)
+        # async multi-process jobs heartbeat time-based so the chief's
+        # watchdog can tell a deadlocked-but-alive worker from a healthy
+        # one (sync jobs without staleness are collective-lockstep: a
+        # wedged peer shows up as a wedged collective, not silence)
+        self._async_hb = None
+        self._last_hb = 0.0
+        self._hb_enabled = (distributed_step.metadata.get("async")
+                            and const.ENV.ADT_NUM_PROCESSES.val > 1)
+        if self._hb_enabled:
+            self._async_hb = self._connect_coordination(
+                "async liveness heartbeats")
+        self._atexit_cb = None
+        if const.ENV.ADT_NUM_PROCESSES.val > 1:
+            # goodbye-on-exit: a worker whose script simply ends must
+            # deregister, or its last heartbeat ages into a false death.
+            # Registered through a weakref so a discarded runner (and its
+            # TrainState) is not pinned for the process lifetime; close()
+            # unregisters explicitly.
+            import atexit
+            import weakref
+            ref = weakref.ref(self)
+
+            def _close_if_alive(_r=ref):
+                runner = _r()
+                if runner is not None:
+                    runner.close()
+            self._atexit_cb = _close_if_alive
+            atexit.register(_close_if_alive)
 
     def _connect_coordination(self, purpose: str = "staleness pacing"):
         from autodist_tpu.runtime.coordination import CoordinationClient
@@ -82,6 +110,11 @@ class Runner:
 
     _RECENT_WINDOW = 512
 
+    @property
+    def _heartbeat_every_s(self) -> float:
+        # a quarter of the watchdog's window: three missable beats
+        return max(0.25, const.ENV.ADT_HEARTBEAT_TIMEOUT_S.val / 4.0)
+
     def run(self, batch, state: Optional[TrainState] = None) -> Any:
         """One training step on a host-global batch; returns host metrics."""
         t_begin = time.perf_counter()
@@ -100,6 +133,7 @@ class Runner:
         if state is None:
             self.state = new_state
         self._step_count += 1
+        self._maybe_heartbeat()
         if self._coord is not None:
             # bounded staleness across processes (the reference's size-s
             # token-queue semantics, ps_synchronizer.py:388-458): report our
@@ -158,6 +192,43 @@ class Runner:
                 if self._total_step_s > 0 else None)
         return out
 
+    def _maybe_heartbeat(self):
+        """Time-based liveness beat for async multi-process jobs. A failed
+        beat RECONNECTS at the next due time instead of latching off: a
+        worker that silently stopped heartbeating would age into a false
+        death at the chief's watchdog — the one thing this beat exists to
+        prevent.
+
+        Deliberately STEP-DRIVEN, not a background thread: the beat means
+        "this worker made training progress recently", which is the signal
+        a deadlock detector needs — a daemon thread would keep beating
+        while the main thread is wedged in a lock or syscall, masking
+        exactly the hang being watched for. The flip side: legitimate
+        non-stepping phases (long evals, slow data) read as silence, so
+        ``ADT_HEARTBEAT_TIMEOUT_S`` must exceed the job's worst honest
+        inter-step gap."""
+        if not self._hb_enabled:
+            return
+        now = time.monotonic()
+        if now - self._last_hb <= self._heartbeat_every_s:
+            return
+        if self._async_hb is None:
+            self._async_hb = self._connect_coordination(
+                "async liveness heartbeats (reconnect)")
+            if self._async_hb is None:
+                return  # retry at the next due beat
+        try:
+            self._async_hb.heartbeat(const.ENV.ADT_WORKER.val or "chief")
+            self._last_hb = now
+        except OSError as e:
+            logging.warning("async heartbeat failed (%s); reconnecting at "
+                            "the next beat", e)
+            try:
+                self._async_hb.close()
+            except OSError:
+                pass
+            self._async_hb = None
+
     def _maybe_check_mirrors(self):
         """Sync multi-process PS keeps every process's host mirror
         bit-identical by determinism, not by serving; every
@@ -215,12 +286,25 @@ class Runner:
 
     def close(self):
         """Release everything the runner opened: coordination-service
-        clients (pacing + mirror check) and the host-PS store's serving
-        threads/sockets. Idempotent."""
-        for attr in ("_coord", "_mirror_coord"):
-            client = getattr(self, attr)
+        clients (pacing + liveness + mirror check, with a clean GOODBYE
+        deregister so a finished worker is never counted dead) and the
+        host-PS store's serving threads/sockets. Idempotent."""
+        worker = const.ENV.ADT_WORKER.val or "chief"
+        self._hb_enabled = False
+        if getattr(self, "_atexit_cb", None) is not None:
+            import atexit
+            try:
+                atexit.unregister(self._atexit_cb)
+            except Exception:  # noqa: BLE001 — unregister is best-effort
+                pass
+            self._atexit_cb = None
+        for attr, say_goodbye in (("_coord", True), ("_async_hb", True),
+                                  ("_mirror_coord", False)):
+            client = getattr(self, attr, None)
             if client not in (None, False):
                 try:
+                    if say_goodbye:
+                        client.goodbye(worker)
                     client.close()
                 except OSError:
                     pass

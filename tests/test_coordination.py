@@ -158,3 +158,19 @@ def test_queue_cap_rejects_then_recovers(server):
     assert c.qpop("capq") == b"x"
     c.qpush("capq", b"y")  # room again
     c.close()
+
+
+def test_goodbye_deregisters(server):
+    """GOODBYE removes the worker from the DEADLIST universe (a finished
+    worker must not age into a false death) and releases its hold on the
+    staleness window."""
+    c = _client()
+    c.heartbeat("w7")
+    c.report_step("w7", -100)  # uniquely below any other test's steps
+    time.sleep(0.3)
+    assert "w7" in c.dead_workers(0.1)
+    assert c.min_step() == -100
+    c.goodbye("w7")
+    assert "w7" not in c.dead_workers(0.1)
+    assert c.min_step() != -100  # no longer bounds the staleness window
+    c.close()

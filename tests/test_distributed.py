@@ -322,10 +322,15 @@ def test_two_process_staleness_pacing(tmp_path):
         np.testing.assert_array_equal(chief["losses"], worker["losses"])
         assert chief["losses"][-1] < chief["losses"][0]
         # BOTH pacing clients connected (min_step alone can't distinguish
-        # one reporter from two) and every step was reported
+        # one reporter from two)
         for r in (chief, worker):
             assert "staleness pacing (window=2) active" in r["log"], \
                 r["log"][-2000:]
         client = CoordinationClient("127.0.0.1", svc_port)
-        assert client.min_step() == 5
+        # clean exits DEREGISTER (GOODBYE): step records no longer bound
+        # the staleness window and heartbeat records cannot age into a
+        # false death. dead_workers(0.0) lists every registered worker,
+        # so [] proves both records are gone.
+        assert client.min_step() == 0
+        assert client.dead_workers(0.0) == []
         client.close()

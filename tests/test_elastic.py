@@ -34,6 +34,7 @@ import autodist_tpu as adt
 from autodist_tpu import strategy
 
 spec, outdir = sys.argv[1], sys.argv[2]
+mode = sys.argv[3] if len(sys.argv) > 3 else "crash"
 ad = adt.AutoDist(resource_spec_file=spec,
                   strategy_builder=strategy.PS(sync=False))
 import jax.numpy as jnp
@@ -57,7 +58,10 @@ if is_worker:
         if i == 2 and not restarted:
             with open(marker, "w") as f:
                 f.write("x")
-            os._exit(3)  # first incarnation dies mid-run
+            if mode == "crash":
+                os._exit(3)  # first incarnation dies mid-run
+            time.sleep(3600)  # deadlock: alive but silent — the chief's
+            # watchdog must kill us so the process watcher relaunches
     with open(os.path.join(outdir, "out_worker.json"), "w") as f:
         json.dump({"losses": losses, "restarted": restarted}, f)
     print("WORKER_DONE", restarted, flush=True)
@@ -93,7 +97,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_worker_crash_relaunches_and_recovers(tmp_path):
+def _run_elastic(tmp_path, mode, extra_env=None):
     script = tmp_path / "user_script.py"
     script.write_text(USER_SCRIPT)
     spec = tmp_path / "spec.yml"
@@ -111,14 +115,18 @@ def test_worker_crash_relaunches_and_recovers(tmp_path):
             ([os.environ["PYTHONPATH"]] if os.environ.get("PYTHONPATH")
              else [])),
     })
-    proc = subprocess.run(
-        [sys.executable, str(script), str(spec), str(tmp_path)],
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, str(script), str(spec), str(tmp_path), mode],
         env=env, capture_output=True, text=True, timeout=240)
+
+
+def _assert_recovered(tmp_path, proc):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "relaunching worker" in proc.stderr, proc.stderr[-3000:]
     worker = json.loads((tmp_path / "out_worker.json").read_text())
     chief = json.loads((tmp_path / "out_chief.json").read_text())
-    # the SECOND incarnation wrote the output (first one crashed at step 2)
+    # the SECOND incarnation wrote the output (first died at step 2)
     assert worker["restarted"] is True
     assert (tmp_path / "crashed_once").exists()
     assert chief["worker_done"] is True
@@ -127,6 +135,21 @@ def test_worker_crash_relaunches_and_recovers(tmp_path):
     assert worker["losses"][-1] < worker["losses"][0]
     assert chief["losses"][-1] < chief["losses"][0]
     assert chief["applied"] > len(chief["losses"])
+
+
+def test_worker_crash_relaunches_and_recovers(tmp_path):
+    _assert_recovered(tmp_path, _run_elastic(tmp_path, "crash"))
+
+
+def test_worker_deadlock_detected_and_recovered(tmp_path):
+    """The first incarnation HANGS (alive, silent) instead of dying: the
+    chief's heartbeat watchdog must notice the silence, kill the wedged
+    process, and let the process watcher relaunch it — the deadlock leg
+    of elastic supervision (a crash alone never exercises the watchdog)."""
+    proc = _run_elastic(tmp_path, "hang",
+                        extra_env={"ADT_HEARTBEAT_TIMEOUT_S": "6"})
+    assert "deadlock" in proc.stderr, proc.stderr[-3000:]
+    _assert_recovered(tmp_path, proc)
 
 
 def _coordinator_for(tmp_path, strategy):
