@@ -158,16 +158,32 @@ class DistributedStep:
                 opt_state = ps_lib.hole_like(holed_opt_template, opt_state)
         if opt_state is None:
             opt_state = item.optimizer.init(params)
-        # pad + place params
+        # pad + place params. Device-resident leaves stay on device the
+        # whole way: jnp.pad pads in an on-device op and _put reshards
+        # device-side — np.pad would download every leaf first.
         def place_var(leaf, lay: VarLayout):
-            arr = np.asarray(leaf)
+            padded = False
             # already-padded leaves (state re-initialized from a live placed
             # TrainState) must not be padded a second time
-            if lay.partitioned and arr.shape[lay.axis] == lay.orig_dim:
-                pad = [(0, 0)] * arr.ndim
+            if lay.partitioned and np.shape(leaf)[lay.axis] == lay.orig_dim:
+                pad = [(0, 0)] * np.ndim(leaf)
                 pad[lay.axis] = (0, lay.padded_dim - lay.orig_dim)
-                arr = np.pad(arr, pad)
-            return self._put(arr, lay.pspec)
+                if isinstance(leaf, jax.Array):
+                    leaf = jnp.pad(leaf, pad)
+                else:
+                    leaf = np.pad(np.asarray(leaf), pad)
+                padded = True
+            if (not padded and isinstance(leaf, jax.Array)
+                    and jax.process_count() == 1):
+                # the TrainState must OWN fresh buffers: the step donates
+                # them, and device_put is a no-op (sharing the caller's
+                # buffer) when the leaf is already resident with the right
+                # sharding — donation would then delete the user's own
+                # params. jnp.copy duplicates on device, no host trip;
+                # padding already produced a fresh array above, and the
+                # multi-process callback path always copies.
+                leaf = jnp.copy(leaf)
+            return self._put(leaf, lay.pspec)
         params_placed = _tree_map_layouts(place_var, params, self._layout_tree)
         # optimizer state: match each leaf to its variable's layout
         opt_layout_tree = variable_utils.map_state_layouts(

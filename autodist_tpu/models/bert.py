@@ -103,7 +103,11 @@ def make_train_setup(config: Optional[BertConfig] = None, seq_len: int = 128,
     model = BertForMLM(cfg)
     rng = jax.random.PRNGKey(seed)
     ids0 = jnp.zeros((1, seq_len), jnp.int32)
-    variables = model.init(rng, ids0, ids0, jnp.ones((1, seq_len), jnp.int32))
+    # jitted init: ONE device dispatch for the whole parameter tree
+    # (eager flax init issues one RPC per initializer — minutes over a
+    # high-latency host<->device link)
+    variables = jax.jit(model.init)(rng, ids0, ids0,
+                                    jnp.ones((1, seq_len), jnp.int32))
 
     def loss_fn(params, batch):
         logits = model.apply(params, batch["input_ids"],

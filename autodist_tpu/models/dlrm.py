@@ -110,7 +110,7 @@ def make_train_setup(config: Optional[DLRMConfig] = None,
     rng = jax.random.PRNGKey(seed)
     d0 = jnp.zeros((1, cfg.num_dense), jnp.float32)
     s0 = jnp.zeros((1, len(cfg.table_sizes)), jnp.int32)
-    variables = model.init(rng, d0, s0)
+    variables = jax.jit(model.init)(rng, d0, s0)  # one dispatch, not one per initializer
 
     def loss_fn(params, batch):
         logits = model.apply(params, batch["dense"], batch["sparse"])

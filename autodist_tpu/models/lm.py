@@ -99,7 +99,7 @@ def make_train_setup(config: Optional[LMConfig] = None, seq_len: int = 128,
                          % attention)
     model = TransformerLM(cfg, attn_fn=attn_fn)
     rng = jax.random.PRNGKey(seed)
-    variables = model.init(rng, jnp.zeros((1, seq_len), jnp.int32))
+    variables = jax.jit(model.init)(rng, jnp.zeros((1, seq_len), jnp.int32))
 
     def loss_fn(params, batch):
         tokens = batch["tokens"]
@@ -134,7 +134,7 @@ def make_sp_train_setup(config: Optional[LMConfig] = None, seq_len: int = 128,
     attn_fn = make_attn_fn(attention, const.SEQUENCE_AXIS, causal=True)
     model = TransformerLM(cfg, attn_fn=None, seq_parallel=True)  # init w/o axis
     rng = jax.random.PRNGKey(seed)
-    variables = model.init(rng, jnp.zeros((1, seq_len), jnp.int32))
+    variables = jax.jit(model.init)(rng, jnp.zeros((1, seq_len), jnp.int32))
     sp_model = TransformerLM(cfg, attn_fn=attn_fn, seq_parallel=True)
 
     def loss_fn(params, batch):

@@ -60,7 +60,7 @@ def make_train_setup(config: Optional[NCFConfig] = None, batch_size: int = 256,
     cfg = config or NCFConfig()
     model = NeuMF(cfg)
     rng = jax.random.PRNGKey(seed)
-    variables = model.init(rng, jnp.zeros((1,), jnp.int32),
+    variables = jax.jit(model.init)(rng, jnp.zeros((1,), jnp.int32),
                            jnp.zeros((1,), jnp.int32))
 
     def loss_fn(params, batch):

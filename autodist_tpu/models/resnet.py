@@ -112,7 +112,8 @@ def make_train_setup(model_cls=ResNet50, num_classes: int = 1000,
     model = model_cls(num_classes=num_classes, dtype=dtype)
     rng = jax.random.PRNGKey(seed)
     x0 = jnp.ones((1, image_size, image_size, 3), jnp.float32)
-    variables = model.init(rng, x0, train=False)
+    variables = jax.jit(
+        lambda r, x: model.init(r, x, train=False))(rng, x0)
 
     def loss_fn(params, batch):
         logits = model.apply(params, batch["image"], train=False)

@@ -58,12 +58,25 @@ def build_mesh(num_devices: Optional[int] = None,
 
 
 def host_to_mesh(mesh: Mesh, value, pspec) -> jax.Array:
-    """Place a host (numpy) value onto the mesh with the given PartitionSpec.
+    """Place a value onto the mesh with the given PartitionSpec.
     Works single- and multi-process (every process provides its addressable
-    shards from the same host-global value)."""
+    shards from the same host-global value).
+
+    On a single-process mesh, already-device-resident values take the
+    ``device_put`` path: XLA reshards on device (a no-op when the sharding
+    already matches). ``np.asarray`` on a jax.Array would DOWNLOAD it to
+    host and re-upload — invisible over PCIe, but a 220 MB parameter tree
+    over a slow host<->device link pays minutes for nothing. Multi-process
+    meshes stay on the callback path: ``device_put`` cannot retarget a
+    committed process-local array onto a mesh this process only partly
+    owns, and for uncommitted arrays it inserts per-leaf cross-host
+    equality collectives — each-process-provides-its-shards is the
+    multi-process contract here."""
     from jax.sharding import NamedSharding
-    arr = np.asarray(value)
     sharding = NamedSharding(mesh, pspec)
+    if isinstance(value, jax.Array) and jax.process_count() == 1:
+        return jax.device_put(value, sharding)
+    arr = np.asarray(value)
     return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
 
 

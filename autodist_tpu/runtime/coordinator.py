@@ -113,6 +113,14 @@ class Coordinator:
                         continue
                     proc = self._live_procs.get(d)
                     if proc is not None and proc.poll() is None:
+                        # defense in depth: only kill when a relaunch would
+                        # actually be sound (build() already rejects
+                        # elastic+sync jobs, so this should always hold)
+                        if self._restart_unsound_reason(d) is not None:
+                            logging.warning(
+                                "worker %s missed heartbeats but a restart "
+                                "would be unsound — not killing it", d)
+                            continue
                         logging.warning(
                             "worker %s missed heartbeats but its process is "
                             "alive (deadlock?) — killing it for an elastic "

@@ -305,9 +305,13 @@ class Runner:
                 try:
                     if say_goodbye:
                         client.goodbye(worker)
-                    client.close()
                 except OSError:
                     pass
+                finally:  # a failed goodbye must not leak the socket
+                    try:
+                        client.close()
+                    except OSError:
+                        pass
             setattr(self, attr, None)
         store = getattr(self._dstep, "ps_store", None)
         if store is not None:

@@ -22,7 +22,11 @@ wall clock):
 - children share the persistent XLA compile cache (/tmp/adt_jax_cache),
   so repeat runs skip the compile cost entirely;
 - inside a model, the pair loop checks a soft deadline and emits with the
-  pairs it has rather than running past its budget.
+  pairs it has rather than running past its budget;
+- every timing point synchronizes by VALUE READBACK (``_sync``), not
+  ``block_until_ready`` — the tunnel transport can acknowledge readiness
+  before execution drains, which once produced MFU "39" (physically
+  impossible; a real step takes >100x longer than the acked time).
 
 Methodology (unchanged from round 2):
 - batches are device-resident for BOTH paths; both donate state buffers;
@@ -52,13 +56,23 @@ MODEL_LABELS = ["resnet50", "bert_base", "lm1b"]
 RESULT_TAG = "ADT_MODEL_RESULT\t"
 
 
-def _phase_rate(fn, iters):
+def _sync(out) -> float:
+    """Forced VALUE readback of a scalar. On the tunnel transport,
+    ``jax.block_until_ready`` can acknowledge before execution drains
+    (observed: a 'resnet-256 step' timed at 5 ms, MFU 39 — physically
+    impossible); fetching the value cannot return early. Costs one RTT
+    per call, which the adaptive >=1 s phases amortize."""
     import jax
+    import numpy as np
+    return float(np.asarray(jax.device_get(out)))
+
+
+def _phase_rate(fn, iters):
     t0 = time.perf_counter()
     out = None
     for _ in range(iters):
         out = fn()
-    jax.block_until_ready(out)
+    _sync(out)
     return iters / (time.perf_counter() - t0)
 
 
@@ -123,8 +137,13 @@ def bench_model(label, pairs=8, iters=4, deadline=None):
         return optax.apply_updates(p, updates), s, loss
 
     base_batch = jax.device_put(batch_np)
-    base_box = [jax.device_put(jax.device_get(params)),
-                jax.device_put(jax.device_get(opt.init(params)))]
+    # the baseline donates its state buffers, so it needs its OWN copies
+    # (the originals feed the framework path later) — copied ON DEVICE:
+    # a device_get/device_put round trip costs minutes for bert-sized
+    # params when the host<->device link is a throttled tunnel
+    import jax.numpy as jnp
+    copy_tree = jax.jit(lambda t: jax.tree_util.tree_map(jnp.copy, t))
+    base_box = [copy_tree(params), jax.jit(opt.init)(params)]
     t0 = time.perf_counter()
     # AOT-compile once and call the executable directly: one compile serves
     # both the FLOPs count and the baseline steps
@@ -155,9 +174,9 @@ def bench_model(label, pairs=8, iters=4, deadline=None):
     # warmup (compile + a few steps each)
     t0 = time.perf_counter()
     for _ in range(3):
-        run_baseline()
-        run_fw()
-    jax.block_until_ready((base_box[0], state_box[0].params))
+        lb = run_baseline()
+        lf = run_fw()
+    _sync(lb), _sync(lf)
     print("  warmup done in %.1fs" % (time.perf_counter() - t0),
           file=sys.stderr, flush=True)
 
@@ -168,8 +187,7 @@ def bench_model(label, pairs=8, iters=4, deadline=None):
     probes = []
     for _ in range(3):
         t0 = time.perf_counter()
-        run_fw()
-        jax.block_until_ready(state_box[0].params)
+        _sync(run_fw())
         probes.append(time.perf_counter() - t0)
     step_s = max(statistics.median(probes), 1e-4)
     iters = max(iters, min(64, int(round(1.0 / step_s))))
@@ -215,7 +233,7 @@ def probe_main():
         jax.config.update("jax_platforms", os.environ["ADT_BENCH_PLATFORM"])
     t0 = time.perf_counter()
     x = jax.numpy.ones((64, 64)) @ jax.numpy.ones((64, 64))
-    jax.block_until_ready(x)
+    _sync(x.sum())
     print(RESULT_TAG + json.dumps(
         {"probe_s": round(time.perf_counter() - t0, 2)}), flush=True)
 
