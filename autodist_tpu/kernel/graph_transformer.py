@@ -176,12 +176,15 @@ class DistributedStep:
             if (not padded and isinstance(leaf, jax.Array)
                     and jax.process_count() == 1):
                 # the TrainState must OWN fresh buffers: the step donates
-                # them, and device_put is a no-op (sharing the caller's
-                # buffer) when the leaf is already resident with the right
-                # sharding — donation would then delete the user's own
-                # params. jnp.copy duplicates on device, no host trip;
-                # padding already produced a fresh array above, and the
-                # multi-process callback path always copies.
+                # them, and device_put may alias the caller's buffer —
+                # not only on a matching-sharding no-op but ALSO when a
+                # reshard reuses the source buffer as one of the output
+                # shards (observed: SingleDevice -> 8-way replicated kept
+                # the source as shard 0, and donation deleted the user's
+                # params). No reliable aliasing predicate exists, so copy
+                # unconditionally: jnp.copy is device-side (no host trip)
+                # and transient per-leaf, not a whole-tree spike. Padding
+                # and the multi-process callback path already copy.
                 leaf = jnp.copy(leaf)
             return self._put(leaf, lay.pspec)
         params_placed = _tree_map_layouts(place_var, params, self._layout_tree)

@@ -41,6 +41,10 @@ class Calibration:
                       for f in dataclasses.fields(cls)})
 
     def save(self, path: str) -> str:
+        import os
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)  # a long measurement session
+        # must not die on a missing directory at the very last step
         with open(path, "w") as f:
             json.dump(self.to_dict(), f, indent=1, sort_keys=True)
         return path
