@@ -93,3 +93,63 @@ def count_flops_estimate(jaxpr) -> int:
             total += 2 * int(np.prod(out.shape)) * max(k, 1)
     traverse(jaxpr, visit)
     return total
+
+# jaxpr primitives that move bytes across mesh axes: (axis param key,
+# cost class). "reduce" collectives (all-reduce family) move the FULL
+# traced payload around the ring regardless of axis size; "permute"
+# (ring rotations) move ~the full traced payload in total (size-1 traces
+# see one full-size block where the real program does k rotations of
+# 1/k-size blocks); "alltoall" exchanges only this device's 1/k shard.
+_COLLECTIVE_KINDS = {
+    "psum": ("axes", "reduce"), "pmax": ("axes", "reduce"),
+    "pmin": ("axes", "reduce"),
+    "all_gather": ("axis_name", "reduce"),
+    "reduce_scatter": ("axis_name", "reduce"),
+    "all_to_all": ("axis_name", "alltoall"),
+    "ppermute": ("axis_name", "permute"),
+}
+
+
+def collective_comm_profile(jaxpr) -> dict:
+    """{mesh axis name: {cost class: payload bytes}} for the collectives
+    a traced program issues — the cost-model input for MODEL-PARALLEL
+    communication (Megatron psums, ring-attention ppermutes, MoE
+    all_to_alls), which the per-variable strategy terms cannot see
+    because these collectives live inside the user's forward. Bytes are
+    the collective OUTPUT avals at trace shapes; scan bodies multiply by
+    trip count (a scanned L-layer stack issues L psums, not one)."""
+    import numpy as np
+    from autodist_tpu.kernel.common import op_info
+    profile: dict = {}
+
+    def walk(jp, mult):
+        for eqn in jp.eqns:
+            name = eqn.primitive.name
+            # materialize: sub_jaxprs is a generator, and a generator is
+            # truthy even when it yields nothing
+            subs = list(op_info.sub_jaxprs(eqn))
+            if name == "scan":
+                inner = mult * int(eqn.params.get("length", 1) or 1)
+                for sub in subs:
+                    walk(sub, inner)
+                continue
+            if subs:
+                for sub in subs:
+                    walk(sub, mult)
+                continue
+            key_kind = _COLLECTIVE_KINDS.get(name)
+            if key_kind is None:
+                continue
+            key, kind = key_kind
+            axes = eqn.params.get(key, ())
+            if isinstance(axes, str):
+                axes = (axes,)
+            nbytes = mult * sum(
+                int(np.prod(ov.aval.shape or (1,)))
+                * np.dtype(ov.aval.dtype).itemsize
+                for ov in eqn.outvars if hasattr(ov.aval, "shape"))
+            for axis in axes:
+                by_kind = profile.setdefault(axis, {})
+                by_kind[kind] = by_kind.get(kind, 0.0) + float(nbytes)
+    walk(jaxpr, 1)
+    return profile

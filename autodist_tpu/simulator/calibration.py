@@ -64,6 +64,7 @@ def _predict(breakdown, scales: Sequence[float]) -> float:
         breakdown, compute_s=breakdown.compute_s * c,
         allreduce_s=breakdown.allreduce_s * a,
         ps_s=breakdown.ps_s * p,
+        mp_s=breakdown.mp_s * a,  # rides the same wire as gradient AR
         latency_s=breakdown.latency_s * l).step_time_s
 
 
@@ -98,7 +99,10 @@ def fit(breakdowns: Sequence, measured_s: Sequence[float],
         # golden-section comparison downstream
         raise ValueError("measured times must be positive finite seconds")
     scales = [1.0, 1.0, 1.0, 1.0]
-    terms = [lambda b: b.compute_s, lambda b: b.allreduce_s,
+    # ar_scale covers everything on the collective wire (allreduce_s AND
+    # mp_s — _predict applies it to both), so an mp-only measurement set
+    # still exercises it
+    terms = [lambda b: b.compute_s, lambda b: b.allreduce_s + b.mp_s,
              lambda b: b.ps_s, lambda b: b.latency_s]
     gr = (math.sqrt(5.0) - 1.0) / 2.0
 

@@ -53,6 +53,11 @@ class CostBreakdown:
     allreduce_s: float
     ps_s: float
     latency_s: float
+    # model-parallel collective time (Megatron psums, ring-attention
+    # ppermutes, MoE all_to_alls): these live INSIDE the forward/backward
+    # on the compute critical path, so unlike the gradient collectives
+    # they do not overlap with compute
+    mp_s: float = 0.0
     # per-device HBM estimate (params + optimizer + gradient buffer +
     # activations) and whether it fits the chip — strategies change all
     # four terms: host-PS offloads params/opt, ZeRO partitions them,
@@ -66,9 +71,11 @@ class CostBreakdown:
 
     @property
     def step_time_s(self) -> float:
-        # collectives overlap partially with compute on TPU; assume the
-        # slower of the two dominates, plus fixed launch latency
-        return max(self.compute_s, self.allreduce_s + self.ps_s) + self.latency_s
+        # gradient collectives overlap partially with compute on TPU;
+        # assume the slower of the two dominates. Model-parallel
+        # collectives and launch latency are serial.
+        return (max(self.compute_s, self.allreduce_s + self.ps_s)
+                + self.mp_s + self.latency_s)
 
 
 class CostModel:
@@ -227,6 +234,41 @@ class CostModel:
         self._act_cache = (float(total), float(dots), float(batch_in))
         return self._act_cache
 
+    def _collective_profile(self):
+        """{axis: fwd payload bytes} of the loss's own collectives, from
+        ONE cached trace (the same jaxpr the FLOPs/activation estimates
+        use). Empty when the loss has no model-parallel collectives or
+        the trace failed."""
+        if not hasattr(self, "_coll_cache"):
+            closed = self._loss_jaxpr()
+            if closed is None:
+                self._coll_cache = {}
+            else:
+                from autodist_tpu.kernel.common.utils import (
+                    collective_comm_profile)
+                self._coll_cache = collective_comm_profile(closed.jaxpr)
+        return self._coll_cache
+
+    def mp_comm_time(self, strategy: Strategy, ici_bw: float) -> float:
+        """Serial model-parallel collective seconds per step, by cost
+        class. A Megatron row-parallel psum all-reduces the FULL traced
+        activation no matter the axis size (wire ~2(k-1)/k of payload);
+        ring permutes move ~the full traced payload in total; all_to_all
+        exchanges only this device's 1/k shard. The backward issues
+        roughly the same collectives again (psum <-> psum, ppermute
+        reversed), hence the 2x."""
+        mesh_shape = strategy.graph_config.mesh_shape or {}
+        total = 0.0
+        for axis, by_kind in self._collective_profile().items():
+            k = int(mesh_shape.get(axis, 1))
+            if k <= 1:
+                continue  # axis not materialized: collective is a no-op
+            wire = (by_kind.get("reduce", 0.0) * 2.0 * (k - 1) / k
+                    + by_kind.get("permute", 0.0) * (k - 1) / k
+                    + by_kind.get("alltoall", 0.0) * (k - 1) / k / k)
+            total += 2.0 * wire / ici_bw
+        return total
+
     def hbm_bytes(self, strategy: Strategy) -> float:
         """Per-device HBM estimate under a strategy: device-resident
         params + optimizer state + one gradient buffer + activations.
@@ -371,14 +413,16 @@ class CostModel:
         remat_factor = REMAT_COMPUTE_FACTOR.get(
             strategy.graph_config.remat, 1.0)
         compute_s = self.compute_time(n) * remat_factor
+        mp_s = self.mp_comm_time(strategy, ici_bw)
         cal = self.calibration
         if cal is not None:
             compute_s *= cal.compute_scale
             allreduce_s *= cal.ar_scale
             ps_s *= cal.ps_scale
             latency_s *= cal.latency_scale
+            mp_s *= cal.ar_scale  # same wire as the gradient collectives
         return CostBreakdown(compute_s=compute_s,
                              allreduce_s=allreduce_s, ps_s=ps_s,
-                             latency_s=latency_s,
+                             latency_s=latency_s, mp_s=mp_s,
                              hbm_bytes=self.hbm_bytes(strategy),
                              hbm_capacity=self._hbm_capacity)

@@ -45,14 +45,23 @@ def default_candidates() -> List[Tuple[str, StrategyBuilder]]:
 
 class AutoStrategy(StrategyBuilder):
     def __init__(self, candidates: Optional[List[Tuple[str, StrategyBuilder]]] = None,
+                 extra_candidates: Optional[List[Tuple[str, StrategyBuilder]]] = None,
                  **cost_model_kwargs):
+        """``candidates`` REPLACES the default pool; ``extra_candidates``
+        extends it — the hook for model-parallel entries (TensorParallel,
+        SequenceParallelAR, ExpertParallel need model-specific mp_rules,
+        so they cannot be defaults). The cost model prices their
+        forward-collective traffic (``mp_comm_time``) and the HBM gate
+        understands their sharded storage, so mp candidates rank against
+        the data-parallel family on one scale."""
         self._candidates = candidates
+        self._extra = list(extra_candidates or [])
         self._cm_kwargs = cost_model_kwargs
         self.last_ranking = None  # exposed for inspection/tests
 
     def build(self, model_item, resource_spec) -> Strategy:
         from autodist_tpu.simulator.simulator import Simulator
-        candidates = self._candidates or default_candidates()
+        candidates = (self._candidates or default_candidates()) + self._extra
         built = []
         for label, builder in candidates:
             try:

@@ -11,6 +11,11 @@ realized over our analytic model), persists them, and lets
 Run on anything (CPU works):
     python examples/autostrategy_calibrate.py
 """
+if __package__ in (None, ""):  # direct invocation: repo root on sys.path
+    import os as _os
+    import sys as _sys
+    _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
 import os
 import time
 

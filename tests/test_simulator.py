@@ -335,3 +335,85 @@ def test_calibration_rejects_nan_measurement():
     for bad in (float("nan"), float("inf")):
         with pytest.raises(ValueError):
             cal_lib.fit([raw], [bad])
+
+
+# ---------------------------------------------- model-parallel accounting
+
+def _tp_case(seq_len=16, batch_size=8):
+    from autodist_tpu.models import tp_lm
+    cfg = tp_lm.TPLMConfig.tiny()
+    loss_fn, params, batch, _ = tp_lm.make_train_setup(
+        cfg, seq_len=seq_len, batch_size=batch_size)
+    item = ModelItem(loss_fn=loss_fn, optimizer=optax.sgd(0.1),
+                     params=params, example_batch=batch).prepare()
+    return item, tp_lm.tp_rules()
+
+
+def test_collective_profile_sees_megatron_psums():
+    from autodist_tpu.kernel.common.utils import collective_comm_profile
+    from autodist_tpu.utils.axis_env import bound_axes
+    item, _ = _tp_case()
+    with bound_axes():
+        jx = jax.make_jaxpr(item.loss_fn)(item.params, item.example_batch)
+    prof = collective_comm_profile(jx.jaxpr)
+    # row-parallel psums are "reduce"-class: full payload on the wire
+    assert prof["model"]["reduce"] > 0
+    # psum payload must NOT be divided by axis size downstream: the cost
+    # model charges reduce-class bytes at the ring factor only (a tp8
+    # psum is NOT cheaper than a tp2 psum of the same activation)
+    from autodist_tpu.strategy.tensor_parallel_strategy import TensorParallel
+    from autodist_tpu.models import tp_lm as _tp
+    item, rules = _tp_case()
+    spec = _spec(n_nodes=1, tpus=8)
+    sim = Simulator(item, spec)
+    tp2 = TensorParallel(tp_shards=2, mp_rules=rules).build(item, spec)
+    tp8 = TensorParallel(tp_shards=8, mp_rules=rules).build(item, spec)
+    mp2 = sim.simulate(tp2).breakdown.mp_s
+    mp8 = sim.simulate(tp8).breakdown.mp_s
+    assert mp8 > mp2  # ring factor grows with k; payload does not shrink
+
+
+def test_mp_term_prices_tensor_parallel():
+    """A TensorParallel strategy carries a nonzero serial mp_s term that
+    grows with payload; DP strategies carry none. On an ICI-rich spec the
+    small model ranks DP first; with HBM capacity squeezed below DP's
+    needs (but above TP's sharded storage) the feasibility gate flips the
+    ranking to TP — memory pressure is WHY one goes model-parallel."""
+    from autodist_tpu.strategy.tensor_parallel_strategy import TensorParallel
+    item, rules = _tp_case()
+    spec = _spec(n_nodes=1, tpus=8)
+    sim = Simulator(item, spec)
+    tp = TensorParallel(tp_shards=2, mp_rules=rules).build(item, spec)
+    dp = S.AllReduce().build(item, spec)
+    b_tp, b_dp = sim.simulate(tp).breakdown, sim.simulate(dp).breakdown
+    assert b_tp.mp_s > 0
+    assert b_dp.mp_s == 0
+    assert sim.rank([("dp", dp), ("tp", tp)])[0].label == "dp"
+    # squeeze HBM: DP infeasible, TP's sharded params fit
+    mid = (b_dp.hbm_bytes + b_tp.hbm_bytes) / 2
+    assert b_tp.hbm_bytes < b_dp.hbm_bytes
+    tight = Simulator(item, spec, hbm_capacity_bytes=mid)
+    ranked = tight.rank([("dp", dp), ("tp", tp)])
+    assert ranked[0].label == "tp"
+    assert ranked[0].breakdown.feasible and not ranked[1].breakdown.feasible
+
+
+def test_auto_strategy_extra_candidates_rank_and_build():
+    """extra_candidates extends the default pool; the chosen strategy
+    (whichever wins) must lower and train."""
+    from autodist_tpu.strategy.tensor_parallel_strategy import TensorParallel
+    import autodist_tpu as adt
+    from autodist_tpu.models import tp_lm
+    adt.reset()
+    cfg = tp_lm.TPLMConfig.tiny()
+    loss_fn, params, batch, _ = tp_lm.make_train_setup(
+        cfg, seq_len=16, batch_size=8)
+    builder = AutoStrategy(extra_candidates=[
+        ("tp2", TensorParallel(tp_shards=2, mp_rules=tp_lm.tp_rules()))])
+    ad = adt.AutoDist(strategy_builder=builder)
+    step = ad.function(loss_fn, optimizer=optax.sgd(0.1), params=params)
+    losses = [float(step(batch)["loss"]) for _ in range(3)]
+    assert losses[-1] < losses[0]
+    labels = [r.label for r in builder.last_ranking]
+    assert "tp2" in labels and len(labels) > 5
+    adt.reset()
