@@ -95,16 +95,25 @@ def count_flops_estimate(jaxpr) -> int:
     return total
 
 # jaxpr primitives that move bytes across mesh axes: (axis param key,
-# cost class). "reduce" collectives (all-reduce family) move the FULL
-# traced payload around the ring regardless of axis size; "permute"
-# (ring rotations) move ~the full traced payload in total (size-1 traces
-# see one full-size block where the real program does k rotations of
-# 1/k-size blocks); "alltoall" exchanges only this device's 1/k shard.
+# cost class). Classes matter because the profile is taken from a trace
+# with every axis bound at SIZE 1, so each primitive's traced output
+# relates differently to its real per-device wire at axis size k:
+#   reduce  (psum/pmax/pmin): traced out == full payload at any k;
+#           ring wire ~ 2(k-1)/k x traced bytes.
+#   gather  (all_gather): traced out == the per-device SHARD at size 1;
+#           real wire ~ (k-1) x traced bytes.
+#   scatter (reduce_scatter): traced out == the FULL input at size 1;
+#           real wire ~ (k-1)/k x traced bytes.
+#   alltoall: traced buffer size is k-invariant (split/concat cancel);
+#           real wire ~ (k-1)/k x traced bytes.
+#   permute (ring rotations): size-1 traces see one full-size block
+#           where the real program does ~k rotations of 1/k blocks;
+#           total wire ~ (k-1)/k x traced bytes.
 _COLLECTIVE_KINDS = {
     "psum": ("axes", "reduce"), "pmax": ("axes", "reduce"),
     "pmin": ("axes", "reduce"),
-    "all_gather": ("axis_name", "reduce"),
-    "reduce_scatter": ("axis_name", "reduce"),
+    "all_gather": ("axis_name", "gather"),
+    "reduce_scatter": ("axis_name", "scatter"),
     "all_to_all": ("axis_name", "alltoall"),
     "ppermute": ("axis_name", "permute"),
 }

@@ -251,12 +251,10 @@ class CostModel:
 
     def mp_comm_time(self, strategy: Strategy, ici_bw: float) -> float:
         """Serial model-parallel collective seconds per step, by cost
-        class. A Megatron row-parallel psum all-reduces the FULL traced
-        activation no matter the axis size (wire ~2(k-1)/k of payload);
-        ring permutes move ~the full traced payload in total; all_to_all
-        exchanges only this device's 1/k shard. The backward issues
-        roughly the same collectives again (psum <-> psum, ppermute
-        reversed), hence the 2x."""
+        class (see ``_COLLECTIVE_KINDS`` in kernel/common/utils.py for
+        how each class's traced bytes relate to real wire at axis size
+        k). The backward issues roughly the same collectives again
+        (psum <-> psum, ppermute reversed), hence the 2x."""
         mesh_shape = strategy.graph_config.mesh_shape or {}
         total = 0.0
         for axis, by_kind in self._collective_profile().items():
@@ -264,8 +262,10 @@ class CostModel:
             if k <= 1:
                 continue  # axis not materialized: collective is a no-op
             wire = (by_kind.get("reduce", 0.0) * 2.0 * (k - 1) / k
+                    + by_kind.get("gather", 0.0) * (k - 1)
+                    + by_kind.get("scatter", 0.0) * (k - 1) / k
                     + by_kind.get("permute", 0.0) * (k - 1) / k
-                    + by_kind.get("alltoall", 0.0) * (k - 1) / k / k)
+                    + by_kind.get("alltoall", 0.0) * (k - 1) / k)
             total += 2.0 * wire / ici_bw
         return total
 
@@ -413,6 +413,14 @@ class CostModel:
         remat_factor = REMAT_COMPUTE_FACTOR.get(
             strategy.graph_config.remat, 1.0)
         compute_s = self.compute_time(n) * remat_factor
+        # GPipe bubble: S stages over M microbatches keep each device
+        # busy M/(S-1+M) of the schedule (Huang et al. 1811.06965)
+        from autodist_tpu import const as _const
+        mesh_shape_cfg = strategy.graph_config.mesh_shape or {}
+        pp = int(mesh_shape_cfg.get(_const.PIPELINE_AXIS, 1))
+        if pp > 1:
+            m = int(strategy.graph_config.pp_microbatches or 1)
+            compute_s *= (pp - 1 + m) / m
         mp_s = self.mp_comm_time(strategy, ici_bw)
         cal = self.calibration
         if cal is not None:

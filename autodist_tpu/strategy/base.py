@@ -183,11 +183,15 @@ class GraphConfig:
     # the TF reference had no equivalent for; on TPU it is the standard
     # HBM-for-FLOPs trade that lets bigger batches/models fit
     remat: Optional[str] = None
+    # GPipe microbatch count for pipeline strategies — recorded so the
+    # cost model can price the pipeline bubble ((S-1+M)/M compute
+    # inflation) from the serialized strategy alone
+    pp_microbatches: Optional[int] = None
 
     def to_dict(self):
         return {"replicas": list(self.replicas), "mesh_shape": self.mesh_shape,
                 "seq_axis": self.seq_axis, "batch_axes": self.batch_axes,
-                "remat": self.remat}
+                "remat": self.remat, "pp_microbatches": self.pp_microbatches}
 
     @classmethod
     def from_dict(cls, d):
@@ -195,7 +199,8 @@ class GraphConfig:
                    mesh_shape=d.get("mesh_shape"),
                    seq_axis=d.get("seq_axis"),
                    batch_axes=d.get("batch_axes"),
-                   remat=d.get("remat"))
+                   remat=d.get("remat"),
+                   pp_microbatches=d.get("pp_microbatches"))
 
 
 # ----------------------------------------------------------------- strategy

@@ -52,6 +52,7 @@ class PipelineParallel(AllReduce):
         if self.tp_shards > 1:
             mesh_shape[const.MODEL_AXIS] = self.tp_shards
         strategy.graph_config.mesh_shape = mesh_shape
+        strategy.graph_config.pp_microbatches = self.n_microbatches
         add_frozen_nodes(strategy, model_item)
         n = apply_mp_rules(strategy, self.mp_rules)
         logging.info("PipelineParallel: %d/%d vars pipe-sharded, mesh %s, "

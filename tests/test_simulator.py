@@ -358,11 +358,13 @@ def test_collective_profile_sees_megatron_psums():
     prof = collective_comm_profile(jx.jaxpr)
     # row-parallel psums are "reduce"-class: full payload on the wire
     assert prof["model"]["reduce"] > 0
-    # psum payload must NOT be divided by axis size downstream: the cost
-    # model charges reduce-class bytes at the ring factor only (a tp8
-    # psum is NOT cheaper than a tp2 psum of the same activation)
+
+
+def test_psum_cost_not_divided_by_axis_size():
+    """Reduce-class payload must NOT shrink with axis extent: a tp8 psum
+    all-reduces the same full activation as a tp2 psum, at a slightly
+    larger ring factor."""
     from autodist_tpu.strategy.tensor_parallel_strategy import TensorParallel
-    from autodist_tpu.models import tp_lm as _tp
     item, rules = _tp_case()
     spec = _spec(n_nodes=1, tpus=8)
     sim = Simulator(item, spec)
@@ -417,3 +419,33 @@ def test_auto_strategy_extra_candidates_rank_and_build():
     labels = [r.label for r in builder.last_ranking]
     assert "tp2" in labels and len(labels) > 5
     adt.reset()
+
+
+def test_pp_bubble_prices_microbatching():
+    """The GPipe bubble inflates compute by (S-1+M)/M: more microbatches
+    amortize the bubble; the factor survives strategy serialization."""
+    from autodist_tpu.strategy.pipeline_parallel_strategy import PipelineParallel
+    from autodist_tpu.strategy.base import Strategy
+    from autodist_tpu.models import pipe_lm
+    cfg = pipe_lm.TPLMConfig.tiny()
+    loss_fn, params, batch, _ = pipe_lm.make_train_setup(
+        cfg, seq_len=16, batch_size=8, n_microbatches=4)
+    item = ModelItem(loss_fn=loss_fn, optimizer=optax.sgd(0.1),
+                     params=params, example_batch=batch).prepare()
+    spec = _spec(n_nodes=1, tpus=8)
+    sim = Simulator(item, spec)
+    rules = pipe_lm.pp_rules(model_axis="model")
+    few = PipelineParallel(pp_shards=4, n_microbatches=2,
+                           mp_rules=rules).build(item, spec)
+    many = PipelineParallel(pp_shards=4, n_microbatches=16,
+                            mp_rules=rules).build(item, spec)
+    c_few = sim.simulate(few).breakdown.compute_s
+    c_many = sim.simulate(many).breakdown.compute_s
+    # (4-1+2)/2 = 2.5x vs (4-1+16)/16 ~= 1.19x
+    assert c_few / c_many == pytest.approx(2.5 / (19 / 16), rel=1e-6)
+    # the factor must survive the file handoff (workers re-rank nothing,
+    # but the chief's AutoStrategy decisions must be reproducible from
+    # the serialized form)
+    rt = Strategy.from_dict(few.to_dict())
+    assert rt.graph_config.pp_microbatches == 2
+    assert sim.simulate(rt).breakdown.compute_s == pytest.approx(c_few)
