@@ -122,3 +122,30 @@ def test_remat_composes_with_sequence_parallel():
     for k in plain:
         np.testing.assert_allclose(plain[k], remat[k], rtol=1e-5,
                                    atol=1e-6, err_msg=k)
+
+
+def test_remat_composes_with_tensor_parallel():
+    """WithRemat around TensorParallel: jax.checkpoint over a loss whose
+    forward issues Megatron psums must lower, run, and track the
+    non-remat TP trajectory."""
+    from autodist_tpu.models import tp_lm
+
+    cfg = tp_lm.TPLMConfig.tiny()
+    loss_fn, params, batch, _ = tp_lm.make_train_setup(
+        cfg, seq_len=16, batch_size=8)
+
+    def run(builder):
+        adt.reset()
+        ad = adt.AutoDist(strategy_builder=builder)
+        runner = ad.build(loss_fn, optax.sgd(0.1), params, batch)
+        runner.init(params)
+        losses = [float(runner.run(batch)["loss"]) for _ in range(2)]
+        adt.reset()
+        return losses
+
+    tp = strategy.TensorParallel(tp_shards=2, mp_rules=tp_lm.tp_rules())
+    plain = run(tp)
+    remat = run(strategy.WithRemat(
+        strategy.TensorParallel(tp_shards=2, mp_rules=tp_lm.tp_rules()),
+        policy="dots"))
+    np.testing.assert_allclose(plain, remat, rtol=1e-5, atol=1e-6)
