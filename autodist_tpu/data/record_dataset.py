@@ -49,6 +49,9 @@ def _dll():
         dll.adl_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                  ctypes.c_int, ctypes.c_uint64, ctypes.c_int,
                                  ctypes.c_uint64]
+        dll.adl_open_sharded.restype = ctypes.c_void_p
+        dll.adl_open_sharded.argtypes = dll.adl_open.argtypes + [
+            ctypes.c_uint64, ctypes.c_uint64]
         dll.adl_next_batch.restype = ctypes.POINTER(ctypes.c_uint8)
         dll.adl_next_batch.argtypes = [ctypes.c_void_p,
                                        ctypes.POINTER(ctypes.c_uint64)]
@@ -163,15 +166,21 @@ class RecordFileDataset:
 
     def __init__(self, path: str, batch_size: int, shuffle: bool = True,
                  seed: int = 0, num_threads: int = 2, ring_slots: int = 4,
-                 copy: bool = True):
+                 copy: bool = True, shard: Tuple[int, int] = (0, 1)):
+        """``shard=(index, count)`` restricts this loader to the strided
+        record subset {i : i % count == index} — the multi-host input
+        pattern: each process loads its OWN disjoint 1/count slice (its
+        per-process batch) instead of materializing the global batch
+        everywhere; pair with ``Remapper.remap_feed_local``."""
         with open(path + ".json") as f:
             meta = json.load(f)
         self.fields = [_Field(d["name"], d["dtype"], d["shape"])
                        for d in meta["fields"]]
         self.batch_size = int(batch_size)
-        self._handle = _dll().adl_open(path.encode(), self.batch_size,
-                                       int(shuffle), seed, num_threads,
-                                       ring_slots)
+        self.shard = (int(shard[0]), int(shard[1]))
+        self._handle = _dll().adl_open_sharded(
+            path.encode(), self.batch_size, int(shuffle), seed, num_threads,
+            ring_slots, self.shard[0], self.shard[1])
         if not self._handle:
             raise ValueError("could not open record file %s" % path)
         self.num_records = int(_dll().adl_num_records(self._handle))

@@ -26,6 +26,13 @@
 // Semantics: infinite stream over the file; each epoch is a fresh
 // permutation (xorshift64* seeded from (seed, epoch)); trailing records
 // that don't fill a batch are dropped (TPU static shapes).
+//
+// Sharding (multi-host input): adl_open_sharded(shard_index, shard_count)
+// restricts the stream to the strided record subset
+// {i : i % shard_count == shard_index} — every process reads a DISJOINT
+// 1/shard_count slice of the file instead of materializing the global
+// batch everywhere. Same seed + different shard_index streams are
+// disjoint by construction.
 
 #include <fcntl.h>
 #include <stdint.h>
@@ -65,12 +72,14 @@ struct Loader {
   int fd = -1;
   const uint8_t* base = nullptr;  // mmap of the payload
   size_t map_len = 0;
-  uint64_t n_records = 0;
+  uint64_t n_records = 0;      // records in THIS shard's universe
   uint64_t record_bytes = 0;
   uint64_t batch = 0;
   uint64_t batches_per_epoch = 0;
   int shuffle = 0;
   uint64_t seed = 0;
+  uint64_t shard_index = 0;    // global record = local * shard_count + index
+  uint64_t shard_count = 1;
 
   // epoch state (guarded by mu)
   std::mutex mu;
@@ -119,9 +128,11 @@ struct Loader {
         if (stopping) return;
       }
       // gather outside the lock: this is the expensive part
-      for (uint64_t k = 0; k < batch; ++k)
+      for (uint64_t k = 0; k < batch; ++k) {
+        uint64_t g = (uint64_t)indices[k] * shard_count + shard_index;
         memcpy(staging.data() + k * record_bytes,
-               base + (uint64_t)indices[k] * record_bytes, record_bytes);
+               base + g * record_bytes, record_bytes);
+      }
       {
         std::unique_lock<std::mutex> lk(mu);
         Slot* slot = &ring[my_batch % ring.size()];
@@ -147,8 +158,9 @@ struct Loader {
 extern "C" {
 
 // Returns a handle, or null on error (message to stderr).
-void* adl_open(const char* path, uint64_t batch, int shuffle, uint64_t seed,
-               int num_threads, uint64_t ring_slots) {
+void* adl_open_sharded(const char* path, uint64_t batch, int shuffle,
+                       uint64_t seed, int num_threads, uint64_t ring_slots,
+                       uint64_t shard_index, uint64_t shard_count) {
   int fd = open(path, O_RDONLY);
   if (fd < 0) {
     perror("adl_open");
@@ -168,9 +180,20 @@ void* adl_open(const char* path, uint64_t batch, int shuffle, uint64_t seed,
     close(fd);
     return nullptr;
   }
+  if (shard_count == 0 || shard_index >= shard_count) {
+    fprintf(stderr, "adl_open: shard %llu/%llu invalid\n",
+            (unsigned long long)shard_index, (unsigned long long)shard_count);
+    close(fd);
+    return nullptr;
+  }
+  uint64_t n_global = n_records;
+  // this shard's universe: strided records {i : i % count == index}
+  n_records = n_global / shard_count +
+              (shard_index < n_global % shard_count ? 1 : 0);
   if (n_records < batch) {
-    fprintf(stderr, "adl_open: batch %llu > records %llu\n",
-            (unsigned long long)batch, (unsigned long long)n_records);
+    fprintf(stderr, "adl_open: batch %llu > records %llu (shard %llu/%llu)\n",
+            (unsigned long long)batch, (unsigned long long)n_records,
+            (unsigned long long)shard_index, (unsigned long long)shard_count);
     close(fd);
     return nullptr;
   }
@@ -186,13 +209,13 @@ void* adl_open(const char* path, uint64_t batch, int shuffle, uint64_t seed,
   struct stat st;
   fstat(fd, &st);
   if (record_bytes == 0 ||
-      n_records > (SIZE_MAX - 20) / record_bytes) {  // corrupt header
+      n_global > (SIZE_MAX - 20) / record_bytes) {  // corrupt header
     fprintf(stderr, "adl_open: %s header overflows (n=%llu rb=%llu)\n", path,
-            (unsigned long long)n_records, (unsigned long long)record_bytes);
+            (unsigned long long)n_global, (unsigned long long)record_bytes);
     close(fd);
     return nullptr;
   }
-  size_t want = 20 + n_records * record_bytes;
+  size_t want = 20 + n_global * record_bytes;  // the FULL file is mapped
   if ((size_t)st.st_size < want) {
     fprintf(stderr, "adl_open: %s truncated (%lld < %zu)\n", path,
             (long long)st.st_size, want);
@@ -215,12 +238,20 @@ void* adl_open(const char* path, uint64_t batch, int shuffle, uint64_t seed,
   L->batches_per_epoch = n_records / batch;
   L->shuffle = shuffle;
   L->seed = seed;
+  L->shard_index = shard_index;
+  L->shard_count = shard_count;
   if (ring_slots < 2) ring_slots = 2;
   L->ring.resize(ring_slots);
   if (num_threads < 1) num_threads = 1;
   for (int i = 0; i < num_threads; ++i)
     L->workers.emplace_back([L] { L->WorkerLoop(); });
   return L;
+}
+
+void* adl_open(const char* path, uint64_t batch, int shuffle, uint64_t seed,
+               int num_threads, uint64_t ring_slots) {
+  return adl_open_sharded(path, batch, shuffle, seed, num_threads, ring_slots,
+                          0, 1);
 }
 
 uint64_t adl_record_bytes(void* h) { return ((Loader*)h)->record_bytes; }

@@ -47,30 +47,33 @@ class Remapper:
         from autodist_tpu.parallel.mesh import host_to_mesh
         return host_to_mesh(self.mesh, value, pspec)
 
+    def _leaf_spec(self, shape, replicas: int, what: str) -> P:
+        """PartitionSpec + divisibility validation shared by the global
+        and process-local feed paths (``replicas`` is the batch-dim
+        divisor the caller needs: all replicas, or this process's)."""
+        if len(shape) == 0:
+            return P()
+        if shape[0] % replicas != 0:
+            raise ValueError(
+                "%s batch dim %d is not divisible by the %d replicas; pad "
+                "or resize the batch (TPU programs need static, even "
+                "shards)" % (what, shape[0], replicas))
+        if self.seq_axis and len(shape) >= 2:
+            if shape[1] % self.seq_shards != 0:
+                raise ValueError(
+                    "sequence dim %d is not divisible by the %d "
+                    "sequence shards" % (shape[1], self.seq_shards))
+            return P(self.batch_axes, self.seq_axis)
+        return P(self.batch_axes)
+
     def remap_feed(self, batch) -> Any:
         """Split the global batch across replicas along dim 0. Leaves that
         are already mesh-placed with the right sharding (e.g. by
         ``data.DevicePrefetcher``) pass through untouched — re-placing
         would round-trip them through the host."""
         def place(leaf):
-            shape = np.shape(leaf)
-            if len(shape) == 0:
-                spec = P()
-            else:
-                if shape[0] % self.num_replicas != 0:
-                    raise ValueError(
-                        "global batch dim %d is not divisible by the %d "
-                        "replicas; pad or resize the batch (TPU programs "
-                        "need static, even shards)" % (shape[0],
-                                                       self.num_replicas))
-                if self.seq_axis and len(shape) >= 2:
-                    if shape[1] % self.seq_shards != 0:
-                        raise ValueError(
-                            "sequence dim %d is not divisible by the %d "
-                            "sequence shards" % (shape[1], self.seq_shards))
-                    spec = P(self.batch_axes, self.seq_axis)
-                else:
-                    spec = P(self.batch_axes)
+            spec = self._leaf_spec(np.shape(leaf), self.num_replicas,
+                                   "global")
             if isinstance(leaf, jax.Array):
                 want = NamedSharding(self.mesh, spec)
                 if leaf.sharding.is_equivalent_to(want, leaf.ndim):
@@ -90,6 +93,37 @@ class Remapper:
                 # path (make_array_from_callback), which every process runs
             return self._place(np.asarray(leaf), spec)
         return jax.tree_util.tree_map(place, batch)
+
+    def remap_feed_local(self, local_batch) -> Any:
+        """Place a PROCESS-LOCAL batch as this process's slice of the
+        global batch — the scalable multi-host feed: each process loads
+        only its own 1/process_count of the data (e.g.
+        ``RecordFileDataset(shard=(process_index, process_count))``)
+        instead of materializing the identical global batch everywhere,
+        and the slices concatenate along dim 0 in process order. The
+        result is mesh-placed, so ``run``/``remap_feed`` pass it through
+        untouched. Single-process jobs: identical to ``remap_feed``."""
+        if jax.process_count() == 1:
+            return self.remap_feed(local_batch)
+
+        def place(leaf):
+            arr = np.asarray(leaf)
+            if arr.ndim == 0:
+                # scalars are replicated; every process must provide the
+                # same value (cannot be a per-process slice)
+                return self._place(arr, P())
+            if arr.shape[0] % (self.num_replicas // jax.process_count()):
+                raise ValueError(
+                    "local batch dim %d is not divisible by this process's "
+                    "%d replicas" % (arr.shape[0],
+                                     self.num_replicas // jax.process_count()))
+            if self.seq_axis and arr.ndim >= 2:
+                spec = P(self.batch_axes, self.seq_axis)
+            else:
+                spec = P(self.batch_axes)
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, spec), arr)
+        return jax.tree_util.tree_map(place, local_batch)
 
     # ----------------------------------------------------------------- fetch
 

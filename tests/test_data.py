@@ -129,3 +129,39 @@ def test_prefetcher_depth_validation():
     # finite iterable drains cleanly
     out = list(DevicePrefetcher([1, 2, 3], lambda b: b * 10, depth=2))
     assert out == [10, 20, 30]
+
+
+def test_sharded_loader_partitions_disjointly(record_file):
+    """shard=(i, k) loaders cover disjoint strided record subsets whose
+    union is the whole file; shuffling stays within the shard; epochs
+    are deterministic per (seed, shard)."""
+    seen = {}
+    for i in (0, 1, 2):
+        ds = RecordFileDataset(record_file, batch_size=4, shuffle=True,
+                               seed=7, shard=(i, 3))
+        it = iter(ds)
+        assert ds.num_records == 8  # 24 records / 3 shards
+        ids = []
+        for _ in range(ds.batches_per_epoch):
+            ids.extend(next(it)["y"].tolist())
+        seen[i] = set(ids)
+        assert seen[i] == {r for r in range(N) if r % 3 == i}
+        ds.close()
+    assert seen[0] | seen[1] | seen[2] == set(range(N))
+    # deterministic: same (seed, shard) -> same stream
+    a = RecordFileDataset(record_file, batch_size=4, shuffle=True, seed=7,
+                          shard=(1, 3))
+    b = RecordFileDataset(record_file, batch_size=4, shuffle=True, seed=7,
+                          shard=(1, 3))
+    ia, ib = iter(a), iter(b)
+    for _ in range(4):
+        np.testing.assert_array_equal(next(ia)["y"], next(ib)["y"])
+    a.close(), b.close()
+
+
+def test_sharded_loader_rejects_bad_shard(record_file):
+    with pytest.raises(ValueError):
+        RecordFileDataset(record_file, batch_size=4, shard=(3, 3))
+    with pytest.raises(ValueError):
+        # 24/5 = 4 records in shard 4 < batch 8
+        RecordFileDataset(record_file, batch_size=8, shard=(4, 5))
