@@ -183,7 +183,14 @@ class RecordFileDataset:
             ring_slots, self.shard[0], self.shard[1])
         if not self._handle:
             raise ValueError("could not open record file %s" % path)
+        # SHARD-LOCAL record count: the records THIS loader iterates
+        # (i % count == index). Epoch accounting / sampling weights over
+        # the whole dataset must use num_records_global instead.
         self.num_records = int(_dll().adl_num_records(self._handle))
+        with open(path, "rb") as hf:
+            _, self.num_records_global, _ = _HEADER.unpack(
+                hf.read(_HEADER.size))
+        self.num_records_global = int(self.num_records_global)
         self.batches_per_epoch = int(_dll().adl_batches_per_epoch(self._handle))
         self.record_bytes = int(_dll().adl_record_bytes(self._handle))
         self._copy = copy

@@ -119,14 +119,21 @@ _COLLECTIVE_KINDS = {
 }
 
 
-def collective_comm_profile(jaxpr) -> dict:
+def collective_comm_profile(jaxpr, while_trip_count: int = 1) -> dict:
     """{mesh axis name: {cost class: payload bytes}} for the collectives
     a traced program issues — the cost-model input for MODEL-PARALLEL
     communication (Megatron psums, ring-attention ppermutes, MoE
     all_to_alls), which the per-variable strategy terms cannot see
     because these collectives live inside the user's forward. Bytes are
     the collective OUTPUT avals at trace shapes; scan bodies multiply by
-    trip count (a scanned L-layer stack issues L psums, not one)."""
+    trip count (a scanned L-layer stack issues L psums, not one).
+
+    Known limits: ``while_loop`` trip counts are statically unknowable,
+    so collectives inside a while body are counted ``while_trip_count``
+    times (default 1 — an UNDERCOUNT for iterative programs such as
+    decoding loops; pass an expected iteration count to make the
+    assumption explicit). ``cond`` branches are all summed, as if every
+    branch ran — an overcount bounded by the number of branches."""
     import numpy as np
     from autodist_tpu.kernel.common import op_info
     profile: dict = {}
@@ -141,6 +148,10 @@ def collective_comm_profile(jaxpr) -> dict:
                 inner = mult * int(eqn.params.get("length", 1) or 1)
                 for sub in subs:
                     walk(sub, inner)
+                continue
+            if name == "while":
+                for sub in subs:
+                    walk(sub, mult * max(int(while_trip_count), 1))
                 continue
             if subs:
                 for sub in subs:

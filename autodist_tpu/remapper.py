@@ -105,6 +105,13 @@ class Remapper:
         untouched. Single-process jobs: identical to ``remap_feed``."""
         if jax.process_count() == 1:
             return self.remap_feed(local_batch)
+        if self.num_replicas % jax.process_count() != 0:
+            raise ValueError(
+                "cannot feed process-local batches: the %d batch replicas "
+                "do not divide evenly over %d processes (each process must "
+                "own a whole number of replicas)"
+                % (self.num_replicas, jax.process_count()))
+        local_replicas = self.num_replicas // jax.process_count()
 
         def place(leaf):
             arr = np.asarray(leaf)
@@ -112,15 +119,7 @@ class Remapper:
                 # scalars are replicated; every process must provide the
                 # same value (cannot be a per-process slice)
                 return self._place(arr, P())
-            if arr.shape[0] % (self.num_replicas // jax.process_count()):
-                raise ValueError(
-                    "local batch dim %d is not divisible by this process's "
-                    "%d replicas" % (arr.shape[0],
-                                     self.num_replicas // jax.process_count()))
-            if self.seq_axis and arr.ndim >= 2:
-                spec = P(self.batch_axes, self.seq_axis)
-            else:
-                spec = P(self.batch_axes)
+            spec = self._leaf_spec(arr.shape, local_replicas, "local")
             return jax.make_array_from_process_local_data(
                 NamedSharding(self.mesh, spec), arr)
         return jax.tree_util.tree_map(place, local_batch)

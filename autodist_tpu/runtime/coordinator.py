@@ -39,6 +39,29 @@ def _reap_pattern(command: str) -> str:
     return "[%s]%s" % (command[0], esc[len(_ere_escape(command[0])):])
 
 
+def _reap_command(command: str, strategy_id: str) -> str:
+    """Remote kill of a stale worker incarnation, scoped to THIS job.
+
+    ``pkill -f <full command line>`` alone would match any process with
+    the same argv — two concurrent jobs launched from the same script on
+    a shared worker host would reap each other's live workers. The job's
+    identity lives in the worker's environment (``ADT_STRATEGY_ID``,
+    set at launch and inherited by children), not its argv (bash
+    exec-optimizes the env-prefixed remote command, so assignments never
+    appear in /proc cmdline). So: pgrep candidates by command line, then
+    keep only pids whose ``/proc/<pid>/environ`` carries this job's
+    strategy id. Wrapped in ``sh -c`` so the cluster's env prefix (a
+    simple-command prefix) stays legal in front of the ``for`` loop."""
+    script = (
+        "for p in $(pgrep -f %s); do "
+        "tr '\\0' '\\n' < /proc/$p/environ 2>/dev/null | grep -qxF %s "
+        "&& kill -9 $p; done; true"
+        % (shlex.quote(_reap_pattern(command)),
+           shlex.quote("%s=%s" % (const.ENV.ADT_STRATEGY_ID.name_str,
+                                  strategy_id))))
+    return "sh -c %s" % shlex.quote(script)
+
+
 class Coordinator:
     def __init__(self, strategy, cluster: Cluster,
                  heartbeat_timeout: float = None,
@@ -282,13 +305,11 @@ class Coordinator:
         only if its new holder itself called setsid — this killpg runs
         immediately after ``proc.wait()`` returned, so the window is tiny.
 
-        Remote transport: pkill the exact launched command line on the
+        Remote transport: kill the exact launched command line on the
         remote host (the reference's stale-server cleanup approach,
-        ``utils/server_starter.py:29-46``). bash exec-optimizes the
-        env-prefixed remote command, so only the command's own argv
-        survives in /proc cmdline — matching the full command string,
-        ERE-escaped with the self-match bracket trick, is the reliable
-        handle (``_reap_pattern``)."""
+        ``utils/server_starter.py:29-46``), scoped to this job's strategy
+        id via /proc environ so concurrent jobs sharing a worker host and
+        argv never reap each other (``_reap_command``)."""
         if old_proc is not None:
             try:
                 os.killpg(old_proc.pid, signal.SIGKILL)
@@ -296,8 +317,7 @@ class Coordinator:
                 pass
         if not self._cluster._is_local(address):
             self._cluster.remote_exec(
-                "pkill -f %s || true" % shlex.quote(_reap_pattern(command)),
-                address, wait=True)
+                _reap_command(command, self._strategy_id), address, wait=True)
 
     def _restart_unsound_reason(self, address: str):
         """None when every variable syncs through async host-PS owned by a

@@ -84,7 +84,7 @@ class CostModel:
                  mxu_efficiency: float = DEFAULT_MXU_EFFICIENCY,
                  flops_per_step: Optional[float] = None,
                  hbm_capacity_bytes: Optional[float] = None,
-                 calibration=None):
+                 calibration=None, while_trip_count: int = 1):
         self._item = model_item
         self._spec = resource_spec
         self._chip = chip_kind or self._guess_chip()
@@ -93,6 +93,10 @@ class CostModel:
         self._hbm_capacity = (hbm_capacity_bytes if hbm_capacity_bytes
                               is not None else CHIP_HBM_BYTES[self._chip])
         self._act_cache = None
+        # assumed iterations for while_loop bodies when profiling the
+        # loss's collectives (statically unknowable; see
+        # kernel/common/utils.py collective_comm_profile)
+        self._while_trip_count = int(while_trip_count)
         # measured-run correction of the analytic constants: a Calibration,
         # a path to a saved one, or None (uncalibrated)
         if isinstance(calibration, str):
@@ -246,15 +250,30 @@ class CostModel:
             else:
                 from autodist_tpu.kernel.common.utils import (
                     collective_comm_profile)
-                self._coll_cache = collective_comm_profile(closed.jaxpr)
+                self._coll_cache = collective_comm_profile(
+                    closed.jaxpr,
+                    while_trip_count=self._while_trip_count)
         return self._coll_cache
 
     def mp_comm_time(self, strategy: Strategy, ici_bw: float) -> float:
         """Serial model-parallel collective seconds per step, by cost
         class (see ``_COLLECTIVE_KINDS`` in kernel/common/utils.py for
         how each class's traced bytes relate to real wire at axis size
-        k). The backward issues roughly the same collectives again
-        (psum <-> psum, ppermute reversed), hence the 2x."""
+        k). The 2x prices the backward pass, and is EXACT per class
+        under the size-1 trace convention, because each collective's
+        transpose moves the same wire bytes as the forward:
+
+        - gather (traced bytes B = one shard): fwd all_gather wire
+          (k-1)B; bwd is reduce_scatter of the FULL cotangent kB, wire
+          (k-1)/k * kB = (k-1)B — equal, despite the different factors.
+        - scatter (traced B = full input): fwd wire (k-1)/k * B; bwd
+          all_gather reassembles the full B from k shards of B/k, wire
+          (k-1)/k * B — equal.
+        - reduce: the transpose of psum is free, but every Megatron-style
+          layer pairs a fwd psum with a bwd psum from its dual layer
+          (row- vs column-parallel), so 2x holds at program level.
+        - permute/alltoall: self-dual (inverted permutation / inverse
+          shuffle), identical wire."""
         mesh_shape = strategy.graph_config.mesh_shape or {}
         total = 0.0
         for axis, by_kind in self._collective_profile().items():

@@ -4,7 +4,7 @@ Analog of reference ``autodist/strategy/base.py`` and the protobuf schemas
 ``proto/strategy.proto:31-69`` / ``proto/synchronizers.proto``. The Strategy
 is the contract between the frontend (builders, pure functions of
 (ModelItem, ResourceSpec)) and the backend lowering
-(``autodist_tpu/parallel/lowering.py``): per-variable it says how to
+(``autodist_tpu/kernel/graph_transformer.py``): per-variable it says how to
 synchronize gradients (PS or AllReduce, with partitioning, staleness,
 compression, grouping), and per-graph which devices carry data-parallel
 replicas.

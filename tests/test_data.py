@@ -141,6 +141,7 @@ def test_sharded_loader_partitions_disjointly(record_file):
                                seed=7, shard=(i, 3))
         it = iter(ds)
         assert ds.num_records == 8  # 24 records / 3 shards
+        assert ds.num_records_global == N  # whole-file count, shard-invariant
         ids = []
         for _ in range(ds.batches_per_epoch):
             ids.extend(next(it)["y"].tolist())

@@ -435,3 +435,21 @@ def test_local_feed_matches_global_feed(tmp_path):
     ref = [float(step({"x": gx, "y": gy})["loss"]) for _ in range(6)]
     np.testing.assert_allclose(res[0]["losses"], ref, rtol=1e-6, atol=1e-7)
     adt.reset()
+
+
+def test_remap_feed_local_validates_replica_divisibility(monkeypatch):
+    """A replica count that does not divide over the process count must
+    raise a clear error (not ZeroDivisionError), and the local path must
+    apply the same sequence-shard validation as the global path."""
+    import jax
+    from jax.sharding import Mesh
+    from autodist_tpu.remapper import Remapper
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("data", "seq"))
+    remapper = Remapper(mesh, "data", seq_axis="seq")
+    monkeypatch.setattr(jax, "process_count", lambda: 3)
+    with pytest.raises(ValueError, match="do not divide evenly"):
+        remapper.remap_feed_local({"x": np.zeros((6, 4), np.float32)})
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    # seq dim 3 not divisible by 2 seq shards: the shared _leaf_spec check
+    with pytest.raises(ValueError, match="sequence dim"):
+        remapper.remap_feed_local({"x": np.zeros((1, 3), np.float32)})
