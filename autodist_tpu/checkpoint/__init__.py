@@ -1,6 +1,7 @@
 """Checkpoint layer (reference ``autodist/checkpoint/``)."""
 from autodist_tpu.checkpoint.saver import Saver
+from autodist_tpu.checkpoint.sharded import ShardedSaver
 from autodist_tpu.checkpoint.saved_model_builder import (SavedModelBuilder,
                                                          export_for_serving)
 
-__all__ = ["Saver", "SavedModelBuilder", "export_for_serving"]
+__all__ = ["Saver", "ShardedSaver", "SavedModelBuilder", "export_for_serving"]

@@ -47,6 +47,40 @@ def _flat_to_tree(template, flat: Dict[str, np.ndarray]):
     return variable_utils.unflatten_named(treedef, out)
 
 
+class BackgroundWriter:
+    """At most one background checkpoint write in flight. ``wait()`` joins
+    the pending write and re-raises any error it hit — a failed checkpoint
+    must never look like a success. Shared by :class:`Saver` and
+    :class:`~autodist_tpu.checkpoint.sharded.ShardedSaver`."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def submit(self, fn):
+        self.wait()  # serialize: at most one write in flight
+        self._error = None
+
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised in wait()
+                self._error = e
+
+        self._thread = threading.Thread(target=run, name=self._name,
+                                        daemon=False)
+        self._thread.start()
+
+    def wait(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+            err, self._error = self._error, None
+            if err is not None:
+                raise err
+
+
 class Saver:
     """Save/restore distributed training state in the original layout.
 
@@ -63,7 +97,7 @@ class Saver:
         self.max_to_keep = max_to_keep
         self.chief_only = chief_only
         self.async_save = async_save
-        self._writer = None
+        self._writer = BackgroundWriter("adt-ckpt-writer")
         os.makedirs(self.directory, exist_ok=True)
 
     # ------------------------------------------------------------------ save
@@ -108,29 +142,13 @@ class Saver:
         if not self.async_save:
             write()
             return path
-        self.wait()  # at most one write in flight
-
-        def write_capturing():
-            try:
-                write()
-            except BaseException as e:  # noqa: BLE001 — re-raised in wait()
-                self._writer_error = e
-
-        self._writer_error = None
-        self._writer = threading.Thread(target=write_capturing,
-                                        name="adt-ckpt-writer", daemon=False)
-        self._writer.start()
+        self._writer.submit(write)
         return path
 
     def wait(self):
         """Join a pending async write; re-raises any error the writer hit —
         a failed checkpoint must not look like a success."""
-        if self._writer is not None:
-            self._writer.join()
-            self._writer = None
-            err, self._writer_error = getattr(self, "_writer_error", None), None
-            if err is not None:
-                raise err
+        self._writer.wait()
 
     _META_RE = __import__("re").compile(r"^ckpt-(\d+)\.meta\.json$")
 

@@ -1,0 +1,668 @@
+"""Sharded checkpoint save/restore — no process ever holds the full tree.
+
+The plain :class:`~autodist_tpu.checkpoint.saver.Saver` gathers every
+variable to one host before writing (the reference's original-layout
+property) — correct, but it caps model size at one host's RAM. The
+reference avoided that for partitioned variables by saving each shard as a
+*slice* of the original tensor with ``SaveSliceInfo`` (reference
+``autodist/kernel/partitioner.py:292-347``), so no process materialized the
+full set. This module is the TPU-native equivalent:
+
+- **save**: every process writes ONE npz holding exactly the array shards
+  it owns — for each device leaf, the addressable shards with
+  ``replica_id == 0`` (the unique-writer rule: every distinct slice of a
+  sharded array has exactly one replica-0 holder across the whole mesh);
+  for host-PS variables, the store shards this process owns (all of them
+  on the chief in mirror mode, the owned groups in async serving mode).
+  Peak host memory during save = this process's shards, never the tree.
+- **commit**: a per-process index file lands next to each shard file; the
+  chief waits for all of them (file barrier — the checkpoint directory
+  must be SHARED across hosts, the same NFS assumption as the reference's
+  chief-only saving, reference ``autodist/autodist.py:40-41``) and then
+  writes the meta file. A checkpoint without its meta file is invisible.
+- **restore**: same mesh topology required; each process reads back only
+  the slices its own devices need (``Sharding.devices_indices_map``) and
+  reassembles global arrays with
+  ``jax.make_array_from_single_device_arrays`` — again never the full
+  tree. Host-PS shards reload into the store.
+- **export_full**: converts a sharded checkpoint into a plain
+  :class:`Saver`-format one (original unpadded layout, ``numpy.load``-able
+  with no framework) one LEAF at a time — the vanilla-reload property is
+  preserved as an export, exactly as VERDICT r3 prescribed.
+
+File layout for step N (all under ``directory``)::
+
+    ckpt-N.shard-p<pid>.npz         this process's shards
+    ckpt-N.shard-p<pid>.index.json  its key list (the barrier token)
+    ckpt-N.shard-meta.json          chief-written commit point
+
+npz keys: ``P|<var>|<a:b,c:d>`` (params), ``O|<leaf>|<...>`` (optimizer
+state), ``S|<leaf>|<...>`` (sync/compressor state), ``H|<var>::<si>``
+(host-PS shard value), ``Ho|<var>::<si>|<leaf>`` (host-PS shard optimizer
+leaf). Slice tokens are in the PADDED global coordinates of the stored
+array; the meta file records how to unpad.
+"""
+import json
+import os
+import threading
+import time
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from autodist_tpu import const
+from autodist_tpu.checkpoint.saver import BackgroundWriter
+from autodist_tpu.kernel.common import variable_utils
+from autodist_tpu.utils import logging
+
+_FORMAT = "autodist_tpu.sharded.v1"
+
+
+# ----------------------------------------------------------------- tokens
+
+
+def _index_token(index, shape) -> str:
+    """Stable string for a shard's slice of the global array, with slice
+    bounds made concrete (``slice(None)`` -> ``0:dim``)."""
+    if not shape:
+        return "-"
+    parts = []
+    for sl, dim in zip(index, shape):
+        start, stop, _ = sl.indices(dim)
+        parts.append("%d:%d" % (start, stop))
+    return ",".join(parts)
+
+
+def _token_slices(token: str) -> Tuple[slice, ...]:
+    if token == "-":
+        return ()
+    return tuple(slice(*map(int, p.split(":"))) for p in token.split(","))
+
+
+def _spec_to_json(spec: P) -> list:
+    out = []
+    for e in spec:
+        out.append(list(e) if isinstance(e, (tuple, list)) else e)
+    return out
+
+
+def _spec_from_json(entries: list) -> P:
+    return P(*[tuple(e) if isinstance(e, list) else e for e in entries])
+
+
+def _group_keys(meta: dict) -> Dict[str, List[str]]:
+    """meta['keys'] grouped by their first two ``|`` segments ('P|emb',
+    'Ho|emb::0', ...) — one pass, so restore/export look up each leaf's
+    keys directly instead of scanning the whole key list per leaf."""
+    out: Dict[str, List[str]] = {}
+    for key in meta["keys"]:
+        parts = key.split("|", 2)
+        out.setdefault("|".join(parts[:2]), []).append(key)
+    return out
+
+
+def _leaf_unpad(name: str, shape, layouts) -> Optional[Tuple[int, int]]:
+    """(axis, orig_dim) when the stored leaf carries partition padding the
+    original layout does not have; None otherwise. ``layouts`` maps leaf
+    names (variables, and optimizer leaves pre-resolved to their
+    variable's layout by the caller) to VarLayout."""
+    lay = layouts.get(name)
+    if lay is None:
+        return None
+    if (lay.partitioned and lay.padded_dim != lay.orig_dim
+            and len(shape) > lay.axis and shape[lay.axis] == lay.padded_dim):
+        return (lay.axis, lay.orig_dim)
+    return None
+
+
+class _StreamingNpzWriter:
+    """npz writer that streams one array at a time (zipfile + np.save), so
+    peak memory while saving is a single shard, not the whole file."""
+
+    def __init__(self, path: str):
+        self._zf = zipfile.ZipFile(path, "w", zipfile.ZIP_STORED)
+
+    def write(self, key: str, arr: np.ndarray):
+        with self._zf.open(key + ".npy", "w", force_zip64=True) as f:
+            np.save(f, np.asarray(arr))
+
+    def close(self):
+        self._zf.close()
+
+
+class ShardedSaver:
+    """Save/restore distributed state with per-process shard files.
+
+    Same call contract as :class:`Saver` — ``save()`` must run on EVERY
+    process (each writes its own file); ``restore()`` likewise. The
+    checkpoint ``directory`` must be shared across hosts (NFS/GCS —
+    the reference's chief-only-on-NFS deployment assumption).
+
+    ``async_save=True`` copies this process's shards to host synchronously
+    (the step may donate the buffers right after) but moves file writes and
+    the chief's commit wait to a background thread.
+    """
+
+    def __init__(self, directory: Optional[str] = None, max_to_keep: int = 5,
+                 async_save: bool = False, barrier_timeout: float = 300.0):
+        self.directory = directory or const.DEFAULT_CHECKPOINT_DIR
+        self.max_to_keep = max_to_keep
+        self.async_save = async_save
+        self.barrier_timeout = barrier_timeout
+        self._writer = BackgroundWriter("adt-sharded-ckpt")
+        os.makedirs(self.directory, exist_ok=True)
+
+    # ------------------------------------------------------------------ save
+
+    @staticmethod
+    def _mesh_suffix(dstep) -> str:
+        """Device-key namespace. Global mesh (one SPMD program spanning
+        processes): empty — the replica-0 rule gives each slice exactly one
+        writer. Process-LOCAL mesh (between-graph mode, e.g. async PS):
+        every process runs its own program with its own device state, so
+        each process's device keys carry ``@p<pid>`` and restore reads its
+        own."""
+        if jax.process_count() == 1:
+            return ""
+        pid = jax.process_index()
+        if all(d.process_index == pid
+               for d in np.asarray(dstep.mesh.devices).flat):
+            return "@p%d" % pid
+        return ""
+
+    def _device_tree_entries(self, kind: str, tree, collect, leaves_meta,
+                             layouts, suffix: str):
+        """Collect this process's replica-0 shards of every leaf. Replicated
+        leaves have their single replica-0 shard on exactly one device
+        globally, so exactly one process writes them."""
+        names, leaves, _ = variable_utils.flatten_named(tree)
+        for name, leaf in zip(names, leaves):
+            if not isinstance(leaf, jax.Array):
+                continue  # host-side scalar in a device tree: not ours
+            shape = tuple(leaf.shape)
+            leaves_meta["%s|%s" % (kind, name)] = {
+                "shape": list(shape),
+                "dtype": str(np.dtype(leaf.dtype)),
+                "spec": _spec_to_json(leaf.sharding.spec),
+                "unpad": _leaf_unpad(name, shape, layouts),
+            }
+            for shard in leaf.addressable_shards:
+                if shard.replica_id != 0:
+                    continue
+                key = "%s|%s|%s%s" % (kind, name,
+                                      _index_token(shard.index, shape),
+                                      suffix)
+                collect(key, shard.data)
+
+    def save(self, runner_or_step, state=None, step: Optional[int] = None
+             ) -> Optional[str]:
+        """Write this process's shard file; the chief commits the meta once
+        every process's index file has landed. Returns the checkpoint base
+        path."""
+        if hasattr(runner_or_step, "distributed_step"):  # Runner
+            dstep = runner_or_step.distributed_step
+            state = state if state is not None else runner_or_step.state
+        else:
+            dstep = runner_or_step
+        if state is None:
+            raise ValueError("no state to save")
+        if step is None:
+            step = int(jax.device_get(state.step))
+        base = os.path.join(self.directory, "ckpt-%d" % step)
+        pid = jax.process_index()
+        nproc = jax.process_count()
+        # a crash-resume can re-save the SAME step: this attempt's files
+        # must never mix with a previous attempt's. Remove our own stale
+        # index up front, and couple index<->npz with a per-process nonce
+        # the commit verifies (stale index + replaced npz can't pair up).
+        try:
+            os.remove("%s.shard-p%d.index.json" % (base, pid))
+        except FileNotFoundError:
+            pass
+        nonce = "%d-%d-%s" % (pid, os.getpid(), os.urandom(8).hex())
+
+        # ---- collect this process's entries. Sync save streams: each
+        # producer is materialized one at a time inside write() (peak = one
+        # shard). Async save must copy up front — the caller may donate the
+        # state's buffers the moment save() returns.
+        entries: List[Tuple[str, Any]] = []
+        leaves_meta: Dict[str, dict] = {}
+
+        if self.async_save:
+            def collect(key, data):
+                entries.append((key, np.asarray(data)))
+        else:
+            def collect(key, data):
+                entries.append((key, lambda d=data: np.asarray(d)))
+
+        opt_layouts = dict(dstep.layouts)
+        # optimizer leaves resolve to their variable's layout by name
+        names_o, leaves_o, _ = variable_utils.flatten_named(state.opt_state)
+        for n, l in zip(names_o, leaves_o):
+            var = variable_utils.match_state_to_var(
+                n, tuple(getattr(l, "shape", ())), dstep.model_item.var_infos,
+                dstep.layouts)
+            if var and var in dstep.layouts:
+                opt_layouts[n] = dstep.layouts[var]
+        suffix = self._mesh_suffix(dstep)
+        self._device_tree_entries("P", state.params, collect, leaves_meta,
+                                  dstep.layouts, suffix)
+        self._device_tree_entries("O", state.opt_state, collect, leaves_meta,
+                                  opt_layouts, suffix)
+        self._device_tree_entries("S", state.sync_state, collect, leaves_meta,
+                                  {}, suffix)
+
+        ps_meta: Dict[str, dict] = {}
+        store = dstep.ps_store
+        if store is not None:
+            store.drain()
+            for name, plan in sorted(store.plans.items()):
+                n_shards = len(plan.shard_ranges()) if plan.partitioned else 1
+                ps_meta[name] = {"axis": plan.axis, "nshards": n_shards}
+            for name, si in store.checkpoint_pairs(const.is_chief()):
+                def ps_group(name=name, si=si):
+                    value, opt_flat = store.shard_state(name, si)
+                    out = [("H|%s::%d" % (name, si), value)]
+                    out.extend(("Ho|%s::%d|%s" % (name, si, ln), arr)
+                               for ln, arr in opt_flat.items())
+                    return out
+                if self.async_save:
+                    for key, arr in ps_group():
+                        entries.append((key, arr))
+                else:
+                    # one shard materialized at a time, atomically snapshot
+                    # vs the async apply thread at write time
+                    entries.append(ps_group)
+
+        meta = {
+            "format": _FORMAT, "step": int(step),
+            "strategy_id": dstep.strategy.id,
+            "mesh": {"axes": list(dstep.mesh.axis_names),
+                     "shape": [int(dstep.mesh.shape[a])
+                               for a in dstep.mesh.axis_names]},
+            "process_count": nproc,
+            "leaves": leaves_meta,
+            "ps": ps_meta,
+        }
+
+        def write(barrier=None):
+            shard_path = "%s.shard-p%d.npz" % (base, pid)
+            tmp = shard_path + ".tmp"
+            w = _StreamingNpzWriter(tmp)
+            w.write("__nonce__", np.frombuffer(nonce.encode(), np.uint8))
+            written_keys: List[str] = []
+            for item in entries:
+                if callable(item):  # per-shard group producer (PS)
+                    for key, arr in item():
+                        w.write(key, arr)
+                        written_keys.append(key)
+                else:
+                    key, arr = item
+                    w.write(key, arr() if callable(arr) else arr)
+                    written_keys.append(key)
+            w.close()
+            os.replace(tmp, shard_path)
+            index_path = "%s.shard-p%d.index.json" % (base, pid)
+            tmp = index_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"pid": pid, "nonce": nonce,
+                           "keys": written_keys}, f)
+            os.replace(tmp, index_path)
+            entries.clear()  # free the host copies as soon as they're on disk
+            if barrier is not None:
+                barrier()
+            if pid == 0:
+                key_owner = self._await_indexes(base, nproc)
+                meta["keys"] = key_owner
+                tmp = base + ".shard-meta.json.tmp"
+                with open(tmp, "w") as f:
+                    json.dump(meta, f)
+                os.replace(tmp, base + ".shard-meta.json")
+                self._gc()
+                logging.info("sharded checkpoint %s committed (step %d, "
+                             "%d keys over %d processes)", base, step,
+                             len(key_owner), nproc)
+
+        if not self.async_save:
+            # sync save on a global mesh: a REAL device barrier between the
+            # per-process writes and the chief's commit — the commit can
+            # then never pair this attempt's chief file with a previous
+            # attempt's peer files (safe here on the main thread with no
+            # step in flight; the nonce check is defense in depth, and the
+            # only guard in async/between-graph modes)
+            barrier = None
+            if nproc > 1 and not suffix:
+                from jax.experimental import multihost_utils
+
+                def barrier():
+                    multihost_utils.sync_global_devices(
+                        "adt_sharded_ckpt_%d" % step)
+            write(barrier)
+            return base
+        self._writer.submit(write)
+        return base
+
+    def _await_indexes(self, base: str, nproc: int) -> Dict[str, int]:
+        """File barrier: the chief's commit waits until every process's
+        index file exists, parses, and its nonce matches the one embedded
+        in that process's npz (an index left by a crashed earlier attempt
+        at the same step cannot pair with a fresh npz, or vice versa);
+        returns the merged key->pid map."""
+        deadline = time.monotonic() + self.barrier_timeout
+        key_owner: Dict[str, int] = {}
+        pending = set(range(nproc))
+        while pending:
+            for q in sorted(pending):
+                path = "%s.shard-p%d.index.json" % (base, q)
+                try:
+                    with open(path) as f:
+                        idx = json.load(f)
+                    with np.load("%s.shard-p%d.npz" % (base, q)) as zf:
+                        npz_nonce = bytes(zf["__nonce__"]).decode()
+                except (FileNotFoundError, json.JSONDecodeError, KeyError,
+                        zipfile.BadZipFile):
+                    continue
+                if idx.get("nonce") != npz_nonce:
+                    continue  # torn pair from overlapping attempts
+                for k in idx["keys"]:
+                    prev = key_owner.setdefault(k, q)
+                    if prev != q:
+                        raise ValueError(
+                            "sharded checkpoint key %r written by both "
+                            "process %d and %d — the replica-0 writer rule "
+                            "was violated (mismatched mesh layouts between "
+                            "processes?)" % (k, prev, q))
+                pending.discard(q)
+            if pending:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "sharded checkpoint commit: processes %s never "
+                        "wrote their index files under %s within %.0fs — "
+                        "is the checkpoint directory shared across hosts?"
+                        % (sorted(pending), self.directory,
+                           self.barrier_timeout))
+                time.sleep(0.05)
+        return key_owner
+
+    def wait(self):
+        """Join a pending async write; re-raises any writer error."""
+        self._writer.wait()
+
+    # ------------------------------------------------------------- discovery
+
+    _META_RE = __import__("re").compile(r"^ckpt-(\d+)\.shard-meta\.json$")
+
+    def _own_metas(self):
+        out = []
+        for f in os.listdir(self.directory):
+            m = self._META_RE.match(f)
+            if m:
+                out.append((int(m.group(1)), f))
+        return sorted(out)
+
+    def _gc(self):
+        metas = self._own_metas()
+        while len(metas) > self.max_to_keep:
+            step, fname = metas.pop(0)
+            base = "ckpt-%d" % step
+            for f in os.listdir(self.directory):
+                if f == fname or (f.startswith(base + ".shard-p")):
+                    try:
+                        os.remove(os.path.join(self.directory, f))
+                    except FileNotFoundError:
+                        pass
+
+    def latest(self) -> Optional[str]:
+        self.wait()
+        metas = self._own_metas()
+        if not metas:
+            return None
+        return os.path.join(self.directory,
+                            metas[-1][1].replace(".shard-meta.json", ""))
+
+    # --------------------------------------------------------------- restore
+
+    class _ShardReader:
+        """Lazy per-process npz handles + key->pid routing."""
+
+        def __init__(self, base: str, meta: dict):
+            self._base = base
+            self._keys = meta["keys"]
+            self._files: Dict[int, Any] = {}
+
+        def __call__(self, key: str) -> np.ndarray:
+            pid = self._keys.get(key)
+            if pid is None:
+                raise KeyError("checkpoint is missing key %r" % key)
+            zf = self._files.get(pid)
+            if zf is None:
+                zf = np.load("%s.shard-p%d.npz" % (self._base, pid))
+                self._files[pid] = zf
+            return zf[key]
+
+        def close(self):
+            for zf in self._files.values():
+                zf.close()
+
+    def _read_meta(self, path: str) -> dict:
+        with open(path + ".shard-meta.json") as f:
+            meta = json.load(f)
+        if meta.get("format") != _FORMAT:
+            raise ValueError("not a sharded checkpoint: %s" % path)
+        return meta
+
+    def _check_topology(self, meta: dict, dstep):
+        want_axes = list(dstep.mesh.axis_names)
+        want_shape = [int(dstep.mesh.shape[a]) for a in want_axes]
+        have = meta["mesh"]
+        if (have["axes"] != want_axes or have["shape"] != want_shape
+                or meta["process_count"] != jax.process_count()):
+            raise ValueError(
+                "sharded restore needs the SAME topology it was saved on "
+                "(saved: mesh %s=%s over %d processes; running: %s=%s over "
+                "%d). Convert with ShardedSaver.export_full() and restore "
+                "through Saver instead."
+                % (have["axes"], have["shape"], meta["process_count"],
+                   want_axes, want_shape, jax.process_count()))
+
+    def _restore_device_tree(self, kind: str, template, meta, reader, mesh,
+                             suffix: str):
+        """Rebuild one device tree: every leaf assembled from exactly the
+        slices this process's devices need."""
+        names, leaves, treedef = variable_utils.flatten_named(template)
+        out = []
+        for name, _tmpl in zip(names, leaves):
+            lm = meta["leaves"].get("%s|%s" % (kind, name))
+            if lm is None:
+                raise KeyError(
+                    "checkpoint has no %s leaf %r — was it saved under a "
+                    "different strategy?" % (kind, name))
+            shape = tuple(lm["shape"])
+            dtype = np.dtype(lm["dtype"])
+            sharding = NamedSharding(mesh, _spec_from_json(lm["spec"]))
+            imap = sharding.devices_indices_map(shape)
+            bufs, seen = [], {}
+            for d in sharding.addressable_devices:
+                token = _index_token(imap[d], shape)
+                data = seen.get(token)
+                if data is None:
+                    data = np.asarray(
+                        reader("%s|%s|%s%s" % (kind, name, token, suffix)),
+                        dtype=dtype)
+                    seen[token] = data
+                bufs.append(jax.device_put(data, d))
+            out.append(jax.make_array_from_single_device_arrays(
+                shape, sharding, bufs))
+        return variable_utils.unflatten_named(treedef, out)
+
+    def restore(self, runner, path: Optional[str] = None) -> Tuple[Any, int]:
+        """Restore a Runner's state reading only this process's slices.
+        Returns (state, step)."""
+        self.wait()
+        path = path or self.latest()
+        if path is None:
+            raise FileNotFoundError("no sharded checkpoint in %s"
+                                    % self.directory)
+        dstep = runner.distributed_step
+        meta = self._read_meta(path)
+        self._check_topology(meta, dstep)
+        if meta.get("strategy_id") != dstep.strategy.id:
+            logging.warning(
+                "sharded checkpoint %s was saved under strategy %s, "
+                "restoring under %s — layouts must match or this will fail",
+                path, meta.get("strategy_id"), dstep.strategy.id)
+        reader = self._ShardReader(path, meta)
+        suffix = self._mesh_suffix(dstep)
+        try:
+            item = dstep.model_item
+            holed = dstep._holed_template
+            params = self._restore_device_tree("P", holed, meta, reader,
+                                               dstep.mesh, suffix)
+            opt_template = jax.eval_shape(item.optimizer.init, holed)
+            opt_state = self._restore_device_tree("O", opt_template, meta,
+                                                  reader, dstep.mesh, suffix)
+            sync_template = dstep._sync_state_init()
+            sync_state = self._restore_device_tree("S", sync_template, meta,
+                                                   reader, dstep.mesh, suffix)
+            store = dstep.ps_store
+            if store is not None:
+                groups = _group_keys(meta)
+
+                def provider(name, si):
+                    value = np.asarray(reader("H|%s::%d" % (name, si)))
+                    prefix = "Ho|%s::%d|" % (name, si)
+                    opt_flat = {k[len(prefix):]: np.asarray(reader(k))
+                                for k in groups.get(prefix[:-1], [])}
+                    return value, opt_flat
+                store.load_shard_states(provider)
+        finally:
+            reader.close()
+        step = int(meta["step"])
+        from autodist_tpu.train_state import TrainState
+        state = TrainState(
+            step=dstep._put(np.asarray(step, np.int32), P()),
+            params=params, opt_state=opt_state, sync_state=sync_state)
+        runner.state = state
+        logging.info("restored sharded checkpoint %s (step %d, local slices "
+                     "only)", path, step)
+        return state, step
+
+    # ---------------------------------------------------------------- export
+
+    def export_full(self, path: Optional[str] = None,
+                    out_dir: Optional[str] = None) -> str:
+        """Convert a sharded checkpoint into a plain :class:`Saver`-format
+        one (original unpadded layout — the vanilla ``numpy.load`` reload
+        property), assembling ONE leaf at a time. Any single process can
+        run it (typically the chief, offline). Returns the exported base
+        path."""
+        self.wait()
+        path = path or self.latest()
+        if path is None:
+            raise FileNotFoundError("no sharded checkpoint in %s"
+                                    % self.directory)
+        meta = self._read_meta(path)
+        out_dir = out_dir or self.directory
+        os.makedirs(out_dir, exist_ok=True)
+        base = os.path.join(out_dir, "ckpt-%d" % meta["step"])
+        reader = self._ShardReader(path, meta)
+        try:
+            by_kind: Dict[str, List[str]] = {"P": [], "O": [], "S": []}
+            for lkey in meta["leaves"]:
+                kind, name = lkey.split("|", 1)
+                by_kind[kind].append(name)
+            groups = _group_keys(meta)
+            ps_values, ps_opt = self._assemble_ps_full(meta, reader, groups)
+
+            def write_kind(kind: str, out_path: str, extra: Dict[str, Any]):
+                w = _StreamingNpzWriter(out_path + ".tmp")
+                written = set()
+                for name in sorted(by_kind[kind]):
+                    w.write(name, self._assemble_leaf(kind, name, meta,
+                                                      reader, groups))
+                    written.add(name)
+                for name in sorted(extra):
+                    # shared leaves (optimizer step counts) can exist in both
+                    # the device tree and a PS little-tree; one copy wins
+                    if name not in written:
+                        w.write(name, extra[name])
+                w.close()
+                os.replace(out_path + ".tmp", out_path)
+
+            write_kind("P", base + ".params.npz", ps_values)
+            write_kind("O", base + ".opt.npz", ps_opt)
+            if by_kind["S"]:
+                write_kind("S", base + ".sync.npz", {})
+            with open(base + ".meta.json.tmp", "w") as f:
+                json.dump({"step": meta["step"], "format": "autodist_tpu.v1",
+                           "strategy_id": meta.get("strategy_id")}, f)
+            os.replace(base + ".meta.json.tmp", base + ".meta.json")
+        finally:
+            reader.close()
+        logging.info("exported sharded checkpoint %s -> full layout %s",
+                     path, base)
+        return base
+
+    def _assemble_leaf(self, kind: str, name: str, meta, reader,
+                       groups: Dict[str, List[str]]) -> np.ndarray:
+        """One leaf reassembled from its slices and unpadded."""
+        lm = meta["leaves"]["%s|%s" % (kind, name)]
+        shape = tuple(lm["shape"])
+        dtype = np.dtype(lm["dtype"])
+        prefix = "%s|%s|" % (kind, name)
+        full = np.zeros(shape, dtype)
+        if not shape:
+            try:
+                return np.asarray(reader(prefix + "-"), dtype=dtype)
+            except KeyError:
+                # process-local-mesh checkpoint: export the chief's view
+                return np.asarray(reader(prefix + "-@p0"), dtype=dtype)
+        for key in groups.get(prefix[:-1], []):
+            token = key[len(prefix):]
+            token, _, pnum = token.partition("@")
+            if pnum not in ("", "p0"):
+                continue  # local-mesh checkpoints export the chief's view
+            full[_token_slices(token)] = reader(key)
+        unpad = lm.get("unpad")
+        if unpad:
+            axis, orig = unpad
+            sl = [slice(None)] * len(shape)
+            sl[axis] = slice(0, orig)
+            full = full[tuple(sl)]
+        return full
+
+    def _assemble_ps_full(self, meta, reader, groups: Dict[str, List[str]]):
+        """Host-PS values + optimizer leaves in full original layout
+        (mirrors PSStore.full_values / full_opt_leaf naming: little-tree
+        leaf ``0/mu/v`` becomes full leaf ``0/mu/<var>``)."""
+        ps_values: Dict[str, np.ndarray] = {}
+        ps_opt: Dict[str, np.ndarray] = {}
+        for name, pm in meta.get("ps", {}).items():
+            axis, n_shards = int(pm["axis"]), int(pm["nshards"])
+            shards = [np.asarray(reader("H|%s::%d" % (name, si)))
+                      for si in range(n_shards)]
+            ps_values[name] = (shards[0] if n_shards == 1
+                               else np.concatenate(shards, axis=axis))
+            # per-slot: var-shaped leaves concatenate; others copy shard 0
+            slot_leaves: Dict[str, List[np.ndarray]] = {}
+            for si in range(n_shards):
+                prefix = "Ho|%s::%d|" % (name, si)
+                for key in groups.get(prefix[:-1], []):
+                    slot_leaves.setdefault(key[len(prefix):], []).append(
+                        np.asarray(reader(key)))
+            for ln, pieces in slot_leaves.items():
+                if ln.endswith("/v") or ln == "v":
+                    full_name = ((ln[:-2] + "/" + name) if ln.endswith("/v")
+                                 else name)
+                    if (len(pieces) > 1 and pieces[0].ndim > axis
+                            and sum(p.shape[axis] for p in pieces)
+                            == ps_values[name].shape[axis]):
+                        ps_opt[full_name] = np.concatenate(pieces, axis=axis)
+                    else:
+                        ps_opt[full_name] = pieces[0]
+                else:
+                    ps_opt.setdefault(ln, pieces[0])
+        return ps_values, ps_opt

@@ -732,6 +732,93 @@ class PSStore:
                 shard_vals.setdefault(name, {})[int(si)] = arr
         return self._assemble(shard_vals)
 
+    def checkpoint_pairs(self, is_chief: bool) -> List[Tuple[str, int]]:
+        """(var, shard) pairs THIS process writes in a sharded checkpoint.
+        Serving (async) mode: the shards this process owns — its local
+        state is the authoritative copy for exactly those. Mirror (sync)
+        mode: every process holds identical state, so the chief writes all
+        of them and everyone else none."""
+        if self._serve_groups is not None:
+            out: List[Tuple[str, int]] = []
+            for grp in self._serve_groups.values():
+                if grp["owned"]:
+                    out.extend(grp["pairs"])
+            return sorted(out)
+        if not is_chief:
+            return []
+        out = []
+        for name, plan in sorted(self.plans.items()):
+            n = len(plan.shard_ranges()) if plan.partitioned else 1
+            out.extend((name, si) for si in range(n))
+        return out
+
+    def shard_state(self, name: str, si: int
+                    ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """(value, flattened opt-state leaves) of one shard — an atomic
+        snapshot vs the async apply thread."""
+        from autodist_tpu.kernel.common import variable_utils
+        with self._lock:
+            value = np.asarray(self._values[name][si])
+            names, leaves, _ = variable_utils.flatten_named(
+                self._opt[name][si])
+            opt_flat = {n: np.asarray(l) for n, l in zip(names, leaves)}
+        return value, opt_flat
+
+    def load_shard_states(self, provider) -> None:
+        """Reload every shard from ``provider(name, si) -> (value,
+        opt_flat)`` — the sharded-checkpoint restore. All shards load in
+        every process (owned ones authoritative; the rest seed the mirror
+        that pre-publish pulls fall back to). Unknown opt leaves keep the
+        fresh init with a warning, matching :meth:`load_opt_from_full`.
+
+        In serving mode the owner apply loops are PAUSED across the swap:
+        an apply interleaved with the reload would mutate a mix of
+        restored and pre-restore shards. Gradients queued meanwhile stay
+        queued and land after resume — stale-but-legal async grads."""
+        from autodist_tpu.kernel.common import variable_utils
+        workers = []
+        if self._serve_groups is not None:
+            workers = [g["worker"] for g in self._serve_groups.values()
+                       if g["worker"] is not None]
+        for w in workers:
+            w.pause()
+        try:
+            with jax.default_device(self._cpu):
+                for name, plan in sorted(self.plans.items()):
+                    n = len(plan.shard_ranges()) if plan.partitioned else 1
+                    new_vals, new_opts = [], []
+                    for si in range(n):
+                        value, opt_flat = provider(name, si)
+                        value = np.asarray(value)
+                        template = self._optimizer.init(
+                            {"v": jnp.asarray(value)})
+                        t_names, t_leaves, t_def = (
+                            variable_utils.flatten_named(template))
+                        out = []
+                        for tn, tl in zip(t_names, t_leaves):
+                            src = opt_flat.get(tn)
+                            if src is None:
+                                logging.warning(
+                                    "PS sharded restore: opt leaf %r for "
+                                    "%s[%d] not in checkpoint; keeping "
+                                    "fresh init", tn, name, si)
+                                out.append(tl)
+                            else:
+                                out.append(jnp.asarray(np.asarray(src)))
+                        new_vals.append(value)
+                        new_opts.append(
+                            variable_utils.unflatten_named(t_def, out))
+                    with self._lock:
+                        self._values[name] = new_vals
+                        self._opt[name] = new_opts
+            # republish so peers' first post-restore pull sees the restored
+            # values instead of the owner's pre-restore published blob
+            for w in workers:
+                w.publish_now()
+        finally:
+            for w in workers:
+                w.resume()
+
     def full_opt_leaf(self, slot_path: str, var_name: str):
         """Reconstruct one optimizer-state subtree in the var's full layout
         (for original-layout checkpoints): concat var-sliced leaves across

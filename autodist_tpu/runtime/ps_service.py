@@ -200,6 +200,7 @@ class AsyncPSWorker:
         self._service = service
         self._poll_s = poll_s
         self._stop = threading.Event()
+        self._pause = threading.Event()
         self._applied = 0
         self._busy = False  # a blob is popped but not yet applied
         self._thread = threading.Thread(target=self._loop,
@@ -213,6 +214,9 @@ class AsyncPSWorker:
 
     def _loop(self):
         while not self._stop.is_set():
+            if self._pause.is_set():
+                time.sleep(self._poll_s)
+                continue
             # busy is raised BEFORE the pop: a drain() racing the pop must
             # never observe (queue empty, not busy) while a blob is in hand
             self._busy = True
@@ -234,6 +238,26 @@ class AsyncPSWorker:
     @property
     def applied(self) -> int:
         return self._applied
+
+    def publish_now(self):
+        """Republish current values out of band (checkpoint restore) —
+        fetch takes the latest publish (pure overwrite), so this replaces
+        any pre-restore blob without disturbing the applied count."""
+        self._service.publish(self._applied, pack_arrays(self._values_fn()))
+
+    def pause(self, timeout: float = 30.0):
+        """Hold the apply loop and wait out any in-flight apply — state
+        swaps (checkpoint restore) must not interleave with an apply.
+        Queued blobs stay queued and apply after resume()."""
+        self._pause.set()
+        deadline = time.monotonic() + timeout
+        while self._busy:
+            if time.monotonic() > deadline:
+                raise TimeoutError("async PS apply did not quiesce")
+            time.sleep(self._poll_s)
+
+    def resume(self):
+        self._pause.clear()
 
     def drain(self, timeout: float = 30.0) -> int:
         """Block until the queue is empty and applied (tests/checkpoints)."""

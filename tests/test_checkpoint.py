@@ -190,3 +190,208 @@ def test_async_save_equivalent_and_overlapping(tmp_path):
     async_saver.wait()
     steps = [s for s, _ in async_saver._own_metas()]
     assert 100 in steps and 101 in steps
+
+
+# ---------------------------------------------------------------- sharded
+
+
+def _shard_files(d):
+    import os
+    return sorted(f for f in os.listdir(d) if ".shard-p" in f and
+                  f.endswith(".npz"))
+
+
+def test_sharded_roundtrip_bitexact(tmp_path):
+    """Sharded save at step 3 -> restore -> retrain == uninterrupted run,
+    with per-slice keys (not whole tensors) in the shard file."""
+    from autodist_tpu.checkpoint import ShardedSaver
+    params, loss_fn, batch = _problem()
+    opt = optax.adam(0.05)
+    ad = autodist_tpu.AutoDist(strategy_builder=S.PartitionedAR())
+    runner = ad.build(loss_fn, opt, params, batch)
+    runner.init(params)
+    for _ in range(3):
+        runner.run(batch)
+    saver = ShardedSaver(directory=str(tmp_path))
+    base = saver.save(runner)
+    assert base is not None
+    # the partitioned var is stored as per-device slices
+    flat = np.load(base + ".shard-p0.npz")
+    emb_keys = [k for k in flat.files if k.startswith("P|emb|")]
+    assert len(emb_keys) == 8  # one slice per device of the 8-way mesh
+    got = {k: flat[k].shape for k in emb_keys}
+    assert all(s[0] == 2 for s in got.values()), got  # 16/8 rows each
+
+    for _ in range(2):
+        runner.run(batch)
+    final_a = runner.gather_params()
+
+    state, step = saver.restore(runner)
+    assert step == 3
+    for _ in range(2):
+        runner.run(batch)
+    final_b = runner.gather_params()
+    for k in final_a:
+        np.testing.assert_array_equal(np.asarray(final_a[k]),
+                                      np.asarray(final_b[k]))
+
+
+def test_sharded_host_ps_roundtrip(tmp_path):
+    """Host-resident PS vars (values + per-shard optimizer state) ride the
+    sharded format and resume bit-exact."""
+    from autodist_tpu.checkpoint import ShardedSaver
+    params, loss_fn, batch = _problem()
+    opt = optax.adam(0.05)
+    ad = autodist_tpu.AutoDist(strategy_builder=S.PartitionedPS())
+    runner = ad.build(loss_fn, opt, params, batch)
+    runner.init(params)
+    assert runner.distributed_step.ps_store is not None
+    for _ in range(3):
+        runner.run(batch)
+    saver = ShardedSaver(directory=str(tmp_path))
+    base = saver.save(runner)
+    flat = np.load(base + ".shard-p0.npz")
+    assert any(k.startswith("H|") for k in flat.files)
+    assert any(k.startswith("Ho|") for k in flat.files)
+
+    for _ in range(2):
+        runner.run(batch)
+    final_a = runner.gather_params()
+
+    saver.restore(runner)
+    for _ in range(2):
+        runner.run(batch)
+    final_b = runner.gather_params()
+    for k in final_a:
+        np.testing.assert_array_equal(np.asarray(final_a[k]),
+                                      np.asarray(final_b[k]))
+
+
+def test_sharded_export_matches_plain_saver(tmp_path):
+    """export_full() produces a byte-identical Saver-format checkpoint —
+    the vanilla-reload property survives as an export."""
+    from autodist_tpu.checkpoint import ShardedSaver
+    params, loss_fn, batch = _problem()
+    opt = optax.adam(0.05)
+    ad = autodist_tpu.AutoDist(
+        strategy_builder=S.AllReduce(compressor="HorovodCompressorEF"))
+    runner = ad.build(loss_fn, opt, params, batch)
+    runner.init(params)
+    for _ in range(3):
+        runner.run(batch)
+    plain = Saver(directory=str(tmp_path / "plain"))
+    ppath = plain.save(runner)
+    sharded = ShardedSaver(directory=str(tmp_path / "sharded"))
+    sharded.save(runner)
+    epath = sharded.export_full(out_dir=str(tmp_path / "export"))
+
+    for suffix in (".params.npz", ".opt.npz", ".sync.npz"):
+        a = dict(np.load(ppath + suffix))
+        b = dict(np.load(epath + suffix))
+        assert sorted(a) == sorted(b), (suffix, sorted(a), sorted(b))
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k], err_msg="%s %s"
+                                          % (suffix, k))
+
+    # the exported checkpoint restores through the plain Saver
+    restorer = Saver(directory=str(tmp_path / "export"))
+    state, step = restorer.restore(runner)
+    assert step == 3
+
+
+def test_sharded_export_ps_matches_plain_saver(tmp_path):
+    """Same export equivalence for the host-PS (partitioned, no-proxy)
+    path: values from store shards, optimizer slots reassembled."""
+    from autodist_tpu.checkpoint import ShardedSaver
+    params, loss_fn, batch = _problem()
+    opt = optax.adam(0.05)
+    ad = autodist_tpu.AutoDist(strategy_builder=S.PartitionedPS())
+    runner = ad.build(loss_fn, opt, params, batch)
+    runner.init(params)
+    for _ in range(3):
+        runner.run(batch)
+    plain = Saver(directory=str(tmp_path / "plain"))
+    ppath = plain.save(runner)
+    sharded = ShardedSaver(directory=str(tmp_path / "sharded"))
+    sharded.save(runner)
+    epath = sharded.export_full(out_dir=str(tmp_path / "export"))
+    for suffix in (".params.npz", ".opt.npz"):
+        a = dict(np.load(ppath + suffix))
+        b = dict(np.load(epath + suffix))
+        assert sorted(a) == sorted(b), (suffix, sorted(a), sorted(b))
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k], err_msg="%s %s"
+                                          % (suffix, k))
+
+
+def test_sharded_max_to_keep_and_async(tmp_path):
+    from autodist_tpu.checkpoint import ShardedSaver
+    params, loss_fn, batch = _problem()
+    ad = autodist_tpu.AutoDist(strategy_builder=S.PartitionedAR())
+    runner = ad.build(loss_fn, optax.sgd(0.01), params, batch)
+    runner.init(params)
+    saver = ShardedSaver(directory=str(tmp_path), max_to_keep=2,
+                         async_save=True)
+    for _ in range(4):
+        runner.run(batch)
+        saver.save(runner)
+    saver.wait()
+    import os
+    metas = [f for f in os.listdir(tmp_path) if f.endswith("shard-meta.json")]
+    assert len(metas) == 2
+    assert saver.latest().endswith("ckpt-4")
+    # evicted steps' shard files are gone too
+    assert not any(f.startswith("ckpt-1.") or f.startswith("ckpt-2.")
+                   for f in os.listdir(tmp_path))
+    state, step = saver.restore(runner)
+    assert step == 4
+
+
+def test_sharded_topology_mismatch_raises(tmp_path):
+    from autodist_tpu.checkpoint import ShardedSaver
+    params, loss_fn, batch = _problem()
+    ad = autodist_tpu.AutoDist(strategy_builder=S.PartitionedAR())
+    runner = ad.build(loss_fn, optax.sgd(0.01), params, batch)
+    runner.init(params)
+    runner.run(batch)
+    saver = ShardedSaver(directory=str(tmp_path))
+    base = saver.save(runner)
+    # forge a meta claiming a different topology
+    import json
+    meta = json.load(open(base + ".shard-meta.json"))
+    meta["mesh"]["shape"] = [4]
+    json.dump(meta, open(base + ".shard-meta.json", "w"))
+    with pytest.raises(ValueError, match="SAME topology"):
+        saver.restore(runner)
+
+
+def test_sharded_commit_rejects_stale_index(tmp_path):
+    """A crashed earlier attempt's index file (nonce not matching the
+    npz) must never satisfy the commit barrier — the chief times out
+    instead of committing a torn checkpoint."""
+    import json
+    from autodist_tpu.checkpoint.sharded import (ShardedSaver,
+                                                 _StreamingNpzWriter)
+    base = str(tmp_path / "ckpt-7")
+    # fresh npz with nonce A ...
+    w = _StreamingNpzWriter(base + ".shard-p1.npz")
+    w.write("__nonce__", np.frombuffer(b"nonce-A", np.uint8))
+    w.write("P|w|0:4,0:2", np.zeros((4, 2), np.float32))
+    w.close()
+    # ... but a stale index with nonce B (earlier attempt, pre-crash)
+    with open(base + ".shard-p1.index.json", "w") as f:
+        json.dump({"pid": 1, "nonce": "nonce-B",
+                   "keys": ["P|w|0:4,0:2"]}, f)
+    saver = ShardedSaver(directory=str(tmp_path), barrier_timeout=0.5)
+    with pytest.raises(TimeoutError, match="never wrote their index"):
+        saver._await_indexes(base, 2)
+    # repair the index with the matching nonce: commit proceeds
+    with open(base + ".shard-p1.index.json", "w") as f:
+        json.dump({"pid": 1, "nonce": "nonce-A",
+                   "keys": ["P|w|0:4,0:2"]}, f)
+    with open(base + ".shard-p0.index.json", "w") as f:
+        json.dump({"pid": 0, "nonce": "nonce-C", "keys": []}, f)
+    w = _StreamingNpzWriter(base + ".shard-p0.npz")
+    w.write("__nonce__", np.frombuffer(b"nonce-C", np.uint8))
+    w.close()
+    assert saver._await_indexes(base, 2) == {"P|w|0:4,0:2": 1}

@@ -453,3 +453,116 @@ def test_remap_feed_local_validates_replica_divisibility(monkeypatch):
     # seq dim 3 not divisible by 2 seq shards: the shared _leaf_spec check
     with pytest.raises(ValueError, match="sequence dim"):
         remapper.remap_feed_local({"x": np.zeros((1, 3), np.float32)})
+
+
+# ---------------------------------------------------------- sharded ckpt
+
+SHARDED_DRIVER = os.path.join(HERE, "sharded_driver.py")
+
+
+def _launch_sharded_pair(tmp_path, builder, phase, ckpt_dir):
+    spec = tmp_path / "spec.yml"
+    spec.write_text(SPEC_YAML)
+    port = _free_port()
+    strategy_id = "sharded-%s-%s-%d" % (builder, phase, os.getpid())
+    outs, procs = [], []
+    for pid in range(2):
+        out = tmp_path / ("sh-%s-%d.json" % (phase, pid))
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.update({
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "ADT_COORDINATOR_ADDR": "127.0.0.1:%d" % port,
+            "ADT_NUM_PROCESSES": "2",
+            "ADT_PROCESS_ID": str(pid),
+            "ADT_STRATEGY_ID": strategy_id,
+            "ADT_DEBUG_REMOTE": "1",
+            "ADT_EXTERNAL_LAUNCH": "1",
+            "PYTHONPATH": os.pathsep.join(
+                [os.path.dirname(HERE)] +
+                ([os.environ["PYTHONPATH"]]
+                 if os.environ.get("PYTHONPATH") else [])),
+        })
+        if pid == 1:
+            env["ADT_WORKER"] = "localhost"
+        procs.append(subprocess.Popen(
+            [sys.executable, SHARDED_DRIVER, str(spec), str(out), builder,
+             phase, str(ckpt_dir)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+        outs.append(out)
+    logs = [p.communicate(timeout=240)[0] for p in procs]
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, "process failed:\n%s" % log
+    return [json.loads(o.read_text()) for o in outs]
+
+
+@pytest.mark.parametrize("builder", ["PartitionedAR", "PartitionedPS"])
+def test_two_process_sharded_checkpoint_resume_bitexact(tmp_path, builder):
+    """The VERDICT-r3 acceptance: a partitioned (+ host-PS) model saves a
+    sharded checkpoint across 2 processes — each process writing only its
+    own shards — then FRESH processes restore reading only local slices
+    and continue bit-exactly; peak host allocation during save/restore
+    stays far below the full tree's bytes (the plain Saver gathers it
+    all)."""
+    ckpt = tmp_path / "ckpt"
+    run0, run1 = _launch_sharded_pair(tmp_path, builder, "run", ckpt)
+
+    # both processes wrote a shard file with disjoint keys (__nonce__ is
+    # per-file commit bookkeeping, present in every file)
+    files = sorted(f for f in os.listdir(ckpt) if f.endswith(".npz"))
+    assert len(files) == 2, files
+    keys = [set(np.load(str(ckpt / f)).files) - {"__nonce__"} for f in files]
+    assert not (keys[0] & keys[1]), keys[0] & keys[1]
+    if builder == "PartitionedAR":
+        # the partitioned device var's slices are split between the files
+        assert {k for k in keys[0] if k.startswith("P|emb|")}
+        assert {k for k in keys[1] if k.startswith("P|emb|")}
+    else:
+        # mirror-mode host-PS: every process holds identical store state,
+        # so the chief writes all H| shards and the worker none — an empty
+        # worker file is the correct division of labor here
+        assert {k for k in keys[0] if k.startswith("H|emb")}
+        assert not keys[1]
+
+    # no process's save peak came near the full tree
+    for r in (run0, run1):
+        assert r["peak_bytes"] < 0.6 * r["full_bytes"], \
+            (r["peak_bytes"], r["full_bytes"])
+
+    res0, res1 = _launch_sharded_pair(tmp_path, builder, "resume", ckpt)
+    np.testing.assert_array_equal(res0["losses"], res1["losses"])
+    # resumed steps 4..5 equal the uninterrupted run's steps 4..5
+    np.testing.assert_array_equal(run0["losses"][3:], res0["losses"])
+    for k in run0["params"]:
+        np.testing.assert_array_equal(run0["params"][k], res0["params"][k])
+    if builder == "PartitionedAR":
+        # device-partitioned restore reads only local slices. (Mirror-mode
+        # host-PS restore legitimately materializes the full PS store —
+        # that IS its live working set on every process.)
+        for r in (res0, res1):
+            assert r["peak_bytes"] < 0.6 * r["full_bytes"], \
+                (r["peak_bytes"], r["full_bytes"])
+
+
+def test_two_process_sharded_async_ownership(tmp_path):
+    """Async per-shard-ownership PS: each process's sharded checkpoint file
+    carries exactly the H| shards it OWNS (disjoint, complete union), and
+    fresh processes restore and keep training."""
+    with _coordination_service():
+        ckpt = tmp_path / "ckpt"
+        _launch_sharded_pair(tmp_path, "PSAsyncPart", "run", ckpt)
+        files = sorted(f for f in os.listdir(ckpt) if f.endswith(".npz"))
+        assert len(files) == 2
+        hkeys = [set(k for k in np.load(str(ckpt / f)).files
+                     if k.startswith("H|")) for f in files]
+        assert hkeys[0] and hkeys[1], hkeys  # both processes own shards
+        assert not (hkeys[0] & hkeys[1])
+        union = {k.split("|", 1)[1] for k in hkeys[0] | hkeys[1]}
+        # every (var, shard) present exactly once
+        assert any(k.endswith("::0") for k in union)
+    with _coordination_service():
+        res0, res1 = _launch_sharded_pair(tmp_path, "PSAsyncPart", "resume",
+                                          ckpt)
+        for r in (res0, res1):
+            assert all(np.isfinite(r["losses"])), r["losses"]
