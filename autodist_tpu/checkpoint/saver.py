@@ -47,6 +47,23 @@ def _flat_to_tree(template, flat: Dict[str, np.ndarray]):
     return variable_utils.unflatten_named(treedef, out)
 
 
+import re as _re
+
+
+def scan_checkpoint_metas(directory: str, pattern) -> list:
+    """Sorted (step, filename) pairs for meta files matching ``pattern``
+    (a compiled regex whose group 1 is the step). Foreign files in a
+    shared directory are ignored, not crashed on. Shared by
+    :class:`Saver` and :class:`ShardedSaver` so retention/discovery
+    semantics cannot drift apart."""
+    out = []
+    for f in os.listdir(directory):
+        m = pattern.match(f)
+        if m:
+            out.append((int(m.group(1)), f))
+    return sorted(out)
+
+
 class BackgroundWriter:
     """At most one background checkpoint write in flight. ``wait()`` joins
     the pending write and re-raises any error it hit — a failed checkpoint
@@ -150,17 +167,10 @@ class Saver:
         a failed checkpoint must not look like a success."""
         self._writer.wait()
 
-    _META_RE = __import__("re").compile(r"^ckpt-(\d+)\.meta\.json$")
+    _META_RE = _re.compile(r"^ckpt-(\d+)\.meta\.json$")
 
     def _own_metas(self):
-        """(step, filename) for files this saver wrote; foreign files in a
-        shared directory are ignored, not crashed on."""
-        out = []
-        for f in os.listdir(self.directory):
-            m = self._META_RE.match(f)
-            if m:
-                out.append((int(m.group(1)), f))
-        return sorted(out)
+        return scan_checkpoint_metas(self.directory, self._META_RE)
 
     def _gc(self):
         metas = self._own_metas()

@@ -258,6 +258,7 @@ class ShardedSaver:
         ps_meta: Dict[str, dict] = {}
         store = dstep.ps_store
         if store is not None:
+            dstep.flush_ps()  # in-flight pipelined push lands first
             store.drain()
             for name, plan in sorted(store.plans.items()):
                 n_shards = len(plan.shard_ranges()) if plan.partitioned else 1
@@ -393,15 +394,12 @@ class ShardedSaver:
 
     # ------------------------------------------------------------- discovery
 
-    _META_RE = __import__("re").compile(r"^ckpt-(\d+)\.shard-meta\.json$")
+    import re as _re
+    _META_RE = _re.compile(r"^ckpt-(\d+)\.shard-meta\.json$")
 
     def _own_metas(self):
-        out = []
-        for f in os.listdir(self.directory):
-            m = self._META_RE.match(f)
-            if m:
-                out.append((int(m.group(1)), f))
-        return sorted(out)
+        from autodist_tpu.checkpoint.saver import scan_checkpoint_metas
+        return scan_checkpoint_metas(self.directory, self._META_RE)
 
     def _gc(self):
         metas = self._own_metas()
@@ -529,6 +527,8 @@ class ShardedSaver:
                                                    reader, dstep.mesh, suffix)
             store = dstep.ps_store
             if store is not None:
+                # a staged prefetch of pre-restore values must not survive
+                dstep.invalidate_ps()
                 groups = _group_keys(meta)
 
                 def provider(name, si):

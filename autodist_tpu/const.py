@@ -87,6 +87,11 @@ class ENV(Enum):
     # liveness window (seconds): workers heartbeat every quarter of it;
     # the chief's watchdog treats silence longer than it as death/deadlock
     ADT_HEARTBEAT_TIMEOUT_S = ("ADT_HEARTBEAT_TIMEOUT_S", float, 60.0)
+    # host-PS transfer/compute overlap (parallel/ps.py PSPipeline): 1 =
+    # background push + prefetched pull (bit-exact for sync PS; with
+    # staleness>=1 or async serving the prefetch overlaps compute fully);
+    # 0 = the serial pull->step->push baseline
+    ADT_PS_OVERLAP = ("ADT_PS_OVERLAP", int, 1)
 
     @property
     def val(self):

@@ -77,19 +77,59 @@ class DistributedStep:
 
     # ---------------------------------------------------------- ps data path
 
+    @property
+    def _ps_pipe(self):
+        """Lazy PSPipeline (parallel/ps.py): overlaps the PS push (D2H +
+        host apply) and the next pull's H2D staging with compute. None when
+        there is no host-PS store or ``ADT_PS_OVERLAP=0`` (serial
+        baseline)."""
+        if not hasattr(self, "_ps_pipe_obj"):
+            self._ps_pipe_obj = None
+            if self.ps_store is not None and const.ENV.ADT_PS_OVERLAP.val:
+                stale_ok = (self.ps_store.max_staleness() >= 1
+                            or self.ps_store.any_async())
+                self._ps_pipe_obj = ps_lib.PSPipeline(
+                    self.ps_store, self.mesh, stale_ok)
+        return self._ps_pipe_obj
+
     def _pull_ps(self) -> dict:
         """Host -> device transfer of the current PS values (the per-step
         parameter read from the PS; empty when no var is host-resident)."""
         if self.ps_store is None:
             return {}
+        if self._ps_pipe is not None:
+            return self._ps_pipe.values()
         from autodist_tpu.parallel.mesh import tree_to_mesh
         return tree_to_mesh(self.mesh, self.ps_store.pull(), P())
 
     def _push_ps(self, ps_grads: dict) -> None:
         """Device -> host transfer of the reduced PS gradients + host-side
-        optimizer apply (the PS update op)."""
+        optimizer apply (the PS update op). Pipelined when overlap is on."""
         if self.ps_store is not None and ps_grads:
-            self.ps_store.push(ps_grads)
+            if self._ps_pipe is not None:
+                self._ps_pipe.submit(ps_grads)
+            else:
+                self.ps_store.push(ps_grads)
+
+    def flush_ps(self) -> None:
+        """Wait for any in-flight pipelined push — every store read
+        (checkpoint, gather, mirror digest) must see all submitted
+        gradients applied."""
+        if self.ps_store is not None and self._ps_pipe is not None:
+            self._ps_pipe.flush()
+
+    def invalidate_ps(self) -> None:
+        """Flush and discard the pipeline's staged values — call whenever
+        the store's contents are replaced out of band (restore/re-init)."""
+        if self.ps_store is not None and self._ps_pipe is not None:
+            self._ps_pipe.invalidate()
+
+    def close_ps(self) -> None:
+        """Flush the pipeline and shut its executors down (Runner.close);
+        a fresh pipeline is lazily created if stepping resumes."""
+        if self.ps_store is not None and self._ps_pipe is not None:
+            self._ps_pipe.close()
+            self._ps_pipe_obj = None
 
     def __call__(self, state: TrainState, batch, donate: bool = True):
         """Run one step. ``donate=True`` (default) consumes ``state``'s
@@ -145,6 +185,7 @@ class DistributedStep:
         vars) and placed on the mesh. ``params``/``opt_state`` arrive in the
         ORIGINAL full layout (the checkpoint layout)."""
         item = self.model_item
+        self.invalidate_ps()  # re-init replaces the store's contents
         if self.ps_store is not None and not ps_lib.holes_of(params):
             # host-resident leaves: values + per-shard optimizer state
             # (an already-holed input means re-init from a live state — the
@@ -207,9 +248,10 @@ class DistributedStep:
         come straight from the store (the authoritative copy)."""
         gathered = self._gather_tree(state.params, self._layout_tree)
         if self.ps_store is not None:
-            # async serving: apply any queued gradients this process owns
-            # before reading (peers' in-flight grads are, by async
-            # semantics, allowed to land after)
+            # flush the pipelined push, then apply any queued gradients this
+            # process owns before reading (peers' in-flight grads are, by
+            # async semantics, allowed to land after)
+            self.flush_ps()
             self.ps_store.drain()
             gathered = ps_lib.fill_holes(gathered, self.ps_store.full_values())
         return gathered
@@ -223,8 +265,9 @@ class DistributedStep:
             VarLayout(name=""))
         gathered = self._gather_tree(state.opt_state, layout_tree)
         if self.ps_store is not None:
-            # drain before reading so the opt snapshot pairs with the value
-            # snapshot gather_params takes (not torn across an async apply)
+            # flush+drain before reading so the opt snapshot pairs with the
+            # value snapshot gather_params takes (not torn across an apply)
+            self.flush_ps()
             self.ps_store.drain()
             gathered = ps_lib.fill_holes_with_path(
                 gathered, self.ps_store.full_opt_leaf)
@@ -496,11 +539,29 @@ class GraphTransformer:
                             sparse_bytes, dense_bytes)
                 sparse_specs = keep
             except Exception as e:  # noqa: BLE001 — discovery is best-effort
+                # ... except when it must not be: an exception here silently
+                # degrades every sparse var to dense sync (>10x wire on
+                # embedding models). Strict when the builder demanded the
+                # sparse wire (require_sparse) or under test invariants.
+                if (self._strategy.graph_config.require_sparse
+                        or const.ENV.ADT_IS_TESTING.val):
+                    raise RuntimeError(
+                        "sparse-wire discovery failed and the strategy "
+                        "requires the sparse gradient path (vars: %s)"
+                        % sorted(sparse_candidates)) from e
                 sparse_specs = {}
                 logging.warning("sparse-wire discovery failed (%s); dense "
                                 "sync for all sparse vars", e)
             uncaptured = sparse_candidates - discovered
             if uncaptured:
+                if self._strategy.graph_config.require_sparse:
+                    raise ValueError(
+                        "strategy requires the sparse gradient wire but "
+                        "vars %s are not routed through "
+                        "ops.embedding.embedding_lookup(name=...) — their "
+                        "gradients would sync DENSE (vocab-sized wire). "
+                        "Route the lookups through ops.embedding, or build "
+                        "with require_sparse=False." % sorted(uncaptured))
                 logging.warning(
                     "sparse vars %s not routed through "
                     "ops.embedding.embedding_lookup(name=...); their "

@@ -945,3 +945,129 @@ class PSStore:
 
     def any_async(self) -> bool:
         return any(not p.sync for p in self.plans.values())
+
+
+# ----------------------------------------------------------------- pipeline
+
+
+class PSPipeline:
+    """Overlap the host-PS data path with compute (a TPU-native stand-in
+    for the reference's TF dataflow runtime, which scheduled PS send/recv
+    against compute implicitly, ``ps_synchronizer.py:171-176``).
+
+    The serial baseline runs pull -> step -> device_get(grads) -> host
+    apply, so a transfer-bound config pays compute + 2x PCIe per step.
+    Here the push (D2H + optimizer apply) and the NEXT step's pull staging
+    (H2D) run on one background worker:
+
+    - **sync PS (exact)**: each step's job is get -> apply -> prefetch, and
+      the next step's :meth:`values` waits for it — numerics are
+      bit-identical to the serial path (same calls, same order). The whole
+      job overlaps the main thread's dispatch latency, feed building, and
+      user host code.
+    - **staleness >= 1 or async serving**: the prefetch is issued BEFORE
+      the apply, so the H2D rides alongside this step's compute and the
+      apply + D2H ride alongside the next step's: step time ~=
+      max(compute, transfer). Reads lag applies by exactly one — inside
+      the declared staleness bound (and unordered-by-design under async).
+
+    ``ADT_PS_OVERLAP=0`` restores the serial path.
+    """
+
+    def __init__(self, store: PSStore, mesh, stale_ok: bool):
+        import concurrent.futures
+        self._store = store
+        self._mesh = mesh
+        self._stale_ok = stale_ok
+        self._exec = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="adt-ps-pipe")
+        # stale mode runs pulls on their OWN lane so the next step's H2D
+        # overlaps the previous push's D2H+apply (max(pull, push) instead
+        # of pull+push); exact mode keeps one lane (strict order)
+        self._pull_exec = (concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="adt-ps-pull")
+            if stale_ok else self._exec)
+        self._pending = None  # Future -> staged device values for next step
+        self._push_pending = None  # stale mode: the push/apply future
+        # staleness window: a read may lag at most this many applies (the
+        # pull for step N+1 waits for push N-s before reading)
+        self._window = max(1, store.max_staleness())
+        import collections
+        self._push_hist = collections.deque(maxlen=max(self._window, 1))
+
+    def _pull_staged(self):
+        from autodist_tpu.parallel.mesh import tree_to_mesh
+        from jax.sharding import PartitionSpec as P
+        return tree_to_mesh(self._mesh, self._store.pull(), P())
+
+    def values(self):
+        """Device-staged PS values for the step about to run. Consumes the
+        prefetch when one is pending (in exact mode the prefetch job also
+        carries the push, so waiting keeps sync semantics exact); cold
+        start / post-eval does a fresh pull."""
+        if self._pending is None:
+            return self._pull_staged()
+        fut, self._pending = self._pending, None
+        return fut.result()
+
+    def submit(self, ps_grads: Dict[str, Any]) -> None:
+        """Queue this step's push and the next step's pull.
+
+        Exact (sync) mode: one job, get -> apply -> prefetch, and the next
+        ``values()`` waits for all of it — bit-identical to serial.
+
+        Stale mode (staleness >= 1 / async serving): the pull rides its own
+        lane and may read PRE-apply values (stale-by-one, and per-variable
+        rather than tree-atomic — the store's per-var lock means a pull
+        concurrent with an apply can see var A pre-apply and var B post-
+        apply, exactly the per-variable consistency the reference's
+        per-var PS queues gave)."""
+        if self._stale_ok:
+            # bounded lag: the prefetched read may trail the newest apply
+            # by at most the staleness window — the pull waits for the
+            # push submitted `window` steps ago (None in the ramp-up)
+            barrier = (self._push_hist[0]
+                       if len(self._push_hist) >= self._window else None)
+
+            def pull_job():
+                if barrier is not None:
+                    barrier.result()
+                return self._pull_staged()
+            self._pending = self._pull_exec.submit(pull_job)
+            prev = self._push_pending
+
+            def push_job():
+                if prev is not None:
+                    prev.result()        # pushes stay ordered
+                self._store.push(ps_grads)
+            self._push_pending = self._exec.submit(push_job)
+            self._push_hist.append(self._push_pending)
+        else:
+            def job():
+                self._store.push(ps_grads)
+                return self._pull_staged()
+            self._pending = self._exec.submit(job)
+
+    def flush(self) -> None:
+        """Wait for the in-flight push (checkpoints / gathers / digests
+        read the store and must see every submitted gradient applied).
+        The staged values stay pending for the next :meth:`values`."""
+        if self._push_pending is not None:
+            self._push_pending.result()
+        if self._pending is not None and not self._stale_ok:
+            self._pending.result()
+
+    def invalidate(self) -> None:
+        """Flush, then DISCARD the staged prefetch — the store's state was
+        replaced out of band (checkpoint restore / re-init) and the staged
+        values no longer reflect it."""
+        self.flush()
+        if self._pending is not None:
+            self._pending.result()  # never abandon a running pull mid-flight
+        self._pending = None
+
+    def close(self) -> None:
+        self.flush()
+        self._exec.shutdown(wait=True)
+        if self._pull_exec is not self._exec:
+            self._pull_exec.shutdown(wait=True)

@@ -214,12 +214,16 @@ class AsyncPSWorker:
 
     def _loop(self):
         while not self._stop.is_set():
+            # busy is raised BEFORE the pause check AND before the pop:
+            # pause() waits on !busy, so it can never return "quiesced"
+            # while this thread is past the check and about to pop; and a
+            # drain() racing the pop must never observe (queue empty, not
+            # busy) while a blob is in hand
+            self._busy = True
             if self._pause.is_set():
+                self._busy = False
                 time.sleep(self._poll_s)
                 continue
-            # busy is raised BEFORE the pop: a drain() racing the pop must
-            # never observe (queue empty, not busy) while a blob is in hand
-            self._busy = True
             blob = self._service.pop_grads()
             if blob is None:
                 self._busy = False

@@ -250,6 +250,9 @@ class Runner:
             if self._mirror_coord is None:
                 self._mirror_coord = False
                 return
+        # the pipelined push for this step must land before the digest, or
+        # processes would hash different apply versions (false divergence)
+        self._dstep.flush_ps()
         digest = store.mirror_digest()
         worker = const.ENV.ADT_WORKER.val or "chief"
         # keys are scoped by strategy id (unique per run — a long-lived
@@ -315,6 +318,14 @@ class Runner:
             setattr(self, attr, None)
         store = getattr(self._dstep, "ps_store", None)
         if store is not None:
+            # land the in-flight pipelined push and stop its executor
+            # threads BEFORE tearing the store down — a background push
+            # against a closed store would fail into a never-awaited
+            # Future, silently losing the last step's gradient
+            try:
+                self._dstep.close_ps()
+            except Exception as e:  # noqa: BLE001 — close stays idempotent
+                logging.warning("PS pipeline close failed: %s", e)
             store.close()
 
     def gather_params(self):

@@ -139,7 +139,9 @@ def test_async_e2e_single_process():
     for i in range(60):
         losses.append(float(runner.run(batch)["loss"]))
         if i % 5 == 4:
+            dstep.flush_ps()  # pipelined pushes must reach the queue first
             store.drain()
+    dstep.flush_ps()
     store.drain()
     assert store.applied_total() == 60
     # async pulls may observe stale versions, but the trajectory converges

@@ -140,6 +140,7 @@ def test_sparse_ps_ships_pairs_to_store():
     store = runner.distributed_step.ps_store
     assert store is not None and store.plans["emb/table"].partitioned
     runner.run(batch)
+    runner.distributed_step.flush_ps()  # pipelined push lands off-thread
     dense_push = VOCAB * DIM * 4
     assert 0 < store.stats["bytes_pushed"] < dense_push / 10, \
         "sparse PS push not batch-scale: %d" % store.stats["bytes_pushed"]
@@ -243,4 +244,67 @@ def test_ncf_sparse_embed_layers_engage():
     assert "params/mf_user_embedding/embedding" in wired, wired
     assert len(wired) == 4
     losses = [float(runner.run(batch)["loss"]) for _ in range(5)]
+    assert losses[-1] < losses[0]
+
+
+# ------------------------------------------------------------- strict mode
+
+
+def test_require_sparse_raises_on_unrouted_lookup():
+    """A builder that demanded the sparse wire (require_sparse=True) must
+    raise — not warn — when a sparse var bypasses the named
+    embedding_lookup and would silently sync dense (>10x wire)."""
+    rng = np.random.RandomState(0)
+    params = {"emb": jnp.asarray(rng.randn(4096, 16) * 0.1, jnp.float32),
+              "w": jnp.asarray(rng.randn(16, 4) * 0.1, jnp.float32)}
+
+    def loss_fn(p, batch):
+        rows = jnp.take(p["emb"], batch["ids"], axis=0)  # NOT ops.embedding
+        return jnp.mean((rows @ p["w"]) ** 2)
+
+    batch = {"ids": rng.randint(0, 4096, (16,)).astype(np.int32)}
+    ad = adt.AutoDist(
+        strategy_builder=strategy.Parallax(require_sparse=True))
+    with pytest.raises(ValueError, match="requires the sparse gradient"):
+        ad.build(loss_fn, optax.sgd(0.1), params, batch)
+
+
+def test_require_sparse_roundtrips_through_serialization(tmp_path):
+    """require_sparse survives strategy serialize/deserialize — the
+    worker's independently-lowered program enforces the same contract."""
+    from autodist_tpu.strategy.base import Strategy, GraphConfig
+    s = Strategy(graph_config=GraphConfig(replicas=["a"],
+                                          require_sparse=True))
+    s2 = Strategy.from_dict(s.to_dict())
+    assert s2.graph_config.require_sparse is True
+
+
+def test_require_sparse_satisfied_runs_clean(caplog):
+    """A properly-routed embedding model under require_sparse engages the
+    wire with ZERO sparse fallback warnings."""
+    import logging as pylogging
+    rng = np.random.RandomState(0)
+    vocab, dim = 4096, 16
+    params = {"emb": {"table": jnp.asarray(rng.randn(vocab, dim) * 0.1,
+                                           jnp.float32)},
+              "w": jnp.asarray(rng.randn(dim, 4) * 0.1, jnp.float32)}
+
+    def loss_fn(p, batch):
+        rows = E.embedding_lookup(p["emb"]["table"], batch["ids"],
+                                  name="emb/table")
+        return jnp.mean((rows @ p["w"] - batch["y"]) ** 2)
+
+    batch = {"ids": rng.randint(0, vocab, (16,)).astype(np.int32),
+             "y": rng.randn(16, 4).astype(np.float32)}
+    ad = adt.AutoDist(
+        strategy_builder=strategy.Parallax(require_sparse=True))
+    with caplog.at_level(pylogging.WARNING, logger="autodist_tpu"):
+        runner = ad.build(loss_fn, optax.sgd(0.1), params, batch)
+        runner.init(params)
+        losses = [float(runner.run(batch)["loss"]) for _ in range(4)]
+    assert "emb/table" in runner.distributed_step.metadata["sparse_wire"]
+    bad = [r for r in caplog.records if "sparse" in r.getMessage().lower()
+           and ("dense" in r.getMessage().lower()
+                or "failed" in r.getMessage().lower())]
+    assert not bad, [r.getMessage() for r in bad]
     assert losses[-1] < losses[0]

