@@ -242,12 +242,17 @@ class AutoDist:
 
     def build(self, loss_fn: Callable, optimizer, params, example_batch,
               has_aux: bool = False, apply_fn: Optional[Callable] = None,
-              trainable_filter: Optional[Callable] = None) -> Runner:
-        """Capture + compile + lower; returns a Runner (uninitialized)."""
+              trainable_filter: Optional[Callable] = None,
+              mp_rules=None) -> Runner:
+        """Capture + compile + lower; returns a Runner (uninitialized).
+        ``mp_rules`` (e.g. ``models.tp_lm.tp_rules()``) registers the
+        model's tensor-parallel sharding map so AutoStrategy searches the
+        TP space too."""
         item = ModelItem(loss_fn=loss_fn, optimizer=optimizer, params=params,
                          example_batch=example_batch, has_aux=has_aux,
                          apply_fn=apply_fn,
-                         trainable_filter=trainable_filter).prepare()
+                         trainable_filter=trainable_filter,
+                         mp_rules=mp_rules).prepare()
         strategy = self._build_or_load_strategy(item)
         compiled = StrategyCompiler(item, self._resource_spec).compile(strategy)
         logging.info("compiled %r", compiled)

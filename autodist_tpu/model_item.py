@@ -200,7 +200,8 @@ class ModelItem:
                  has_aux: bool = False,
                  step_fn: Optional[Callable] = None,
                  apply_fn: Optional[Callable] = None,
-                 trainable_filter: Optional[Callable[[str], bool]] = None):
+                 trainable_filter: Optional[Callable[[str], bool]] = None,
+                 mp_rules=None):
         if loss_fn is None and step_fn is None:
             raise ValueError("ModelItem needs loss_fn or step_fn")
         self.loss_fn = loss_fn
@@ -210,6 +211,10 @@ class ModelItem:
         self.params = params
         self.example_batch = example_batch
         self.has_aux = has_aux
+        # model-parallel sharding rules the model family exports (e.g.
+        # models.tp_lm.tp_rules()); registering them lets AutoStrategy
+        # enumerate TensorParallel candidates for this model
+        self.mp_rules = list(mp_rules) if mp_rules else None
         # default: everything trains except flax's batch_stats collection
         # (BatchNorm running statistics are EMA state, not weights — updating
         # them by gradient would corrupt normalization)

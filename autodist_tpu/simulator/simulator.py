@@ -24,6 +24,34 @@ class SimulationResult:
         return self.breakdown.step_time_s
 
 
+# Accuracy-risk premium for LOSSY gradient compression, applied to the
+# RANKING key only (step-time estimates stay physical). Quality is not on
+# the cost model's seconds scale, but a selector that defaults to rank-2
+# PowerSGD because it wins microseconds on an unconstrained network is
+# making an accuracy decision the user never asked for — lossless-first
+# unless the wire saving is decisive (bf16 rounding is near-lossless;
+# int8+EF costs measurable accuracy; low-rank PowerSGD the most).
+_LOSSY_PREMIUM = {
+    "HorovodCompressor": 1.02, "BF16Compressor": 1.02,
+    "HorovodCompressorEF": 1.02, "BF16CompressorEF": 1.02,
+    "Int8Compressor": 1.15, "Int8CompressorEF": 1.15,
+    "PowerSGDCompressor": 1.35,
+}
+
+
+def _risk_premium(strategy: Strategy) -> float:
+    """Max lossy-compression premium across the strategy's synchronizers."""
+    worst = 1.0
+    for node in strategy.node_config:
+        syncs = ([node.synchronizer] if node.synchronizer else
+                 [p.synchronizer for p in node.part_configs])
+        for sync in syncs:
+            name = getattr(sync, "compressor", "") or ""
+            name = name.split(":")[0]
+            worst = max(worst, _LOSSY_PREMIUM.get(name, 1.0))
+    return worst
+
+
 class Simulator:
     def __init__(self, model_item, resource_spec, **cost_model_kwargs):
         self._cost_model = CostModel(model_item, resource_spec,
@@ -62,9 +90,13 @@ class Simulator:
         ones regardless of estimated speed — a fast strategy that OOMs is
         not a strategy; within each group, cheapest step time wins. If
         nothing fits, the ranking still returns (cheapest first) with a
-        warning rather than failing the build."""
+        warning rather than failing the build. Lossy-compression
+        candidates carry an accuracy-risk premium in the sort key (see
+        ``_risk_premium``) so they win only when the wire saving is
+        decisive, not on microsecond ties."""
         results = [self.simulate(s, label) for label, s in candidates]
-        results.sort(key=lambda r: (not r.breakdown.feasible, r.step_time_s))
+        results.sort(key=lambda r: (not r.breakdown.feasible,
+                                    r.step_time_s * _risk_premium(r.strategy)))
         if results and not results[0].breakdown.feasible:
             logging.warning(
                 "no candidate strategy fits the HBM estimate (best %s needs "

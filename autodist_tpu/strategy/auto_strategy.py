@@ -15,6 +15,10 @@ from autodist_tpu.utils import logging
 
 
 def default_candidates() -> List[Tuple[str, StrategyBuilder]]:
+    """The full data-parallel strategy space the framework implements —
+    the selector must search what the framework can do (the reference's
+    AutoSync ambition). Model-parallel candidates join per-model via
+    ``mp_rules`` (see :meth:`AutoStrategy.build`)."""
     from autodist_tpu.strategy.all_reduce_strategy import AllReduce
     from autodist_tpu.strategy.parallax_strategy import Parallax
     from autodist_tpu.strategy.partitioned_all_reduce_strategy import PartitionedAR
@@ -23,16 +27,24 @@ def default_candidates() -> List[Tuple[str, StrategyBuilder]]:
     from autodist_tpu.strategy.ps_strategy import PS
     from autodist_tpu.strategy.remat import WithRemat
     return [
+        # host-resident PS (no proxy: 1/HBM in exchange for PCIe per step)
         ("PS", PS()),
         ("PSLoadBalancing", PSLoadBalancing()),
         ("PartitionedPS", PartitionedPS()),
+        # device-cached PS (proxy): params stay in HBM, PS owns the update
+        ("PS/proxy", PS(local_proxy_variable=True)),
+        # bounded staleness: hides slow-worker jitter inside the window
+        ("PS/stale2", PS(staleness=2)),
         ("AllReduce/128", AllReduce(chunk_size=128)),
         ("AllReduce/512", AllReduce(chunk_size=512)),
         ("AllReduce/bf16", AllReduce(compressor="HorovodCompressor")),
         ("AllReduce/int8", AllReduce(compressor="Int8CompressorEF")),
+        # rank-2 PowerSGD: 10-100x wire compression for DCN-bound clusters
+        ("AllReduce/psgd2", AllReduce(compressor="PowerSGDCompressor:2")),
         ("PartitionedAR", PartitionedAR()),
         ("Parallax", Parallax()),
         ("Parallax/bf16", Parallax(compressor="HorovodCompressor")),
+        ("Parallax/int8", Parallax(compressor="Int8CompressorEF")),
         # activation-memory relief: ranks behind the plain variants on
         # speed (extra recompute FLOPs) but ahead on the HBM feasibility
         # gate when ACTIVATIONS dominate — ZeRO/host-PS above relieve
@@ -41,6 +53,29 @@ def default_candidates() -> List[Tuple[str, StrategyBuilder]]:
         ("AllReduce/remat", WithRemat(AllReduce(chunk_size=512),
                                       policy="dots")),
     ]
+
+
+def mp_candidates(model_item, resource_spec
+                  ) -> List[Tuple[str, StrategyBuilder]]:
+    """Tensor-parallel candidates enumerated from the model's registered
+    ``mp_rules`` (set via ``AutoDist.build(..., mp_rules=...)`` or
+    ``ModelItem(mp_rules=...)``): one TP entry per power-of-two shard
+    count dividing the device count. The cost model prices their
+    forward-collective traffic (mp_comm_time) and sharded storage, so
+    they rank against the data-parallel family on one scale."""
+    rules = getattr(model_item, "mp_rules", None)
+    if not rules:
+        return []
+    from autodist_tpu.strategy.tensor_parallel_strategy import TensorParallel
+    n_devices = len(resource_spec.devices)
+    out: List[Tuple[str, StrategyBuilder]] = []
+    k = 2
+    while k <= n_devices and k <= 8:
+        if n_devices % k == 0:
+            out.append(("TensorParallel/%d" % k,
+                        TensorParallel(tp_shards=k, mp_rules=rules)))
+        k *= 2
+    return out
 
 
 class AutoStrategy(StrategyBuilder):
@@ -62,6 +97,9 @@ class AutoStrategy(StrategyBuilder):
     def build(self, model_item, resource_spec) -> Strategy:
         from autodist_tpu.simulator.simulator import Simulator
         candidates = (self._candidates or default_candidates()) + self._extra
+        if self._candidates is None:
+            # models that registered mp_rules enter the tp search space
+            candidates = candidates + mp_candidates(model_item, resource_spec)
         built = []
         for label, builder in candidates:
             try:

@@ -187,11 +187,22 @@ class GraphConfig:
     # cost model can price the pipeline bubble ((S-1+M)/M compute
     # inflation) from the serialized strategy alone
     pp_microbatches: Optional[int] = None
+    # pipeline schedule: "gpipe" (all-M activation residency) or "1f1b"
+    # (residency bounded at S in-flight microbatches; the model must build
+    # its loss through pipeline_loss_1f1b) — priced by the cost model
+    pp_schedule: Optional[str] = None
+    # strict sparse wire: a builder that PLANNED on (ids, values) gradient
+    # shipping (DLRM/NCF embedding strategies) sets this so a silent
+    # fallback to dense sync — a >10x wire regression — raises in the
+    # lowering instead of logging a warning. ADT_IS_TESTING implies it.
+    require_sparse: bool = False
 
     def to_dict(self):
         return {"replicas": list(self.replicas), "mesh_shape": self.mesh_shape,
                 "seq_axis": self.seq_axis, "batch_axes": self.batch_axes,
-                "remat": self.remat, "pp_microbatches": self.pp_microbatches}
+                "remat": self.remat, "pp_microbatches": self.pp_microbatches,
+                "pp_schedule": self.pp_schedule,
+                "require_sparse": self.require_sparse}
 
     @classmethod
     def from_dict(cls, d):
@@ -200,7 +211,9 @@ class GraphConfig:
                    seq_axis=d.get("seq_axis"),
                    batch_axes=d.get("batch_axes"),
                    remat=d.get("remat"),
-                   pp_microbatches=d.get("pp_microbatches"))
+                   pp_microbatches=d.get("pp_microbatches"),
+                   pp_schedule=d.get("pp_schedule"),
+                   require_sparse=bool(d.get("require_sparse", False)))
 
 
 # ----------------------------------------------------------------- strategy

@@ -19,13 +19,17 @@ class Parallax(StrategyBuilder):
     def __init__(self, chunk_size: int = 128, all_reduce_spec: str = "AUTO",
                  compressor: str = "NoneCompressor",
                  local_proxy_variable: bool = False, sync: bool = True,
-                 staleness: int = 0):
+                 staleness: int = 0, require_sparse: bool = False):
         self.chunk_size = chunk_size
         self.all_reduce_spec = all_reduce_spec
         self.compressor = compressor
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
+        # the whole point of Parallax is the dense/sparse split — a user
+        # who picked it for an embedding model can demand that the sparse
+        # wire actually engages (lowering raises on silent dense fallback)
+        self._require_sparse = require_sparse
 
     def build(self, model_item, resource_spec) -> Strategy:
         infos = [model_item.var_infos[n] for n in model_item.trainable_var_names]
@@ -48,4 +52,6 @@ class Parallax(StrategyBuilder):
                     local_replication=self._local_proxy_variable,
                     sync=self._sync, staleness=self._staleness)))
         return Strategy(node_config=nodes,
-                        graph_config=GraphConfig(replicas=replica_devices(resource_spec)))
+                        graph_config=GraphConfig(
+                            replicas=replica_devices(resource_spec),
+                            require_sparse=self._require_sparse))

@@ -39,11 +39,13 @@ def make_partition_str(rank: int, axis: int, num_shards: int) -> str:
 
 class PartitionedPS(StrategyBuilder):
     def __init__(self, local_proxy_variable: bool = False, sync: bool = True,
-                 staleness: int = 0, num_shards: int = 0):
+                 staleness: int = 0, num_shards: int = 0,
+                 require_sparse: bool = False):
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
         self._num_shards_override = num_shards
+        self._require_sparse = require_sparse
 
     def _num_shards(self, dim0: int, n_ps: int) -> int:
         if self._num_shards_override:
@@ -82,4 +84,6 @@ class PartitionedPS(StrategyBuilder):
                 partitioner=make_partition_str(len(info.shape), 0, num_shards),
                 part_configs=part_configs))
         return Strategy(node_config=nodes,
-                        graph_config=GraphConfig(replicas=replica_devices(resource_spec)))
+                        graph_config=GraphConfig(
+                            replicas=replica_devices(resource_spec),
+                            require_sparse=self._require_sparse))

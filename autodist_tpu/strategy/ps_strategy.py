@@ -20,10 +20,11 @@ def replica_devices(resource_spec):
 
 class PS(StrategyBuilder):
     def __init__(self, local_proxy_variable: bool = False, sync: bool = True,
-                 staleness: int = 0):
+                 staleness: int = 0, require_sparse: bool = False):
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
+        self._require_sparse = require_sparse
         if staleness > 0:
             assert sync, "staleness is only meaningful for sync training"
 
@@ -40,4 +41,6 @@ class PS(StrategyBuilder):
             for name in model_item.trainable_var_names
         ]
         return Strategy(node_config=nodes,
-                        graph_config=GraphConfig(replicas=replica_devices(resource_spec)))
+                        graph_config=GraphConfig(
+                            replicas=replica_devices(resource_spec),
+                            require_sparse=self._require_sparse))

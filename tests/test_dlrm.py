@@ -69,7 +69,7 @@ def test_wide_and_deep_variant():
     loss_fn, params, batch, _ = dlrm.make_train_setup(cfg, batch_size=16)
     assert "wide_table_0" in params["params"]
     assert "wide_dense" in params["params"]
-    ad = adt.AutoDist(strategy_builder=strategy.Parallax())
+    ad = adt.AutoDist(strategy_builder=strategy.Parallax(require_sparse=True))
     runner = ad.build(loss_fn, optax.adam(1e-2), params, batch)
     runner.init(params)
     wire = set(runner.distributed_step.metadata["sparse_wire"])
