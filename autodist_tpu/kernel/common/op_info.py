@@ -55,11 +55,11 @@ EFFECTFUL_PRIMITIVES = frozenset({
 
 
 def sub_jaxprs(eqn):
-    """Yield the sub-jaxprs carried in an eqn's params (cond/scan/pjit...)."""
+    """Yield the sub-jaxprs carried in an eqn's params (cond/scan/pjit
+    carry ClosedJaxprs; shard_map carries a raw Jaxpr)."""
     for val in eqn.params.values():
-        if hasattr(val, "jaxpr"):
-            yield val.jaxpr
-        elif isinstance(val, (list, tuple)):
-            for item in val:
-                if hasattr(item, "jaxpr"):
-                    yield item.jaxpr
+        for item in (val if isinstance(val, (list, tuple)) else (val,)):
+            if hasattr(item, "jaxpr"):
+                yield item.jaxpr
+            elif hasattr(item, "eqns"):  # a raw Jaxpr
+                yield item

@@ -116,16 +116,49 @@ def forward(params, input_ids, cfg: TPLMConfig, n_microbatches: int = 1,
 def make_train_setup(cfg: Optional[TPLMConfig] = None, seq_len: int = 128,
                      batch_size: int = 8, seed: int = 0,
                      n_microbatches: int = 1,
-                     model_axis: str = const.MODEL_AXIS):
+                     model_axis: str = const.MODEL_AXIS,
+                     schedule: str = "gpipe"):
+    """``schedule="1f1b"`` trains through the fused 1F1B pipeline
+    (``parallel/pipeline.pipeline_loss_1f1b``): the loss head moves
+    INSIDE the pipelined region so backward microbatches interleave with
+    forward ones, bounding activation residency at S microbatches
+    instead of GPipe's M. Same math to float tolerance."""
     cfg = cfg or TPLMConfig()
     params = init_params(cfg, seed)
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError("schedule must be 'gpipe' or '1f1b'")
 
-    def loss_fn(p, batch):
+    def loss_fn_gpipe(p, batch):
         tokens = batch["tokens"]
         logits = forward(p, tokens[:, :-1], cfg, n_microbatches,
                          model_axis=model_axis)
         nll = tensor.vocab_parallel_xent(logits, tokens[:, 1:], model_axis)
         return jnp.mean(nll)
+
+    def loss_fn_1f1b(p, batch):
+        dt = cfg.dtype
+        tokens = batch["tokens"]
+        ids = tokens[:, :-1]
+        x = tensor.vocab_parallel_embed(p["embed"], ids, model_axis)
+        x = (x * np.sqrt(cfg.d_model)).astype(dt)
+        x = x + p["pos_embed"][:ids.shape[-1]].astype(dt)[None]
+
+        def stage_fn(blocks_local, h):
+            return pipeline.stacked_scan(
+                lambda bp, hh: _block(bp, hh, dt, model_axis),
+                blocks_local, h)
+
+        def head_fn(hp, h, y):
+            h = _layer_norm(h, hp["final_ln"])
+            logits = tensor.vocab_parallel_logits(h, hp["embed"].astype(dt))
+            return jnp.mean(tensor.vocab_parallel_xent(logits, y, model_axis))
+
+        return pipeline.pipeline_loss_1f1b(
+            stage_fn, head_fn, p["blocks"],
+            {"final_ln": p["final_ln"], "embed": p["embed"]},
+            x, tokens[:, 1:], n_microbatches)
+
+    loss_fn = loss_fn_1f1b if schedule == "1f1b" else loss_fn_gpipe
 
     npr = np.random.RandomState(seed)
     example_batch = {"tokens": npr.randint(

@@ -101,3 +101,192 @@ def stacked_scan(block_fn: Callable, stacked_params, h):
         return block_fn(p, carry), None
     out, _ = jax.lax.scan(body, h, stacked_params)
     return out
+
+
+# --------------------------------------------------------------------- 1F1B
+
+
+def _run_1f1b(stage_fn, head_fn, stage_params, head_params, x, y,
+              n_microbatches: int, axis_name: str):
+    """The fused 1F1B schedule: loss AND grads in ONE interleaved scan.
+
+    Schedule (classic non-interleaved 1F1B, Narayanan et al. PipeDream /
+    Megatron): with S stages and M microbatches over global half-ticks,
+    rank ``r`` runs fwd(m) at tick ``r + 2m`` and bwd(m) at tick
+    ``2S-1-r + 2m``. The two live on opposite tick parities, so each rank
+    does at most one forward and one backward per tick, activations flow
+    down (ppermute +1) and cotangents up (ppermute -1) every tick, and a
+    stashed microbatch INPUT lives only ``2(S-r)-1`` ticks — so a
+    circular stash of ``S`` slots bounds activation residency at S
+    microbatches (vs GPipe's all-M residency). The backward recomputes
+    the stage forward from the stashed input (per-microbatch remat).
+
+    Gradient conventions match the GPipe path exactly (the lowering's
+    psum(complement)/N for pipe-sharded vars and psum(all)/N for
+    replicated vars assume the broadcast-loss inflation — see
+    tests/test_pipeline_parallel.py): stage grads come back S-inflated,
+    dx is S-inflated and nonzero only on rank 0, head grads uniform.
+
+    Returns (loss, dstage_params, dhead_params, dx).
+    """
+    S = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    M = n_microbatches
+    B = x.shape[0]
+    if B % M != 0:
+        raise ValueError("batch %d not divisible by %d microbatches" % (B, M))
+    x_mb = x.reshape((M, B // M) + x.shape[1:])
+    y_mb = y.reshape((M, B // M) + y.shape[1:])
+    S_int = int(S)  # mesh axis sizes are static under shard_map
+
+    fwd_perm = [(i, i + 1) for i in range(S_int - 1)]
+    bwd_perm = [(i + 1, i) for i in range(S_int - 1)]
+
+    zeros_mb = jnp.zeros_like(x_mb[0])
+    carry0 = {
+        "fwd_in": zeros_mb,                       # activation from upstream
+        "bwd_in": zeros_mb,                       # cotangent from downstream
+        "stash": jnp.zeros((S_int,) + zeros_mb.shape, zeros_mb.dtype),
+        "gacc": jax.tree_util.tree_map(jnp.zeros_like, stage_params),
+        "hacc": jax.tree_util.tree_map(jnp.zeros_like, head_params),
+        "dx": jnp.zeros_like(x_mb),
+        "loss": jnp.zeros((), jnp.float32),
+    }
+
+    def tick(carry, t):
+        f2 = t - rank                      # fwd(m) at t = r + 2m
+        b2 = t - (2 * S - 1 - rank)        # bwd(m) at t = 2S-1-r + 2m
+        fwd_on = (f2 >= 0) & (f2 % 2 == 0) & (f2 // 2 < M)
+        bwd_on = (b2 >= 0) & (b2 % 2 == 0) & (b2 // 2 < M)
+        fi = jnp.clip(f2 // 2, 0, M - 1)
+        bi = jnp.clip(b2 // 2, 0, M - 1)
+
+        # ---- forward (predicated): stash the input, send output down
+        inp = jnp.where(rank == 0,
+                        jax.lax.dynamic_index_in_dim(x_mb, fi, 0,
+                                                     keepdims=False),
+                        carry["fwd_in"])
+        out = stage_fn(stage_params, inp)
+        stash = jnp.where(
+            fwd_on,
+            jax.lax.dynamic_update_slice_in_dim(
+                carry["stash"], inp[None], fi % S_int, 0),
+            carry["stash"])
+
+        # ---- backward (predicated): recompute from the stashed input,
+        # last rank sources its cotangent (and the loss) from head_fn
+        h_in = jax.lax.dynamic_index_in_dim(carry["stash"], bi % S_int, 0,
+                                            keepdims=False)
+        s_out, stage_vjp = jax.vjp(stage_fn, stage_params, h_in)
+        yb = jax.lax.dynamic_index_in_dim(y_mb, bi, 0, keepdims=False)
+        loss_mb, head_vjp = jax.vjp(head_fn, head_params, s_out, yb)
+        dhead_mb, dout_head, _ = head_vjp(jnp.ones((), loss_mb.dtype))
+        is_last = rank == S - 1
+        dout = jnp.where(is_last, dout_head, carry["bwd_in"])
+        dstage_mb, dh = stage_vjp(dout)
+
+        gate = lambda on, tree, acc: jax.tree_util.tree_map(  # noqa: E731
+            lambda d, a: a + jnp.where(on, d, jnp.zeros_like(d)), tree, acc)
+        gacc = gate(bwd_on, dstage_mb, carry["gacc"])
+        hacc = gate(bwd_on & is_last, dhead_mb, carry["hacc"])
+        dx = jnp.where(
+            bwd_on & (rank == 0),
+            jax.lax.dynamic_update_slice_in_dim(carry["dx"], dh[None], bi, 0),
+            carry["dx"])
+        loss = carry["loss"] + jnp.where(
+            bwd_on & is_last, loss_mb.astype(jnp.float32), 0.0)
+
+        # ---- wire: activations down, cotangents up (zeros when idle)
+        fwd_payload = jnp.where(fwd_on, out, jnp.zeros_like(out))
+        bwd_payload = jnp.where(bwd_on, dh, jnp.zeros_like(dh))
+        new_carry = {
+            "fwd_in": jax.lax.ppermute(fwd_payload, axis_name, fwd_perm),
+            "bwd_in": jax.lax.ppermute(bwd_payload, axis_name, bwd_perm),
+            "stash": stash, "gacc": gacc, "hacc": hacc, "dx": dx,
+            "loss": loss,
+        }
+        return new_carry, None
+
+    T = 2 * M + 2 * S_int - 2
+    final, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+
+    # GPipe-convention packaging (see docstring): loss + head grads
+    # broadcast uniform; stage grads and dx S-inflated, mean over M
+    loss = jax.lax.psum(
+        jnp.where(rank == S - 1, final["loss"] / M, 0.0), axis_name)
+    dstage = jax.tree_util.tree_map(
+        lambda a: a * (S / M), final["gacc"])
+    dhead = jax.tree_util.tree_map(
+        lambda a: jax.lax.psum(a / M, axis_name), final["hacc"])
+    # each dx slot is the cotangent of that microbatch's UNdivided loss;
+    # the total loss is the mean over M, hence the /M here too
+    dx = jnp.where(rank == 0, final["dx"].reshape(x.shape) * (S / M),
+                   jnp.zeros(x.shape, final["dx"].dtype))
+    return loss, dstage, dhead, dx
+
+
+import functools as _functools  # noqa: E402
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 6, 7))
+def pipeline_loss_1f1b(stage_fn, head_fn, stage_params, head_params, x, y,
+                       n_microbatches, axis_name=const.PIPELINE_AXIS):
+    """Pipelined loss with the 1F1B schedule (activation residency bounded
+    at S microbatches instead of GPipe's M — Narayanan et al. 1806.03377 /
+    Megatron-LM 2104.04473).
+
+    ``stage_fn(stage_params, h) -> h`` is this rank's layer chunk;
+    ``head_fn(head_params, h, y) -> scalar`` is the per-microbatch loss
+    head (runs at the last stage INSIDE the schedule — that is what lets
+    backward start while later microbatches are still in forward).
+
+    Differentiable in (stage_params, head_params, x): the forward pass of
+    the outer ``jax.grad`` already runs the fused fwd+bwd schedule and
+    stashes the grads as residuals, so the outer backward only scales
+    them — loss-and-grad costs ONE 1F1B sweep. Gradient scaling matches
+    ``pipeline_apply``'s broadcast-loss convention, so the lowering's
+    existing psum(complement)/N sync is exact for both schedules.
+
+    Outside shard_map this degenerates to sequential M=1 semantics via the
+    plain path (use ``pipeline_apply`` for capture tracing).
+    """
+    if not axis_bound(axis_name):
+        out = stage_fn(stage_params, x)
+        return head_fn(head_params, out, y)
+    loss, _, _, _ = _run_1f1b(stage_fn, head_fn, stage_params, head_params,
+                              x, y, n_microbatches, axis_name)
+    return loss
+
+
+def _zero_cotangent(y):
+    """Zero cotangent for the targets — float0 for integer dtypes (the
+    tangent type JAX assigns non-differentiable inputs)."""
+    import numpy as _np
+    if jnp.issubdtype(jnp.asarray(y).dtype, jnp.inexact):
+        return jnp.zeros_like(y)
+    return _np.zeros(jnp.shape(y), jax.dtypes.float0)
+
+
+def _pl_fwd(stage_fn, head_fn, stage_params, head_params, x, y,
+            n_microbatches, axis_name):
+    if not axis_bound(axis_name):
+        out, loss_vjp = jax.vjp(
+            lambda sp, hp, xx: head_fn(hp, stage_fn(sp, xx), y),
+            stage_params, head_params, x)
+        dsp, dhp, dx = loss_vjp(jnp.ones((), out.dtype))
+        return out, (dsp, dhp, dx, y)
+    loss, dstage, dhead, dx = _run_1f1b(
+        stage_fn, head_fn, stage_params, head_params, x, y,
+        n_microbatches, axis_name)
+    return loss, (dstage, dhead, dx, y)
+
+
+def _pl_bwd(stage_fn, head_fn, n_microbatches, axis_name, residuals, g):
+    dstage, dhead, dx, y = residuals
+    scale = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+        lambda a: (a * g).astype(a.dtype), tree)
+    return scale(dstage), scale(dhead), (dx * g).astype(dx.dtype), \
+        _zero_cotangent(y)
+
+
+pipeline_loss_1f1b.defvjp(_pl_fwd, _pl_bwd)

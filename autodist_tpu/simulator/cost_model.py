@@ -36,6 +36,14 @@ CHIP_HBM_BYTES = {
 # forward in the backward (fwd+bwd ~3x fwd -> ~4x), "dots" recomputes
 # only the cheap non-contraction work (~3.5x)
 REMAT_COMPUTE_FACTOR = {None: 1.0, "full": 4.0 / 3.0, "dots": 3.5 / 3.0}
+# Honest price of the CURRENT fused 1F1B implementation
+# (parallel/pipeline._run_1f1b): 2(M+S-1) ticks, each executing BOTH a
+# stage forward and a recompute+backward vjp with jnp.where discarding
+# the idle half — ~8(M+S-1) fwd-units vs GPipe's ~3(M+S-1), i.e. 8/3
+# over the bubble-adjusted compute. A lax.cond tick body would halve
+# this (branch parity is uniform over the model/data axes, so in-branch
+# collectives stay matched) — priced here as implemented, not as hoped.
+F1B_RECOMPUTE_FACTOR = 8.0 / 3.0
 DEFAULT_MXU_EFFICIENCY = 0.4      # achieved/peak for typical training steps
 WIRE_DTYPE_BYTES = 4              # gradients travel fp32 unless compressed
 # host<->device link for the host-offloaded PS path (no-proxy PS keeps
@@ -347,6 +355,14 @@ class CostModel:
         else:
             act = total_act + batch_in
         act /= n  # activations scale with the per-device batch shard
+        # 1F1B pipeline schedule: at most S microbatches in flight per
+        # rank vs GPipe's all-M residency (Narayanan et al. 1806.03377)
+        from autodist_tpu import const as _const
+        mesh = strategy.graph_config.mesh_shape or {}
+        pp = int(mesh.get(_const.PIPELINE_AXIS, 1))
+        m = int(strategy.graph_config.pp_microbatches or 1)
+        if pp > 1 and strategy.graph_config.pp_schedule == "1f1b" and m > pp:
+            act *= pp / m
         return device_params + opt_bytes + grad_bytes + act
 
     def _wire_bytes(self, info, sync, compressed: bool = True) -> float:
@@ -440,6 +456,11 @@ class CostModel:
         if pp > 1:
             m = int(strategy.graph_config.pp_microbatches or 1)
             compute_s *= (pp - 1 + m) / m
+            if strategy.graph_config.pp_schedule == "1f1b":
+                # the fused schedule recomputes each stage forward from
+                # the stashed input in its backward tick (per-microbatch
+                # remat): ~one extra forward on top of fwd+bwd
+                compute_s *= F1B_RECOMPUTE_FACTOR
         mp_s = self.mp_comm_time(strategy, ici_bw)
         cal = self.calibration
         if cal is not None:

@@ -27,15 +27,19 @@ class PipelineParallel(AllReduce):
     def __init__(self, pp_shards: int, mp_rules: MpRules,
                  n_microbatches: int = 4, tp_shards: int = 1,
                  chunk_size: int = 128, all_reduce_spec: str = "AUTO",
-                 compressor: str = "NoneCompressor"):
+                 compressor: str = "NoneCompressor",
+                 schedule: str = "gpipe"):
         super().__init__(chunk_size, all_reduce_spec, compressor)
         if pp_shards < 1 or tp_shards < 1:
             raise ValueError("pp_shards/tp_shards must be >= 1")
         if n_microbatches < 1:
             raise ValueError("n_microbatches must be >= 1")
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError("schedule must be 'gpipe' or '1f1b'")
         self.pp_shards = pp_shards
         self.tp_shards = tp_shards
         self.n_microbatches = n_microbatches
+        self.schedule = schedule
         self.mp_rules = list(mp_rules)
 
     def build(self, model_item, resource_spec) -> Strategy:
@@ -53,9 +57,11 @@ class PipelineParallel(AllReduce):
             mesh_shape[const.MODEL_AXIS] = self.tp_shards
         strategy.graph_config.mesh_shape = mesh_shape
         strategy.graph_config.pp_microbatches = self.n_microbatches
+        strategy.graph_config.pp_schedule = self.schedule
         add_frozen_nodes(strategy, model_item)
         n = apply_mp_rules(strategy, self.mp_rules)
         logging.info("PipelineParallel: %d/%d vars pipe-sharded, mesh %s, "
-                     "%d microbatches", n, len(strategy.node_config),
-                     mesh_shape, self.n_microbatches)
+                     "%d microbatches, %s schedule", n,
+                     len(strategy.node_config), mesh_shape,
+                     self.n_microbatches, self.schedule)
         return strategy
