@@ -75,3 +75,17 @@ def is_scalar_leaf(leaf) -> bool:
 
 def tree_zeros_like(tree):
     return jax.tree_util.tree_map(lambda x: jax.numpy.zeros_like(x), tree)
+
+
+def zero_cotangent(x):
+    """Zero cotangent for a possibly-integer operand — float0 for
+    non-inexact dtypes (the tangent type JAX assigns non-differentiable
+    inputs in custom_vjp backward rules)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    if x is None:
+        return None
+    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+        return jnp.zeros_like(x)
+    return np.zeros(jnp.shape(x), jax.dtypes.float0)

@@ -82,11 +82,12 @@ class BertEncoder(nn.Module):
 
 class BertForMLM(nn.Module):
     config: BertConfig
+    attn_fn: Optional[Any] = None
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
         cfg = self.config
-        encoder = BertEncoder(cfg, name="encoder")
+        encoder = BertEncoder(cfg, attn_fn=self.attn_fn, name="encoder")
         x = encoder(input_ids, token_type_ids, attention_mask)
         x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_transform")(x)
         x = nn.gelu(x)
@@ -97,10 +98,30 @@ class BertForMLM(nn.Module):
 
 
 def make_train_setup(config: Optional[BertConfig] = None, seq_len: int = 128,
-                     batch_size: int = 32, seed: int = 0):
-    """(loss_fn, params, example_batch, apply_fn) — masked-LM objective."""
+                     batch_size: int = 32, seed: int = 0,
+                     attention: str = "auto"):
+    """(loss_fn, params, example_batch, apply_fn) — masked-LM objective.
+
+    ``attention``: "xla" (fused XLA attention), "flash" (the pallas kernel
+    with the padding ``attention_mask`` as segment ids,
+    ``ops/flash_attention.py``), or "auto" (default): XLA below 8192
+    tokens, flash at or above. Measured on the v5e chip (BENCHMARKS.md):
+    for masked bidirectional attention XLA is FASTER at every length that
+    fits (~1.8x at 512-4096), but it materializes the [S, S] logits and
+    fails to compile by seq 8192 at bert-base geometry — the flash
+    kernel's O(S) memory is what extends BERT past that wall, so "auto"
+    switches exactly where XLA stops being an option.
+    """
     cfg = config or BertConfig.base()
-    model = BertForMLM(cfg)
+    if attention == "auto":
+        attention = "flash" if seq_len >= 8192 else "xla"
+    attn_fn = None
+    if attention == "flash":
+        from autodist_tpu.ops.flash_attention import make_flash_attn_fn
+        attn_fn = make_flash_attn_fn(causal=False)
+    elif attention != "xla":
+        raise ValueError("attention must be 'auto', 'flash' or 'xla'")
+    model = BertForMLM(cfg, attn_fn=attn_fn)
     rng = jax.random.PRNGKey(seed)
     ids0 = jnp.zeros((1, seq_len), jnp.int32)
     # jitted init: ONE device dispatch for the whole parameter tree

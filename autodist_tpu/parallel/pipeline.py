@@ -259,12 +259,8 @@ def pipeline_loss_1f1b(stage_fn, head_fn, stage_params, head_params, x, y,
 
 
 def _zero_cotangent(y):
-    """Zero cotangent for the targets — float0 for integer dtypes (the
-    tangent type JAX assigns non-differentiable inputs)."""
-    import numpy as _np
-    if jnp.issubdtype(jnp.asarray(y).dtype, jnp.inexact):
-        return jnp.zeros_like(y)
-    return _np.zeros(jnp.shape(y), jax.dtypes.float0)
+    from autodist_tpu.kernel.common.variable_utils import zero_cotangent
+    return zero_cotangent(y)
 
 
 def _pl_fwd(stage_fn, head_fn, stage_params, head_params, x, y,
