@@ -48,6 +48,18 @@
 //   QLEN <q>                  -> VAL <n>
 //   SHUTDOWN                  -> OK (then exits)
 //
+// Binary blob framing (round 4): the b64 text forms above cost +33% wire
+// and an encode/decode pass on every gradient/value blob. The B-suffixed
+// variants carry the payload as RAW bytes, length-prefixed by the header
+// line (the control plane stays newline-delimited text):
+//   BPUTB <key> <ver> <n>\n<n raw bytes>   -> OK
+//   BGETB <key>               -> BVALB <ver> <n>\n<n raw bytes> | NONE
+//   QPUSHB <q> <n>\n<n raw bytes>          -> OK | ERR queue full
+//   QPOPB <q>                 -> QVALB <n>\n<n raw bytes> | NONE
+// Blobs are stored raw either way; text and binary commands interoperate
+// on the same keys/queues (text reads of binary-written blobs b64-encode
+// on the way out).
+//
 // The blob commands are the wire of the ASYNC parameter-server path
 // (autodist_tpu/runtime/ps_service.py): the owner publishes versioned
 // parameter blobs with BPUT, workers fetch with BGET and push gradient
@@ -79,6 +91,56 @@
 
 namespace {
 
+const char kB64[] =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+std::string B64Encode(const std::string& in) {
+  std::string out;
+  out.reserve(((in.size() + 2) / 3) * 4);
+  size_t i = 0;
+  for (; i + 2 < in.size(); i += 3) {
+    unsigned v = (static_cast<unsigned char>(in[i]) << 16) |
+                 (static_cast<unsigned char>(in[i + 1]) << 8) |
+                 static_cast<unsigned char>(in[i + 2]);
+    out += kB64[(v >> 18) & 63]; out += kB64[(v >> 12) & 63];
+    out += kB64[(v >> 6) & 63]; out += kB64[v & 63];
+  }
+  if (i < in.size()) {
+    unsigned v = static_cast<unsigned char>(in[i]) << 16;
+    bool two = i + 1 < in.size();
+    if (two) v |= static_cast<unsigned char>(in[i + 1]) << 8;
+    out += kB64[(v >> 18) & 63]; out += kB64[(v >> 12) & 63];
+    out += two ? kB64[(v >> 6) & 63] : '=';
+    out += '=';
+  }
+  return out;
+}
+
+std::string B64Decode(const std::string& in) {
+  static int rev[256];
+  static bool init = false;
+  if (!init) {
+    for (int i = 0; i < 256; ++i) rev[i] = -1;
+    for (int i = 0; i < 64; ++i) rev[static_cast<unsigned char>(kB64[i])] = i;
+    init = true;
+  }
+  std::string out;
+  out.reserve((in.size() / 4) * 3);
+  unsigned v = 0;
+  int bits = 0;
+  for (char c : in) {
+    int d = rev[static_cast<unsigned char>(c)];
+    if (d < 0) continue;  // '=' padding / whitespace
+    v = (v << 6) | d;
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out += static_cast<char>((v >> bits) & 0xFF);
+    }
+  }
+  return out;
+}
+
 double NowSeconds() {
   struct timespec ts;
   clock_gettime(CLOCK_MONOTONIC, &ts);
@@ -99,6 +161,12 @@ struct Conn {
   int fd;
   std::string inbuf;
   std::string outbuf;
+  size_t out_off = 0;  // sent prefix of outbuf (offset beats erase():
+                       // an 8 MB blob would memmove itself per send)
+  // binary framing: >0 while awaiting this many raw payload bytes for the
+  // parked command below
+  size_t bin_need = 0;
+  std::vector<std::string> bin_args;
 };
 
 class Server {
@@ -132,7 +200,7 @@ class Server {
       fds.push_back({listen_fd_, POLLIN, 0});
       for (auto& [fd, conn] : conns_) {
         short events = POLLIN;
-        if (!conn.outbuf.empty()) events |= POLLOUT;
+        if (conn.out_off < conn.outbuf.size()) events |= POLLOUT;
         fds.push_back({fd, events, 0});
       }
       int rc = poll(fds.data(), fds.size(), 1000);
@@ -166,7 +234,8 @@ class Server {
   }
 
   bool ReadFrom(Conn& conn) {
-    char buf[4096];
+    char buf[262144];  // blob-sized reads: 4 KB would cost one syscall
+                       // per 4 KB of a multi-MB gradient payload
     while (true) {
       ssize_t n = recv(conn.fd, buf, sizeof(buf), 0);
       if (n > 0) {
@@ -178,8 +247,17 @@ class Server {
         return false;
       }
     }
-    size_t pos;
-    while ((pos = conn.inbuf.find('\n')) != std::string::npos) {
+    while (true) {
+      if (conn.bin_need > 0) {
+        if (conn.inbuf.size() < conn.bin_need) break;  // payload incomplete
+        std::string payload = conn.inbuf.substr(0, conn.bin_need);
+        conn.inbuf.erase(0, conn.bin_need);
+        conn.bin_need = 0;
+        HandleBinaryPayload(conn, std::move(payload));
+        continue;
+      }
+      size_t pos = conn.inbuf.find('\n');
+      if (pos == std::string::npos) break;
       std::string line = conn.inbuf.substr(0, pos);
       conn.inbuf.erase(0, pos + 1);
       if (!line.empty() && line.back() == '\r') line.pop_back();
@@ -215,15 +293,17 @@ class Server {
   }
 
   void Flush(Conn& conn) {
-    while (!conn.outbuf.empty()) {
-      ssize_t n = send(conn.fd, conn.outbuf.data(), conn.outbuf.size(),
-                       MSG_NOSIGNAL);
+    while (conn.out_off < conn.outbuf.size()) {
+      ssize_t n = send(conn.fd, conn.outbuf.data() + conn.out_off,
+                       conn.outbuf.size() - conn.out_off, MSG_NOSIGNAL);
       if (n > 0) {
-        conn.outbuf.erase(0, n);
+        conn.out_off += static_cast<size_t>(n);
       } else {
-        break;  // EAGAIN or error; poll will retry / detect close
+        return;  // EAGAIN or error; poll will retry / detect close
       }
     }
+    conn.outbuf.clear();
+    conn.out_off = 0;
   }
 
   void Handle(Conn& conn, const std::string& line) {
@@ -287,7 +367,9 @@ class Server {
       }
       Reply(conn, dead.empty() ? "NONE" : "VAL " + dead);
     } else if (cmd == "BPUT" && parts.size() == 4) {
-      blobs_[parts[1]] = {atol(parts[2].c_str()), parts[3]};
+      // storage is RAW bytes for both wire forms; the text form carries
+      // b64 and converts at the boundary
+      blobs_[parts[1]] = {atol(parts[2].c_str()), B64Decode(parts[3])};
       Reply(conn, "OK");
     } else if (cmd == "BGET" && parts.size() == 2) {
       auto it = blobs_.find(parts[1]);
@@ -295,7 +377,7 @@ class Server {
         Reply(conn, "NONE");
       } else {
         Reply(conn, "BVAL " + std::to_string(it->second.first) + " " +
-                        it->second.second);
+                        B64Encode(it->second.second));
       }
     } else if (cmd == "QPUSH" && parts.size() == 3) {
       // cap: a queue nobody drains (dead owner) must not eat the host's
@@ -304,7 +386,7 @@ class Server {
       if (q.size() >= kMaxQueueLen) {
         Reply(conn, "ERR queue full");
       } else {
-        q.push_back(parts[2]);
+        q.push_back(B64Decode(parts[2]));
         Reply(conn, "OK");
       }
     } else if (cmd == "QPOP" && parts.size() == 2) {
@@ -312,19 +394,73 @@ class Server {
       if (it == queues_.end() || it->second.empty()) {
         Reply(conn, "NONE");
       } else {
-        Reply(conn, "QVAL " + it->second.front());
+        Reply(conn, "QVAL " + B64Encode(it->second.front()));
         it->second.pop_front();
       }
     } else if (cmd == "QLEN" && parts.size() == 2) {
       auto it = queues_.find(parts[1]);
       long n = (it == queues_.end()) ? 0 : static_cast<long>(it->second.size());
       Reply(conn, "VAL " + std::to_string(n));
+    } else if (cmd == "BPUTB" && parts.size() == 4) {
+      long n = atol(parts[3].c_str());
+      if (n < 0 || n > kMaxBlobBytes) {
+        Reply(conn, "ERR bad length");  // a malformed frame must not park
+      } else {                          // the parser on 2^64 bytes forever
+        conn.bin_args = {cmd, parts[1], parts[2]};
+        conn.bin_need = static_cast<size_t>(n);
+        if (conn.bin_need == 0) HandleBinaryPayload(conn, "");
+      }
+    } else if (cmd == "QPUSHB" && parts.size() == 3) {
+      long n = atol(parts[2].c_str());
+      if (n < 0 || n > kMaxBlobBytes) {
+        Reply(conn, "ERR bad length");
+      } else {
+        conn.bin_args = {cmd, parts[1]};
+        conn.bin_need = static_cast<size_t>(n);
+        if (conn.bin_need == 0) HandleBinaryPayload(conn, "");
+      }
+    } else if (cmd == "BGETB" && parts.size() == 2) {
+      auto it = blobs_.find(parts[1]);
+      if (it == blobs_.end()) {
+        Reply(conn, "NONE");
+      } else {
+        Reply(conn, "BVALB " + std::to_string(it->second.first) + " " +
+                        std::to_string(it->second.second.size()));
+        conn.outbuf += it->second.second;  // raw, length-prefixed above
+      }
+    } else if (cmd == "QPOPB" && parts.size() == 2) {
+      auto it = queues_.find(parts[1]);
+      if (it == queues_.end() || it->second.empty()) {
+        Reply(conn, "NONE");
+      } else {
+        Reply(conn, "QVALB " + std::to_string(it->second.front().size()));
+        conn.outbuf += it->second.front();
+        it->second.pop_front();
+      }
     } else if (cmd == "SHUTDOWN") {
       Reply(conn, "OK");
       Flush(conn);
       shutdown_ = true;
     } else {
       Reply(conn, "ERR unknown command");
+    }
+  }
+
+  void HandleBinaryPayload(Conn& conn, std::string payload) {
+    std::vector<std::string> args;
+    args.swap(conn.bin_args);
+    if (args.empty()) return;
+    if (args[0] == "BPUTB") {
+      blobs_[args[1]] = {atol(args[2].c_str()), std::move(payload)};
+      Reply(conn, "OK");
+    } else if (args[0] == "QPUSHB") {
+      auto& q = queues_[args[1]];
+      if (q.size() >= kMaxQueueLen) {
+        Reply(conn, "ERR queue full");
+      } else {
+        q.push_back(std::move(payload));
+        Reply(conn, "OK");
+      }
     }
   }
 
@@ -366,6 +502,9 @@ class Server {
   std::map<int, Conn> conns_;
   std::map<std::string, std::string> kv_;
   static constexpr size_t kMaxQueueLen = 4096;
+  // binary-frame payload cap: far above any gradient blob, far below
+  // anything that could park the parser / eat host memory
+  static constexpr long kMaxBlobBytes = 1L << 31;  // 2 GB
   std::map<std::string, std::pair<long, std::string>> blobs_;
   std::map<std::string, std::deque<std::string>> queues_;
   std::map<std::string, long> counters_;

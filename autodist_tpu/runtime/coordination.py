@@ -95,15 +95,33 @@ class CoordinationClient:
         self._sock.settimeout(timeout)
         self._buf = b""
 
-    def _cmd(self, line: str) -> str:
-        self._sock.sendall(line.encode() + b"\n")
+    def _recv_line(self) -> str:
         while b"\n" not in self._buf:
-            chunk = self._sock.recv(4096)
+            chunk = self._sock.recv(262144)
             if not chunk:
                 raise OSError("coordination service closed connection")
             self._buf += chunk
         resp, self._buf = self._buf.split(b"\n", 1)
         return resp.decode().strip()
+
+    def _recv_raw(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self._sock.recv(262144)
+            if not chunk:
+                raise OSError("coordination service closed connection")
+            self._buf += chunk
+        payload, self._buf = self._buf[:n], self._buf[n:]
+        return payload
+
+    def _cmd(self, line: str) -> str:
+        self._sock.sendall(line.encode() + b"\n")
+        return self._recv_line()
+
+    def _cmd_raw(self, header: str, payload: bytes) -> str:
+        """Length-prefixed binary frame: header line then raw payload
+        (the B-suffixed service commands) — no base64 inflation."""
+        self._sock.sendall(header.encode() + b"\n" + payload)
+        return self._recv_line()
 
     # ----------------------------------------------------------------- api
 
@@ -147,34 +165,31 @@ class CoordinationClient:
     #      raw bytes, base64'd on the line protocol)
 
     def bput(self, key: str, version: int, payload: bytes):
-        import base64
-        b64 = base64.b64encode(payload).decode()
-        assert self._cmd("BPUT %s %d %s" % (key, version, b64)) == "OK"
+        """Publish a versioned blob (binary frame — raw bytes on the wire)."""
+        resp = self._cmd_raw("BPUTB %s %d %d" % (key, version, len(payload)),
+                             payload)
+        assert resp == "OK", resp
 
     def bget(self, key: str):
-        """-> (version, payload bytes) or None."""
-        import base64
-        resp = self._cmd("BGET %s" % key)
+        """(version, payload) of the latest published blob, or None."""
+        resp = self._cmd("BGETB %s" % key)
         if resp == "NONE":
             return None
-        _, ver, b64 = resp.split(" ", 2)
-        return int(ver), base64.b64decode(b64)
+        _, ver, n = resp.split(" ", 2)
+        return int(ver), self._recv_raw(int(n))
 
     def qpush(self, queue: str, payload: bytes):
-        import base64
-        b64 = base64.b64encode(payload).decode()
-        resp = self._cmd("QPUSH %s %s" % (queue, b64))
+        """Enqueue a blob (binary frame); raises when the service's queue
+        cap rejects it (dead-owner backpressure)."""
+        resp = self._cmd_raw("QPUSHB %s %d" % (queue, len(payload)), payload)
         if resp != "OK":
-            # the service rejects pushes past its size cap rather than
-            # letting an orphaned queue eat the host's memory
             raise RuntimeError("qpush rejected: %s" % resp)
 
     def qpop(self, queue: str):
-        import base64
-        resp = self._cmd("QPOP %s" % queue)
+        resp = self._cmd("QPOPB %s" % queue)
         if resp == "NONE":
             return None
-        return base64.b64decode(resp[5:])
+        return self._recv_raw(int(resp.split(" ", 1)[1]))
 
     def qlen(self, queue: str) -> int:
         return int(self._cmd("QLEN %s" % queue)[4:])

@@ -174,3 +174,32 @@ def test_goodbye_deregisters(server):
     assert "w7" not in c.dead_workers(0.1)
     assert c.min_step() != -100  # no longer bounds the staleness window
     c.close()
+
+
+def test_binary_blob_roundtrip_and_text_interop(server):
+    """The binary frames (BPUTB/BGETB/QPUSHB/QPOPB) carry RAW payloads;
+    storage is raw for both wire forms, so text b64 commands interoperate
+    on the same keys/queues."""
+    import base64
+    c = _client()
+    payload = bytes(range(256)) * 64 + b"\n\r binary-hostile \x00\xff"
+    c.bput("bin/key", 7, payload)            # binary publish
+    got = c.bget("bin/key")                  # binary fetch
+    assert got == (7, payload)
+    # text fetch of the binary-written blob: b64 at the boundary
+    resp = c._cmd("BGET bin/key")
+    assert resp.startswith("BVAL 7 ")
+    assert base64.b64decode(resp.split(" ", 2)[2]) == payload
+    # text publish, binary fetch
+    c._cmd("BPUT bin/key2 3 %s" % base64.b64encode(payload).decode())
+    assert c.bget("bin/key2") == (3, payload)
+    # queues: binary push, binary pop; then text pop sees raw->b64
+    c.qpush("bin/q", payload)
+    c.qpush("bin/q", payload)
+    assert c.qpop("bin/q") == payload
+    resp = c._cmd("QPOP bin/q")
+    assert base64.b64decode(resp[5:]) == payload
+    # empty payload edge
+    c.bput("bin/empty", 1, b"")
+    assert c.bget("bin/empty") == (1, b"")
+    c.close()
