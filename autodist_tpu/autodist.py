@@ -261,11 +261,31 @@ class AutoDist:
         is_async = self._validate_async(compiled, item)
         if (const.ENV.ADT_ELASTIC.val > 0 and not is_async
                 and const.ENV.ADT_NUM_PROCESSES.val > 1):
+            # sync strategies are collective-lockstep: a relaunched worker
+            # cannot rejoin mid-run, so elastic means checkpoint-restore
+            # orchestration — worker death tears the whole mesh down and
+            # the chief re-execs with auto-resume (the coordinator's
+            # _restart_whole_job). Auto-resume needs periodic saves:
+            # Runner.fit(save_every=...) or explicit Saver.save calls.
+            if not const.ENV.ADT_ELASTIC_SYNC.val:
+                raise ValueError(
+                    "ADT_ELASTIC on a sync strategy needs "
+                    "ADT_ELASTIC_SYNC=1 at bring-up (the jax.distributed "
+                    "join was skipped for the async-elastic flow and "
+                    "cannot happen retroactively). Set ADT_ELASTIC_SYNC=1 "
+                    "for whole-job checkpoint-restore recovery, or use an "
+                    "async host-PS strategy (e.g. PS(sync=False))")
+            if self._coordinator is not None:
+                self._coordinator.enable_sync_elastic()
+            logging.info(
+                "ADT_ELASTIC on a sync strategy: whole-job checkpoint-"
+                "restore recovery enabled (resume dir: %s)",
+                const.ENV.ADT_CKPT_DIR.val)
+        if is_async and const.ENV.ADT_ELASTIC_SYNC.val:
             raise ValueError(
-                "ADT_ELASTIC requires an async host-PS strategy (e.g. "
-                "PS(sync=False)): sync strategies are collective-lockstep, "
-                "so a relaunched worker cannot rejoin mid-run — resume "
-                "those from a checkpoint instead")
+                "ADT_ELASTIC_SYNC is set but the strategy is async PS: "
+                "unset it — async elastic restarts workers individually "
+                "and must not pin the process set with jax.distributed")
         if is_async:
             # async PS cannot ride global collectives (they are lockstep):
             # each process runs its OWN local mesh — the reference's

@@ -87,6 +87,18 @@ class ENV(Enum):
     # liveness window (seconds): workers heartbeat every quarter of it;
     # the chief's watchdog treats silence longer than it as death/deadlock
     ADT_HEARTBEAT_TIMEOUT_S = ("ADT_HEARTBEAT_TIMEOUT_S", float, 60.0)
+    # sync-elastic bring-up: with ADT_ELASTIC, declares the job's strategy
+    # SYNCHRONOUS so processes still join jax.distributed (lockstep
+    # collectives need the global mesh; recovery is whole-job re-exec with
+    # a fresh process set, not per-worker rejoin)
+    ADT_ELASTIC_SYNC = ("ADT_ELASTIC_SYNC", bool, False)
+    # sync-elastic recovery (runtime/coordinator.py _restart_whole_job):
+    # set on the re-exec'd job so Runner.init restores the latest
+    # checkpoint from ADT_CKPT_DIR instead of starting fresh. Users can
+    # also set it for at-most-once resume semantics on any job.
+    ADT_AUTO_RESUME = ("ADT_AUTO_RESUME", bool, False)
+    # checkpoint directory the auto-resume (and its periodic saves) use
+    ADT_CKPT_DIR = ("ADT_CKPT_DIR", str, DEFAULT_CHECKPOINT_DIR)
     # host-PS transfer/compute overlap (parallel/ps.py PSPipeline): 1 =
     # background push + prefetched pull (bit-exact for sync PS; with
     # staleness>=1 or async serving the prefetch overlaps compute fully);

@@ -107,14 +107,19 @@ class Cluster:
                 self._coordsvc = CoordinationServer(self.coordsvc_port)
                 self._coordsvc.start()
                 atexit.register(self._coordsvc.stop)
+
             except (RuntimeError, TimeoutError, OSError,
                     subprocess.CalledProcessError) as e:
                 logging.warning("coordination service unavailable: %s", e)
                 self._coordsvc = None
         from autodist_tpu.runtime import server_starter
-        if const.ENV.ADT_ELASTIC.val > 0:
+        if (const.ENV.ADT_ELASTIC.val > 0
+                and not const.ENV.ADT_ELASTIC_SYNC.val):
             # elastic async-PS jobs keep the process set OPEN (workers may
-            # die and be relaunched); jax.distributed would pin it shut
+            # die and be relaunched); jax.distributed would pin it shut.
+            # Sync-elastic (ADT_ELASTIC_SYNC) joins: lockstep collectives
+            # need the global mesh, and recovery is a whole-job re-exec
+            # with a fresh process set rather than a rejoin.
             logging.info("elastic mode: chief not joining jax.distributed")
             server_starter.mark_elastic_started()
         else:
@@ -125,6 +130,19 @@ class Cluster:
                     const.ENV.ADT_WORKER.val or self._spec.chief))
         atexit.register(self.terminate)
         self._started = True
+
+    def stop_coordination_service(self):
+        """Stop the service child this cluster started (sync-elastic
+        re-exec: os.execv skips atexit, and an orphaned server would hold
+        the port and carry the crashed incarnation's state into the
+        resumed job)."""
+        svc = getattr(self, "_coordsvc", None)
+        if svc is not None:
+            try:
+                svc.stop()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+            self._coordsvc = None
 
     def terminate(self, grace_s: float = 10.0):
         """Terminate launched worker process groups (reference

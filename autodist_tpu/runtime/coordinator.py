@@ -92,7 +92,15 @@ class Coordinator:
         self._restart_at: dict = {}        # address -> last relaunch time
         self._launch_cmds: dict = {}       # address -> (command, env)
         self._live_procs: dict = {}        # address -> current launcher proc
+        # sync-elastic (checkpoint-restore orchestration): worker death
+        # restarts the WHOLE job from the latest checkpoint instead of
+        # relaunching one worker (autodist.py enables it for sync
+        # strategies under ADT_ELASTIC)
+        self._sync_elastic = False
         atexit.register(self.join)
+
+    def enable_sync_elastic(self):
+        self._sync_elastic = True
 
     def start_watchdog(self):
         """Heartbeat-based failure detection via the coordination service
@@ -206,6 +214,8 @@ class Coordinator:
             # (reference coordinator.py:70-79)
             for e in (const.ENV.ADT_MIN_LOG_LEVEL, const.ENV.ADT_IS_TESTING,
                       const.ENV.ADT_PATCH_OPTAX, const.ENV.ADT_ELASTIC,
+                      const.ENV.ADT_ELASTIC_SYNC, const.ENV.ADT_AUTO_RESUME,
+                      const.ENV.ADT_CKPT_DIR,
                       const.ENV.ADT_HEARTBEAT_TIMEOUT_S):
                 raw = os.environ.get(e.name_str)
                 if raw is not None:
@@ -253,7 +263,13 @@ class Coordinator:
     def _try_restart(self, address: str, code, old_proc=None) -> bool:
         """Relaunch a dead worker when (a) restart budget remains and
         (b) the job's strategy makes a restart SOUND. Returns True when a
-        relaunch happened (the new process is supervised like the first)."""
+        relaunch happened (the new process is supervised like the first).
+
+        Sync-elastic jobs take the whole-job path instead: lockstep peers
+        are wedged in a collective the dead worker will never re-enter, so
+        the only sound recovery is tear-down + relaunch-from-checkpoint."""
+        if self._sync_elastic:
+            return self._restart_whole_job(address, code)
         used = self._restarts.get(address, 0)
         if self._max_restarts <= used or address not in self._launch_cmds:
             return False
@@ -289,6 +305,57 @@ class Coordinator:
         self._live_procs[address] = proc
         self._proc_wait_async(proc, address)
         return True
+
+    def _restart_whole_job(self, address: str, code) -> bool:
+        """Sync-elastic recovery: a worker died mid-lockstep, so the
+        surviving processes (including THIS chief, whose main thread is
+        wedged in a collective the dead worker will never re-enter) cannot
+        continue. Reap every worker incarnation, then re-exec the chief's
+        own script with ``ADT_AUTO_RESUME=1`` — the fresh run relaunches
+        the workers, and every process restores the latest checkpoint
+        (``Runner.init``'s auto-resume) before training resumes. The
+        restart budget is carried across the exec in
+        ``ADT_ELASTIC_RESTARTS``. Returns False (fail-fast) when the
+        budget is spent."""
+        used = int(os.environ.get("ADT_ELASTIC_RESTARTS", "0"))
+        if used >= self._max_restarts or not self._launch_cmds:
+            logging.error(
+                "sync-elastic: worker %s died (code %s) but the restart "
+                "budget (%d) is spent — failing fast", address, code,
+                self._max_restarts)
+            return False
+        logging.warning(
+            "sync-elastic: worker %s died (code %s) mid-lockstep — "
+            "restarting the WHOLE job from the latest checkpoint "
+            "(restart %d/%d)", address, code, used + 1, self._max_restarts)
+        # silence the other watchers first: the reap below kills their
+        # processes, which must read as shutdown, not as fresh failures
+        self._stop_watchdog.set()
+        for addr, (command, _env) in sorted(self._launch_cmds.items()):
+            try:
+                self._reap_incarnation(addr, command,
+                                       self._live_procs.get(addr))
+            except Exception as e:  # noqa: BLE001 — best-effort teardown
+                logging.warning("reap of %s failed: %s", addr, e)
+        # stop the coordination-service child: exec skips atexit, and an
+        # orphan would hold the port (EADDRINUSE for the resumed job's
+        # fresh server) while carrying stale heartbeat/queue/barrier state
+        self._cluster.stop_coordination_service()
+        os.environ["ADT_ELASTIC_RESTARTS"] = str(used + 1)
+        os.environ[const.ENV.ADT_AUTO_RESUME.name_str] = "1"
+        # scrub what THIS incarnation's _setup exported: the fresh chief
+        # must look like a first start (else maybe_init_distributed joins
+        # from the inherited process count BEFORE the workers are launched
+        # and wedges waiting for them)
+        os.environ.pop(const.ENV.ADT_NUM_PROCESSES.name_str, None)
+        os.environ.pop(const.ENV.ADT_STRATEGY_ID.name_str, None)
+        logging.warning("sync-elastic: re-exec %s %s", sys.executable,
+                        " ".join(sys.argv))
+        sys.stdout.flush()
+        sys.stderr.flush()
+        # exec replaces the process image: the wedged main thread, the
+        # jax.distributed client state, and the atexit chain all go with it
+        os.execv(sys.executable, [sys.executable] + sys.argv)
 
     def _reap_incarnation(self, address: str, command: str, old_proc):
         """Make sure the PREVIOUS incarnation is really gone before its

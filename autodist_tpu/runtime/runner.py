@@ -104,8 +104,25 @@ class Runner:
 
     def init(self, params, opt_state=None) -> TrainState:
         """Initialize distributed state (the reference's auto-run of
-        initializers on session creation, ``runner.py:97-100``)."""
+        initializers on session creation, ``runner.py:97-100``).
+
+        Under ``ADT_AUTO_RESUME`` (set by the sync-elastic whole-job
+        restart, or by the user for at-most-once resume), a committed
+        checkpoint in ``ADT_CKPT_DIR`` is restored over the fresh init —
+        every process calls init(), so the restore's collective placement
+        runs everywhere."""
         self.state = self._dstep.init_state(params, opt_state)
+        if const.ENV.ADT_AUTO_RESUME.val:
+            from autodist_tpu.checkpoint.saver import Saver
+            saver = Saver(directory=const.ENV.ADT_CKPT_DIR.val)
+            if saver.latest() is not None:
+                _, step = saver.restore(self)
+                logging.warning("ADT_AUTO_RESUME: restored step %d from %s",
+                                step, const.ENV.ADT_CKPT_DIR.val)
+            else:
+                logging.warning("ADT_AUTO_RESUME set but no checkpoint in "
+                                "%s; starting fresh",
+                                const.ENV.ADT_CKPT_DIR.val)
         return self.state
 
     _RECENT_WINDOW = 512

@@ -66,7 +66,7 @@ def maybe_init_distributed():
     never join: jax.distributed pins a fixed process set for the job's
     lifetime, while elastic async PS needs workers to come and go — they
     couple through the coordination service alone."""
-    if const.ENV.ADT_ELASTIC.val > 0:
+    if const.ENV.ADT_ELASTIC.val > 0 and not const.ENV.ADT_ELASTIC_SYNC.val:
         if const.ENV.ADT_EXTERNAL_LAUNCH.val:
             # external launchers own process lifecycles (no Coordinator to
             # relaunch anything) AND their strategy handoff is a collective
