@@ -131,9 +131,12 @@ class AutoDist:
         if const.ENV.ADT_ELASTIC.val > 0:
             # async workers heartbeat time-based (runner.py); the watchdog
             # turns silence-while-alive (deadlock) into a kill that the
-            # process watcher answers with an elastic relaunch. Sync jobs
-            # don't run it: a >timeout gap between their steps (long eval,
-            # slow data) would read as death.
+            # process watcher answers with an elastic relaunch — or, for
+            # sync-elastic jobs, with the whole-job restart. Sync workers
+            # write no heartbeat records (a >timeout gap between lockstep
+            # steps — long eval, slow data — would read as death), so for
+            # them the watchdog is a no-op and a wedge surfaces as a
+            # collective timeout -> process death -> the same recovery.
             self._coordinator.start_watchdog()
         # atexit runs LIFO: this must fire BEFORE cluster.terminate (the
         # registration inside start()) so a clean exit flags the watchers
@@ -281,7 +284,8 @@ class AutoDist:
                 "ADT_ELASTIC on a sync strategy: whole-job checkpoint-"
                 "restore recovery enabled (resume dir: %s)",
                 const.ENV.ADT_CKPT_DIR.val)
-        if is_async and const.ENV.ADT_ELASTIC_SYNC.val:
+        if (is_async and const.ENV.ADT_ELASTIC.val > 0
+                and const.ENV.ADT_ELASTIC_SYNC.val):
             raise ValueError(
                 "ADT_ELASTIC_SYNC is set but the strategy is async PS: "
                 "unset it — async elastic restarts workers individually "

@@ -144,10 +144,18 @@ class Coordinator:
                         continue
                     proc = self._live_procs.get(d)
                     if proc is not None and proc.poll() is None:
-                        # defense in depth: only kill when a relaunch would
-                        # actually be sound (build() already rejects
-                        # elastic+sync jobs, so this should always hold)
-                        if self._restart_unsound_reason(d) is not None:
+                        # defense in depth: only kill when SOME recovery is
+                        # sound — a per-worker relaunch (async), or the
+                        # whole-job checkpoint-restore restart (sync-
+                        # elastic: killing the wedged worker routes its
+                        # death to _restart_whole_job via the process
+                        # watcher). Note sync workers only write heartbeat
+                        # records in staleness-pacing modes; a silent
+                        # sync wedge otherwise surfaces as a collective
+                        # timeout -> process death -> the same path.
+                        if (not self._sync_elastic
+                                and self._restart_unsound_reason(d)
+                                is not None):
                             logging.warning(
                                 "worker %s missed heartbeats but a restart "
                                 "would be unsound — not killing it", d)
@@ -323,6 +331,19 @@ class Coordinator:
                 "sync-elastic: worker %s died (code %s) but the restart "
                 "budget (%d) is spent — failing fast", address, code,
                 self._max_restarts)
+            return False
+        from autodist_tpu.checkpoint.saver import Saver
+        ckpt_dir = const.ENV.ADT_CKPT_DIR.val
+        try:
+            has_ckpt = Saver(directory=ckpt_dir).latest() is not None
+        except OSError:
+            has_ckpt = False
+        if not has_ckpt:
+            logging.error(
+                "sync-elastic: worker %s died (code %s) before any "
+                "checkpoint landed in %s — nothing to restore, failing "
+                "fast (save at least once per restart window)", address,
+                code, ckpt_dir)
             return False
         logging.warning(
             "sync-elastic: worker %s died (code %s) mid-lockstep — "

@@ -111,18 +111,30 @@ class Runner:
         checkpoint in ``ADT_CKPT_DIR`` is restored over the fresh init —
         every process calls init(), so the restore's collective placement
         runs everywhere."""
-        self.state = self._dstep.init_state(params, opt_state)
         if const.ENV.ADT_AUTO_RESUME.val:
             from autodist_tpu.checkpoint.saver import Saver
             saver = Saver(directory=const.ENV.ADT_CKPT_DIR.val)
             if saver.latest() is not None:
+                # restore() builds the placed state itself — a fresh
+                # init_state first would materialize the whole tree on
+                # device just to throw it away
                 _, step = saver.restore(self)
                 logging.warning("ADT_AUTO_RESUME: restored step %d from %s",
                                 step, const.ENV.ADT_CKPT_DIR.val)
-            else:
-                logging.warning("ADT_AUTO_RESUME set but no checkpoint in "
-                                "%s; starting fresh",
-                                const.ENV.ADT_CKPT_DIR.val)
+                return self.state
+            if const.ENV.ADT_NUM_PROCESSES.val > 1:
+                # one process starting fresh while lockstep peers restore
+                # step N diverges every collective — fail loudly (usual
+                # cause: the checkpoint dir is not shared across hosts)
+                raise RuntimeError(
+                    "ADT_AUTO_RESUME is set but no checkpoint exists in "
+                    "%s on this process — a multi-process resume needs "
+                    "the checkpoint directory shared across hosts"
+                    % const.ENV.ADT_CKPT_DIR.val)
+            logging.warning("ADT_AUTO_RESUME set but no checkpoint in "
+                            "%s; starting fresh",
+                            const.ENV.ADT_CKPT_DIR.val)
+        self.state = self._dstep.init_state(params, opt_state)
         return self.state
 
     _RECENT_WINDOW = 512

@@ -1,0 +1,63 @@
+"""Exact space-to-depth stem: mathematically identical to conv7x7s2."""
+import sys, os, time
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import jax, numpy as np
+jax.config.update("jax_compilation_cache_dir", "/tmp/adt_jax_cache")
+import jax.numpy as jnp
+
+B = 256
+rng = np.random.RandomState(0)
+x = jnp.asarray(rng.randn(B, 224, 224, 3), jnp.bfloat16)
+W = jnp.asarray(rng.randn(7, 7, 3, 64) * 0.05, jnp.bfloat16)
+
+def conv_ref(x, W):
+    return jax.lax.conv_general_dilated(
+        x, W, (2, 2), [(3, 3), (3, 3)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+def s2d(x, b=2):
+    B_, H, Wd, C = x.shape
+    x = x.reshape(B_, H // b, b, Wd // b, b, C)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(B_, H // b, Wd // b, b * b * C)
+
+def make_w2(W):
+    # zero-pad 7x7 -> 8x8 so it aligns to 2x2 blocks with the pad-3 offset:
+    # out[i,j] = sum_{ky,kx} x[2i-3+ky, 2j-3+kx] W[ky,kx]
+    # let u = 2i-4+p (p=0..7), i.e. pad one leading zero row/col: ky = p-1
+    Wp = jnp.zeros((8, 8, 3, 64), W.dtype).at[1:, 1:].set(W)
+    # u = 2(i-2+dj)+o with dj=0..3, o=0..1 -> W2[dj,di,(o_r,o_c,c)]
+    W2 = Wp.reshape(4, 2, 4, 2, 3, 64)      # [djr, or, djc, oc, c, f]
+    W2 = W2.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 12, 64)
+    return W2
+
+def conv_s2d(x, W2):
+    xs = s2d(x, 2)  # [B,112,112,12], channel order (or, oc, c)
+    return jax.lax.conv_general_dilated(
+        xs, W2, (1, 1), [(2, 1), (2, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+W2 = make_w2(W)
+a = conv_ref(x[:2], W)
+b_ = conv_s2d(x[:2], W2)
+print("shapes", a.shape, b_.shape)
+err = float(jnp.abs(a.astype(jnp.float32) - b_.astype(jnp.float32)).max())
+print("max abs err:", err)
+
+def _sync(r):
+    float(jnp.sum(jax.tree_util.tree_leaves(r)[0].astype(jnp.float32)))
+
+def timeit(f, *args, steps=10):
+    _sync(f(*args))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        r = f(*args)
+    _sync(r)
+    return (time.perf_counter() - t0) / steps
+
+# fwd+bwd like training
+g_ref = jax.jit(jax.grad(lambda w, xx: jnp.sum(conv_ref(xx, w).astype(jnp.float32) ** 2)))
+g_s2d = jax.jit(jax.grad(lambda w, xx: jnp.sum(conv_s2d(xx, w).astype(jnp.float32) ** 2)))
+t_ref = timeit(g_ref, W, x)
+t_s2d = timeit(g_s2d, W2, x)
+print("stem fwd+bwd: ref %.1f ms   s2d %.1f ms   speedup %.2fx"
+      % (t_ref * 1e3, t_s2d * 1e3, t_ref / t_s2d))

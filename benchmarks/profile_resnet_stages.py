@@ -1,0 +1,88 @@
+"""Per-stage attribution of the resnet50 train step on the chip."""
+import sys, os, time
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import jax, numpy as np
+jax.config.update("jax_compilation_cache_dir", "/tmp/adt_jax_cache")
+import jax.numpy as jnp
+import flax.linen as nn
+from functools import partial
+from autodist_tpu.models import resnet
+
+B = 256
+PEAK = 197e12  # bf16 TFLOP/s v5e
+
+def _sync(r):
+    # VALUE READBACK: on this tunnel transport block_until_ready can
+    # acknowledge before execution drains (see BENCHMARKS.md header)
+    leaf = jax.tree_util.tree_leaves(r)[0]
+    float(jnp.sum(leaf.astype(jnp.float32)))
+
+
+def timeit(f, *args, steps=6):
+    _sync(f(*args))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        r = f(*args)
+    _sync(r)
+    return (time.perf_counter() - t0) / steps
+
+def flops_of(f, *args):
+    return jax.jit(f).lower(*args).compile().cost_analysis()["flops"]
+
+rng = np.random.RandomState(0)
+
+# full train step (fwd+bwd via grad of mean-logit loss)
+def seg_grad(mod, shape):
+    x = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+    v = jax.jit(lambda r, xx: mod.init(r, xx, train=False))(jax.random.PRNGKey(0), x[:1])
+    def loss(p, xx):
+        return jnp.mean(mod.apply(p, xx, train=False) ** 2)
+    g = jax.jit(jax.grad(loss))
+    dt = timeit(g, v, x)
+    fl = flops_of(jax.grad(loss), v, x)
+    return dt, fl
+
+class Stem(nn.Module):
+    dtype = jnp.bfloat16
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=jnp.bfloat16)(x.astype(jnp.bfloat16))
+        x = nn.BatchNorm(use_running_average=True, dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        return nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+class Stage(nn.Module):
+    filters: int
+    count: int
+    first_stride: int
+    @nn.compact
+    def __call__(self, x, train=False):
+        for j in range(self.count):
+            s = (self.first_stride, self.first_stride) if j == 0 else (1, 1)
+            x = resnet.BottleneckBlock(self.filters, s, dtype=jnp.bfloat16)(x, train)
+        return x
+
+parts = [
+    ("stem 7x7s2+pool", Stem(), (B, 224, 224, 3)),
+    ("stage1 64f x3 @56px", Stage(64, 3, 1), (B, 56, 56, 64)),
+    ("stage2 128f x4 @56px", Stage(128, 4, 2), (B, 56, 56, 256)),
+    ("stage3 256f x6 @28px", Stage(256, 6, 2), (B, 28, 28, 512)),
+    ("stage4 512f x3 @14px", Stage(512, 3, 2), (B, 14, 14, 1024)),
+]
+total_dt = 0.0
+for name, mod, shape in parts:
+    dt, fl = seg_grad(mod, shape)
+    total_dt += dt
+    print("%-22s %7.1f ms  %6.2f TFLOP  %5.1f TFLOP/s  mfu %.2f"
+          % (name, dt * 1e3, fl / 1e12, fl / dt / 1e12, fl / dt / PEAK),
+          flush=True)
+
+# whole model for comparison
+lf, params, batch, _ = resnet.make_train_setup(batch_size=B)
+g = jax.jit(jax.grad(lf))
+dt = timeit(g, params, batch)
+fl = flops_of(jax.grad(lf), params, batch)
+print("%-22s %7.1f ms  %6.2f TFLOP  %5.1f TFLOP/s  mfu %.2f  (sum of parts %.1f ms)"
+      % ("FULL resnet50 step", dt * 1e3, fl / 1e12, fl / dt / 1e12,
+         fl / dt / PEAK, total_dt * 1e3), flush=True)
