@@ -153,6 +153,9 @@ def _run_1f1b(stage_fn, head_fn, stage_params, head_params, x, y,
         "loss": jnp.zeros((), jnp.float32),
     }
 
+    zeros_stage = jax.tree_util.tree_map(jnp.zeros_like, stage_params)
+    zeros_head = jax.tree_util.tree_map(jnp.zeros_like, head_params)
+
     def tick(carry, t):
         f2 = t - rank                      # fwd(m) at t = r + 2m
         b2 = t - (2 * S - 1 - rank)        # bwd(m) at t = 2S-1-r + 2m
@@ -160,45 +163,70 @@ def _run_1f1b(stage_fn, head_fn, stage_params, head_params, x, y,
         bwd_on = (b2 >= 0) & (b2 % 2 == 0) & (b2 // 2 < M)
         fi = jnp.clip(f2 // 2, 0, M - 1)
         bi = jnp.clip(b2 // 2, 0, M - 1)
-
-        # ---- forward (predicated): stash the input, send output down
-        inp = jnp.where(rank == 0,
-                        jax.lax.dynamic_index_in_dim(x_mb, fi, 0,
-                                                     keepdims=False),
-                        carry["fwd_in"])
-        out = stage_fn(stage_params, inp)
-        stash = jnp.where(
-            fwd_on,
-            jax.lax.dynamic_update_slice_in_dim(
-                carry["stash"], inp[None], fi % S_int, 0),
-            carry["stash"])
-
-        # ---- backward (predicated): recompute from the stashed input,
-        # last rank sources its cotangent (and the loss) from head_fn
-        h_in = jax.lax.dynamic_index_in_dim(carry["stash"], bi % S_int, 0,
-                                            keepdims=False)
-        s_out, stage_vjp = jax.vjp(stage_fn, stage_params, h_in)
-        yb = jax.lax.dynamic_index_in_dim(y_mb, bi, 0, keepdims=False)
-        loss_mb, head_vjp = jax.vjp(head_fn, head_params, s_out, yb)
-        dhead_mb, dout_head, _ = head_vjp(jnp.ones((), loss_mb.dtype))
         is_last = rank == S - 1
-        dout = jnp.where(is_last, dout_head, carry["bwd_in"])
-        dstage_mb, dh = stage_vjp(dout)
 
-        gate = lambda on, tree, acc: jax.tree_util.tree_map(  # noqa: E731
-            lambda d, a: a + jnp.where(on, d, jnp.zeros_like(d)), tree, acc)
-        gacc = gate(bwd_on, dstage_mb, carry["gacc"])
-        hacc = gate(bwd_on & is_last, dhead_mb, carry["hacc"])
+        # fwd and bwd live on opposite tick parities, so each rank runs
+        # exactly ONE of the branches per tick — lax.cond, not
+        # where-predication, so the idle half is not computed. In-branch
+        # collectives (model-axis psums under tp) stay matched: the
+        # parity predicate depends only on the pipe coordinate, so every
+        # model/data-axis peer takes the same branch.
+        # Branch outputs: (fwd_payload, dh, stash, dstage_mb, dhead_mb,
+        # loss_mb) — dh doubles as the upstream ppermute payload AND the
+        # dx-gradient source
+        def fwd_branch(_):
+            inp = jnp.where(rank == 0,
+                            jax.lax.dynamic_index_in_dim(x_mb, fi, 0,
+                                                         keepdims=False),
+                            carry["fwd_in"])
+            out = stage_fn(stage_params, inp)
+            stash = jnp.where(
+                fwd_on,
+                jax.lax.dynamic_update_slice_in_dim(
+                    carry["stash"], inp[None], fi % S_int, 0),
+                carry["stash"])
+            return (jnp.where(fwd_on, out, jnp.zeros_like(out)),
+                    jnp.zeros_like(carry["bwd_in"]), stash,
+                    zeros_stage, zeros_head,
+                    jnp.zeros((), jnp.float32))
+
+        def bwd_branch(_):
+            h_in = jax.lax.dynamic_index_in_dim(carry["stash"],
+                                                bi % S_int, 0,
+                                                keepdims=False)
+            s_out, stage_vjp = jax.vjp(stage_fn, stage_params, h_in)
+            yb = jax.lax.dynamic_index_in_dim(y_mb, bi, 0, keepdims=False)
+            loss_mb, head_vjp = jax.vjp(head_fn, head_params, s_out, yb)
+            dhead_mb, dout_head, _ = head_vjp(jnp.ones((), loss_mb.dtype))
+            dout = jnp.where(is_last, dout_head, carry["bwd_in"])
+            dstage_mb, dh = stage_vjp(dout)
+            gate = lambda on, tree: jax.tree_util.tree_map(  # noqa: E731
+                lambda d: jnp.where(on, d, jnp.zeros_like(d)), tree)
+            return (jnp.zeros_like(carry["fwd_in"]),
+                    jnp.where(bwd_on, dh, jnp.zeros_like(dh)),
+                    carry["stash"],
+                    gate(bwd_on, dstage_mb),
+                    gate(bwd_on & is_last, dhead_mb),
+                    jnp.where(bwd_on & is_last,
+                              loss_mb.astype(jnp.float32), 0.0))
+
+        # bwd-parity ticks run the backward branch (cooldown ticks where
+        # bwd_on is False just compute gated-to-zero deltas)
+        (fwd_payload, dh, stash, dstage_mb, dhead_mb,
+         loss_mb) = jax.lax.cond(b2 % 2 == 0, bwd_branch, fwd_branch,
+                                 operand=None)
+        bwd_payload = dh
+
+        add = lambda acc, d: jax.tree_util.tree_map(  # noqa: E731
+            lambda a, x: a + x, acc, d)
+        gacc = add(carry["gacc"], dstage_mb)
+        hacc = add(carry["hacc"], dhead_mb)
         dx = jnp.where(
             bwd_on & (rank == 0),
             jax.lax.dynamic_update_slice_in_dim(carry["dx"], dh[None], bi, 0),
             carry["dx"])
-        loss = carry["loss"] + jnp.where(
-            bwd_on & is_last, loss_mb.astype(jnp.float32), 0.0)
+        loss = carry["loss"] + loss_mb
 
-        # ---- wire: activations down, cotangents up (zeros when idle)
-        fwd_payload = jnp.where(fwd_on, out, jnp.zeros_like(out))
-        bwd_payload = jnp.where(bwd_on, dh, jnp.zeros_like(dh))
         new_carry = {
             "fwd_in": jax.lax.ppermute(fwd_payload, axis_name, fwd_perm),
             "bwd_in": jax.lax.ppermute(bwd_payload, axis_name, bwd_perm),

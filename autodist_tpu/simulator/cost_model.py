@@ -36,14 +36,12 @@ CHIP_HBM_BYTES = {
 # forward in the backward (fwd+bwd ~3x fwd -> ~4x), "dots" recomputes
 # only the cheap non-contraction work (~3.5x)
 REMAT_COMPUTE_FACTOR = {None: 1.0, "full": 4.0 / 3.0, "dots": 3.5 / 3.0}
-# Honest price of the CURRENT fused 1F1B implementation
-# (parallel/pipeline._run_1f1b): 2(M+S-1) ticks, each executing BOTH a
-# stage forward and a recompute+backward vjp with jnp.where discarding
-# the idle half — ~8(M+S-1) fwd-units vs GPipe's ~3(M+S-1), i.e. 8/3
-# over the bubble-adjusted compute. A lax.cond tick body would halve
-# this (branch parity is uniform over the model/data axes, so in-branch
-# collectives stay matched) — priced here as implemented, not as hoped.
-F1B_RECOMPUTE_FACTOR = 8.0 / 3.0
+# Price of the fused 1F1B implementation (parallel/pipeline._run_1f1b):
+# 2(M+S-1) ticks whose lax.cond body executes ONE of {stage forward,
+# recompute+backward vjp} per tick (parity is uniform over model/data
+# axes, so in-branch collectives stay matched) — ~4(M+S-1) fwd-units vs
+# GPipe's ~3(M+S-1): the 4/3 is the per-microbatch recompute.
+F1B_RECOMPUTE_FACTOR = 4.0 / 3.0
 DEFAULT_MXU_EFFICIENCY = 0.4      # achieved/peak for typical training steps
 WIRE_DTYPE_BYTES = 4              # gradients travel fp32 unless compressed
 # host<->device link for the host-offloaded PS path (no-proxy PS keeps

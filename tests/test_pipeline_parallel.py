@@ -129,15 +129,18 @@ def test_pp_trains():
 # ------------------------------------------------------------------- 1F1B
 
 
-@pytest.mark.parametrize("pp,micro", [(2, 2), (4, 4)])
-def test_pp_lm_1f1b_matches_single_device(pp, micro):
+@pytest.mark.parametrize("pp,tp,micro", [(2, 1, 2), (4, 1, 4), (2, 2, 2)])
+def test_pp_lm_1f1b_matches_single_device(pp, tp, micro):
     """Full stack with schedule='1f1b' == plain single-device training of
     the same (degenerate-path) loss — the interleaved schedule computes
-    the same math as GPipe, with residency bounded at S."""
+    the same math as GPipe, with residency bounded at S. The pp x tp case
+    exercises model-axis collectives INSIDE the lax.cond tick branches
+    (legal: branch parity is uniform over the model axis)."""
     cfg = TPLMConfig.tiny(num_layers=max(2, pp))
+    model_axis = const.MODEL_AXIS if tp > 1 else None
     loss_fn, params, batch, _ = pipe_lm.make_train_setup(
         cfg, seq_len=16, batch_size=8, seed=1, n_microbatches=micro,
-        schedule="1f1b")
+        schedule="1f1b", model_axis=model_axis)
     opt = optax.sgd(0.05)
     rng = np.random.RandomState(2)
     batches = [batch, {"tokens": rng.randint(
@@ -154,8 +157,8 @@ def test_pp_lm_1f1b_matches_single_device(pp, micro):
         ref, state = step(ref, state, b)
 
     ad = adt.AutoDist(strategy_builder=strategy.PipelineParallel(
-        pp_shards=pp, n_microbatches=micro, schedule="1f1b",
-        mp_rules=pipe_lm.pp_rules()))
+        pp_shards=pp, tp_shards=tp, n_microbatches=micro, schedule="1f1b",
+        mp_rules=pipe_lm.pp_rules(model_axis=model_axis)))
     runner = ad.build(loss_fn, opt, params, batches[0])
     assert runner.distributed_step.strategy.graph_config.pp_schedule == "1f1b"
     runner.init(params)
