@@ -363,20 +363,39 @@ class Runner:
     # --------------------------------------------------- fit/evaluate facade
 
     def fit(self, batches, steps: Optional[int] = None,
-            callbacks: Optional[list] = None) -> list:
+            callbacks: Optional[list] = None, save_every: int = 0,
+            saver=None) -> list:
         """Train over an iterable of host batches (the reference's Keras
         ``model.fit`` path, which its patch routed into the distributed
         session — reference ``patch.py:96-197``). ``steps`` bounds infinite
         iterables (e.g. RecordFileDataset) without consuming a batch past
         the bound; ``callbacks`` are called as ``cb(step_index, metrics)``
-        after every step. Returns per-step metrics."""
+        after every step. ``save_every=N`` checkpoints every N steps (and
+        once at the end) through ``saver`` — default an async
+        :class:`~autodist_tpu.checkpoint.saver.Saver` on ``ADT_CKPT_DIR``,
+        which is exactly what sync-elastic recovery resumes from. Returns
+        per-step metrics."""
+        if save_every > 0 and saver is None:
+            from autodist_tpu.checkpoint.saver import Saver
+            saver = Saver(directory=const.ENV.ADT_CKPT_DIR.val,
+                          async_save=True)
         history = []
         bounded = batches if steps is None else itertools.islice(batches, steps)
-        for i, batch in enumerate(bounded):
-            metrics = self.run(batch)
-            history.append(metrics)
-            for cb in (callbacks or ()):
-                cb(i, metrics)
+        try:
+            for i, batch in enumerate(bounded):
+                metrics = self.run(batch)
+                history.append(metrics)
+                for cb in (callbacks or ()):
+                    cb(i, metrics)
+                if save_every > 0 and (i + 1) % save_every == 0:
+                    saver.save(self)
+            if save_every > 0 and history and len(history) % save_every != 0:
+                saver.save(self)  # final partial window
+        finally:
+            # even on an exception path, a failed async checkpoint write
+            # must surface — never look like a success
+            if saver is not None:
+                saver.wait()
         return history
 
     def evaluate(self, batches, steps: Optional[int] = None) -> dict:
@@ -418,8 +437,10 @@ class WrappedSession:
         batch = feed_dict if feed_dict is not None else kwargs
         return self._runner.run(batch)
 
-    def fit(self, batches, steps=None, callbacks=None):
-        return self._runner.fit(batches, steps=steps, callbacks=callbacks)
+    def fit(self, batches, steps=None, callbacks=None, save_every=0,
+            saver=None):
+        return self._runner.fit(batches, steps=steps, callbacks=callbacks,
+                                save_every=save_every, saver=saver)
 
     def evaluate(self, batches, steps=None):
         return self._runner.evaluate(batches, steps=steps)

@@ -395,3 +395,21 @@ def test_sharded_commit_rejects_stale_index(tmp_path):
     w.write("__nonce__", np.frombuffer(b"nonce-C", np.uint8))
     w.close()
     assert saver._await_indexes(base, 2) == {"P|w|0:4,0:2": 1}
+
+
+def test_fit_save_every(tmp_path, monkeypatch):
+    """fit(save_every=N) checkpoints every N steps plus a final partial
+    window, through an async saver on ADT_CKPT_DIR — the periodic save
+    sync-elastic recovery resumes from."""
+    monkeypatch.setenv("ADT_CKPT_DIR", str(tmp_path))
+    params, loss_fn, batch = _problem()
+    ad = autodist_tpu.AutoDist(strategy_builder=S.AllReduce())
+    runner = ad.build(loss_fn, optax.sgd(0.05), params, batch)
+    runner.init(params)
+    history = runner.fit([batch] * 7, save_every=3)
+    assert len(history) == 7
+    saver = Saver(directory=str(tmp_path))
+    steps = [s for s, _ in saver._own_metas()]
+    assert steps == [3, 6, 7], steps  # two windows + the final partial
+    state, step = saver.restore(runner)
+    assert step == 7
