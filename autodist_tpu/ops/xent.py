@@ -1,0 +1,143 @@
+"""Memory-lean softmax cross-entropy for big-vocab LM heads.
+
+The standard head materializes ``logits [N, V]`` in fp32 (lm1b: 32x256
+tokens x 99k vocab = 3.25 GB) plus softmax residuals for the backward —
+the tensor that decides the biggest batch a chip fits. Here neither the
+forward nor the backward ever holds more than one ``[N, C]`` vocab chunk:
+
+- forward: one ``lax.scan`` over vocab chunks maintains the online
+  logsumexp (running max + normalizer, same trick as flash attention's
+  softmax) and picks out each token's target logit as its chunk passes.
+- backward (custom_vjp): recomputes each chunk's logits from the saved
+  activations (linear — one matmul), forms ``softmax - onehot`` for that
+  chunk only, and accumulates dx / per-chunk dW, db slices.
+
+Peak extra memory: ``N*C`` floats (134 MB at C=4096 for the lm1b shape)
+instead of ``N*V`` — what lets lm1b train at batch 64 on a 16 GB v5e.
+Exact same math as ``log_softmax`` + gather to float tolerance
+(tests/test_xent.py).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _num_chunks(vocab: int, chunk: int) -> int:
+    return (vocab + chunk - 1) // chunk
+
+
+def _pad_wb(w, b, chunk):
+    """Pad the vocab dim to a chunk multiple with NEG_INF bias (padded
+    logits then never win the max and add ~0 to the normalizer)."""
+    v = w.shape[1]
+    pad = _num_chunks(v, chunk) * chunk - v
+    if pad:
+        w = jnp.pad(w, ((0, 0), (0, pad)))
+        b = jnp.pad(b, (0, pad), constant_values=NEG_INF)
+    return w, b
+
+
+def _chunked(w, b, chunk):
+    """(w_chunks [n, D, C], b_chunks [n, C]) — the ONE place that defines
+    the chunk layout; forward and backward must agree on which weight
+    slice each scan iteration sees."""
+    wp, bp = _pad_wb(w, b, chunk)
+    nchunks = wp.shape[1] // chunk
+    w_chunks = wp.reshape(wp.shape[0], nchunks, chunk).transpose(1, 0, 2)
+    b_chunks = bp.reshape(nchunks, chunk)
+    return w_chunks, b_chunks, nchunks
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def chunked_softmax_xent(x, w, b, targets, chunk=8192):
+    """Per-token negative log-likelihood of ``targets`` under the linear
+    head ``x @ w + b``, never materializing the full [N, V] logits.
+
+    x: [N, D] activations; w: [D, V]; b: [V]; targets: [N] int32.
+    Returns nll [N] float32. Differentiable in x, w, b.
+    """
+    nll, _ = _xent_fwd_impl(x, w, b, targets, chunk)
+    return nll
+
+
+def _xent_fwd_impl(x, w, b, targets, chunk):
+    n, _d = x.shape
+    # clamp like take_along_axis in the standard path: an out-of-vocab
+    # id must not silently yield nll = lse (tgt stuck at its 0.0 init)
+    targets = jnp.clip(targets, 0, w.shape[1] - 1)
+    w_chunks, b_chunks, nchunks = _chunked(w, b, chunk)
+    xf = x.astype(jnp.float32)
+
+    def body(carry, inputs):
+        m, l, tgt = carry
+        wc, bc, ci = inputs
+        logits = (jax.lax.dot(xf, wc.astype(jnp.float32))
+                  + bc.astype(jnp.float32)[None, :])         # [N, C]
+        m_cur = jnp.max(logits, axis=1)
+        m_new = jnp.maximum(m, m_cur)
+        l = l * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=1)
+        # target logit if the target falls inside this chunk
+        off = ci * chunk
+        local = targets - off
+        inside = (local >= 0) & (local < chunk)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, chunk - 1)[:, None], axis=1)[:, 0]
+        tgt = jnp.where(inside, picked, tgt)
+        return (m_new, l, tgt), None
+
+    m0 = jnp.full((n,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((n,), jnp.float32)
+    t0 = jnp.zeros((n,), jnp.float32)
+    (m, l, tgt), _ = jax.lax.scan(
+        body, (m0, l0, t0),
+        (w_chunks, b_chunks, jnp.arange(nchunks)))
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    nll = lse - tgt
+    return nll, (x, w, b, targets, lse)
+
+
+def _xent_fwd(x, w, b, targets, chunk):
+    return _xent_fwd_impl(x, w, b, targets, chunk)
+
+
+def _xent_bwd(chunk, res, g):
+    """g: cotangent [N]. d_nll/d_logit = softmax - onehot(target); each
+    chunk's logits are recomputed from the saved activations."""
+    x, w, b, targets, lse = res
+    n, d = x.shape
+    v = w.shape[1]
+    targets = jnp.clip(targets, 0, v - 1)  # mirror the forward's clamp
+    w_chunks, b_chunks, nchunks = _chunked(w, b, chunk)
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+
+    def body(dx, inputs):
+        wc, bc, ci = inputs
+        logits = (jax.lax.dot(xf, wc.astype(jnp.float32))
+                  + bc.astype(jnp.float32)[None, :])
+        p = jnp.exp(logits - lse[:, None])                  # softmax chunk
+        off = ci * chunk
+        local = targets - off
+        inside = (local >= 0) & (local < chunk)
+        onehot = (jnp.clip(local, 0, chunk - 1)[:, None]
+                  == jnp.arange(chunk)[None, :]) & inside[:, None]
+        dlog = (p - onehot.astype(p.dtype)) * gf[:, None]   # [N, C]
+        dx = dx + jax.lax.dot(dlog, wc.astype(jnp.float32).T)
+        dwc = jax.lax.dot(xf.T, dlog)                       # [D, C]
+        dbc = jnp.sum(dlog, axis=0)
+        return dx, (dwc, dbc)
+
+    dx0 = jnp.zeros((n, d), jnp.float32)
+    dx, (dw_chunks, db_chunks) = jax.lax.scan(
+        body, dx0, (w_chunks, b_chunks, jnp.arange(nchunks)))
+    dw = dw_chunks.transpose(1, 0, 2).reshape(d, nchunks * chunk)[:, :v]
+    db = db_chunks.reshape(nchunks * chunk)[:v]
+    return (dx.astype(x.dtype), dw.astype(w.dtype), db.astype(b.dtype),
+            None)
+
+
+chunked_softmax_xent.defvjp(_xent_fwd, _xent_bwd)

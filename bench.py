@@ -108,8 +108,14 @@ def _model_spec(label):
                                  dtype=jnp.bfloat16), "input_ids"
     if label == "lm1b":
         from autodist_tpu.models.lm import LMConfig
+        # lean_head pinned OFF for the bench: XLA cost_analysis counts
+        # scan bodies once, so the chunked head's MFU would underreport
+        # (throughput is ~equal at this batch; the lean head's own
+        # numbers — incl. fitting batch 64 where this config OOMs — are
+        # in BENCHMARKS.md "Memory-lean LM head")
         return "lm", dict(config=LMConfig.lm1b(dtype=jnp.bfloat16),
-                          batch_size=32, seq_len=256), "tokens"
+                          batch_size=32, seq_len=256,
+                          lean_head=False), "tokens"
     if label == "smoke":  # tiny CPU-runnable config for harness tests
         return "resnet18", dict(batch_size=4, image_size=32), "image"
     raise ValueError(label)
