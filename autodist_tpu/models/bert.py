@@ -134,8 +134,10 @@ def make_train_setup(config: Optional[BertConfig] = None, seq_len: int = 128,
         logits = model.apply(params, batch["input_ids"],
                              batch["token_type_ids"], batch["attention_mask"])
         logp = jax.nn.log_softmax(logits)
-        tgt = jax.nn.one_hot(batch["labels"], cfg.vocab_size)
-        per_tok = -jnp.sum(tgt * logp, axis=-1)
+        # gather, not one_hot: a [tokens, vocab] one-hot would double the
+        # biggest tensor in the program for the same math
+        per_tok = -jnp.take_along_axis(
+            logp, batch["labels"][..., None], axis=-1)[..., 0]
         weights = batch["mlm_weights"].astype(per_tok.dtype)
         return jnp.sum(per_tok * weights) / jnp.maximum(jnp.sum(weights), 1.0)
 

@@ -101,6 +101,9 @@ def make_train_setup(config: Optional[LMConfig] = None, seq_len: int = 128,
     cfg = config or LMConfig()
     if lean_head == "auto":
         lean_head = cfg.vocab_size >= 32768
+    elif not isinstance(lean_head, bool):
+        raise ValueError("lean_head must be True, False or 'auto', got %r"
+                         % (lean_head,))
     if seq_len > cfg.max_seq_len:
         # out-of-range position lookups would silently NaN (jnp.take fills)
         raise ValueError("seq_len %d exceeds config.max_seq_len %d"

@@ -86,6 +86,8 @@ def test_no_full_logits_in_program():
     assert (n, vocab) not in shapes, "full logits materialized"
     assert any(s[-1] == chunk and s[0] in (n,) for s in shapes
                if len(s) == 2), shapes
+    # the weights are read in place: no stacked [nchunks, D, C] copy of W
+    assert (vocab // chunk, d, chunk) not in shapes, "chunked W copy"
 
 
 def test_lm_lean_head_matches_standard_loss():
