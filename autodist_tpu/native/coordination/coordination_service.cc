@@ -91,6 +91,20 @@
 
 namespace {
 
+// Strict length parse: the whole token must be digits (optionally signed)
+// and in range. atol() returns 0 for garbage like "x16" — which would
+// accept a zero-byte frame and then parse the real payload as commands —
+// and has undefined behavior on overflow.
+bool ParseLen(const std::string& s, long* out) {
+  if (s.empty()) return false;
+  errno = 0;
+  char* end = nullptr;
+  long v = strtol(s.c_str(), &end, 10);
+  if (errno == ERANGE || end != s.c_str() + s.size()) return false;
+  *out = v;
+  return true;
+}
+
 const char kB64[] =
     "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
 
@@ -167,6 +181,11 @@ struct Conn {
   // parked command below
   size_t bin_need = 0;
   std::vector<std::string> bin_args;
+  // bytes of a *rejected* frame's payload still to drain: the client sends
+  // header+payload in one write, so after an ERR the payload bytes are
+  // already in flight and must not be parsed as command lines
+  size_t bin_discard = 0;
+  bool close_requested = false;  // length unparseable -> cannot resync
 };
 
 class Server {
@@ -248,6 +267,13 @@ class Server {
       }
     }
     while (true) {
+      if (conn.bin_discard > 0) {
+        size_t drop = std::min(conn.bin_discard, conn.inbuf.size());
+        conn.inbuf.erase(0, drop);
+        conn.bin_discard -= drop;
+        if (conn.bin_discard > 0) break;  // more to drain on a later read
+        continue;
+      }
       if (conn.bin_need > 0) {
         if (conn.inbuf.size() < conn.bin_need) break;  // payload incomplete
         std::string payload = conn.inbuf.substr(0, conn.bin_need);
@@ -262,9 +288,10 @@ class Server {
       conn.inbuf.erase(0, pos + 1);
       if (!line.empty() && line.back() == '\r') line.pop_back();
       Handle(conn, line);
+      if (conn.close_requested) break;
     }
     Flush(conn);
-    return true;
+    return !conn.close_requested;
   }
 
   static std::vector<std::string> Split(const std::string& s) {
@@ -402,18 +429,31 @@ class Server {
       long n = (it == queues_.end()) ? 0 : static_cast<long>(it->second.size());
       Reply(conn, "VAL " + std::to_string(n));
     } else if (cmd == "BPUTB" && parts.size() == 4) {
-      long n = atol(parts[3].c_str());
-      if (n < 0 || n > kMaxBlobBytes) {
-        Reply(conn, "ERR bad length");  // a malformed frame must not park
-      } else {                          // the parser on 2^64 bytes forever
+      long n = 0;
+      if (!ParseLen(parts[3], &n) || n < 0) {
+        // length unparseable/negative -> the payload boundary is lost
+        // (atol would return 0 for "x16" and the real payload would be
+        // parsed as command lines); close rather than desync
+        Reply(conn, "ERR bad length");
+        conn.close_requested = true;
+      } else if (n > kMaxBlobBytes) {
+        // the client already sent header+payload in one write: drain
+        // exactly n bytes so line parsing resumes at the next frame
+        Reply(conn, "ERR bad length");
+        conn.bin_discard = static_cast<size_t>(n);
+      } else {
         conn.bin_args = {cmd, parts[1], parts[2]};
         conn.bin_need = static_cast<size_t>(n);
         if (conn.bin_need == 0) HandleBinaryPayload(conn, "");
       }
     } else if (cmd == "QPUSHB" && parts.size() == 3) {
-      long n = atol(parts[2].c_str());
-      if (n < 0 || n > kMaxBlobBytes) {
+      long n = 0;
+      if (!ParseLen(parts[2], &n) || n < 0) {
         Reply(conn, "ERR bad length");
+        conn.close_requested = true;
+      } else if (n > kMaxBlobBytes) {
+        Reply(conn, "ERR bad length");
+        conn.bin_discard = static_cast<size_t>(n);
       } else {
         conn.bin_args = {cmd, parts[1]};
         conn.bin_need = static_cast<size_t>(n);

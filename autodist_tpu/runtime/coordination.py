@@ -117,9 +117,18 @@ class CoordinationClient:
         self._sock.sendall(line.encode() + b"\n")
         return self._recv_line()
 
+    # must match kMaxBlobBytes in coordination_service.cc — validated here
+    # so an oversized payload fails before any bytes hit the wire instead of
+    # forcing the service to drain a rejected multi-GB frame
+    MAX_BLOB_BYTES = 1 << 31
+
     def _cmd_raw(self, header: str, payload: bytes) -> str:
         """Length-prefixed binary frame: header line then raw payload
         (the B-suffixed service commands) — no base64 inflation."""
+        if len(payload) > self.MAX_BLOB_BYTES:
+            raise ValueError(
+                "blob payload %d bytes exceeds the service cap %d" %
+                (len(payload), self.MAX_BLOB_BYTES))
         self._sock.sendall(header.encode() + b"\n" + payload)
         return self._recv_line()
 

@@ -203,3 +203,100 @@ def test_binary_blob_roundtrip_and_text_interop(server):
     c.bput("bin/empty", 1, b"")
     assert c.bget("bin/empty") == (1, b"")
     c.close()
+
+
+def test_rejected_blob_frame_does_not_desync(server):
+    """A BPUTB whose declared length exceeds the service cap is rejected,
+    but the payload bytes the client already sent must be DRAINED — not
+    parsed as command lines (a gradient blob containing b"\\nSHUTDOWN\\n"
+    must not stop the service). Advisor finding, coordination_service.cc."""
+    c = _client()
+    # hand-craft an oversized frame: declare cap+16 bytes, send a small
+    # hostile payload that would read as commands if the parser desynced.
+    cap = CoordinationClient.MAX_BLOB_BYTES
+    hostile = b"\nSHUTDOWN\nPUT pwned yes\n"
+    c._sock.sendall(b"BPUTB bad/key 1 %d\n" % (cap + 16) + hostile)
+    assert c._recv_line().startswith("ERR bad length")
+    # the service is now draining cap+16 bytes; finish the declared frame
+    # so the connection resyncs (chunked, to exercise partial drains)
+    remaining = cap + 16 - len(hostile)
+    chunk = b"\x00" * (1 << 20)
+    while remaining > 0:
+        n = min(remaining, len(chunk))
+        c._sock.sendall(chunk[:n])
+        remaining -= n
+    # after the drain the same connection parses frames normally again
+    assert c.ping()
+    # ...and the hostile payload neither stopped the service nor wrote keys
+    assert c.get("pwned") is None
+    c.close()
+
+    c2 = _client()
+    assert c2.ping()  # service alive for new connections too
+    c2.close()
+
+
+def test_negative_blob_length_closes_connection(server):
+    """A negative declared length is unrecoverable (the payload boundary is
+    unknowable) — the service replies ERR and closes that connection."""
+    import socket as _socket
+    c = _client()
+    c._sock.sendall(b"QPUSHB q/neg -5\ngarbage")
+    assert c._recv_line().startswith("ERR bad length")
+    # connection is closed by the server: next read returns EOF
+    c._sock.settimeout(5.0)
+    assert c._sock.recv(1) == b""
+    # other connections unaffected
+    c2 = _client()
+    assert c2.ping()
+    c2.close()
+
+
+def test_client_rejects_oversized_payload_before_send(server):
+    """Client-side cap validation: an oversized payload raises locally
+    without any bytes hitting the wire."""
+    c = _client()
+    big = _FakeBytes(CoordinationClient.MAX_BLOB_BYTES + 1)
+    with pytest.raises(ValueError, match="exceeds the service cap"):
+        c._cmd_raw("BPUTB k 1 %d" % len(big), big)
+    assert c.ping()  # connection untouched
+    c.close()
+
+
+class _FakeBytes(bytes):
+    """len()-only stand-in: allocating 2 GB in the test is pointless."""
+    def __new__(cls, n):
+        obj = super().__new__(cls)
+        obj._n = n
+        return obj
+
+    def __len__(self):
+        return self._n
+
+
+def test_unparseable_blob_length_closes_connection(server):
+    """atol('x16') == 0 would accept a zero-byte frame and parse the real
+    payload as command lines; strict parsing must reject and close."""
+    c = _client()
+    c._sock.sendall(b"BPUTB k 1 x16\n" + b"\nSHUTDOWN\nPUT pwned2 yes\n"[:16])
+    assert c._recv_line().startswith("ERR bad length")
+    c._sock.settimeout(5.0)
+    assert c._sock.recv(1) == b""  # closed: payload never parsed
+    c2 = _client()
+    assert c2.ping()                # service alive, nothing executed
+    assert c2.get("pwned2") is None
+    c2.close()
+
+
+def test_unparseable_blob_length_closes_connection(server):
+    """atol('x16') == 0 would accept a zero-byte frame and parse the real
+    payload as command lines; strict parsing must reject and close."""
+    c = _client()
+    c._sock.sendall(b"BPUTB k 1 x16\n" + b"\nSHUTDOWN\nPUT pwned2 yes\n"[:16])
+    assert c._recv_line().startswith("ERR bad length")
+    c._sock.settimeout(5.0)
+    assert c._sock.recv(1) == b""  # closed: payload never parsed
+    c2 = _client()
+    assert c2.ping()                # service alive, nothing executed
+    assert c2.get("pwned2") is None
+    c2.close()
