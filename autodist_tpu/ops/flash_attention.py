@@ -23,9 +23,12 @@ Design (standard FlashAttention-2 tiling, arXiv 2307.08691):
   intersect the kv-segment range are skipped dynamically (``pl.when`` on a
   range-overlap test — exact skips for the sorted/contiguous layouts BERT
   and sequence packing produce, safe over-approximation otherwise);
-  partial tiles are masked elementwise. Every query must share a segment
-  with at least one key (self-attention always does: position i sees
-  position i), so no row's softmax is ever empty.
+  partial tiles are masked elementwise. A query whose segment matches NO
+  key anywhere (possible only with a distinct ``(q_seg, kv_seg)`` pair —
+  self-attention position i always sees position i) outputs zeros with
+  zero gradients, guarded in both passes; the XLA fallback's softmax
+  instead yields a uniform average for such rows, so don't rely on
+  empty-row values across paths.
 
 On non-TPU backends the same kernels run under ``interpret=True`` so unit
 tests exercise the identical code path on CPU (tests/test_flash_attention.py
@@ -116,6 +119,10 @@ def _fwd_kernel(*refs, scale, causal, has_seg, bq, bk, n_kv):
         m_cur = jnp.max(s, axis=1, keepdims=True)        # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                           # [bq, bk]
+        # a row with NO visible key so far has m_new == NEG_INF and every
+        # score masked: exp(NEG_INF - NEG_INF) = 1 would average garbage
+        # values into the row — zero its contribution (empty rows emit 0)
+        p = jnp.where(m_new > NEG_INF * 0.5, p, 0.0)
         corr = jnp.exp(m_prev - m_new)                   # [bq, 1]
         l_new = l_ref[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
@@ -128,7 +135,12 @@ def _fwd_kernel(*refs, scale, causal, has_seg, bq, bk, n_kv):
     def _():
         l = l_ref[:, :1]
         o_ref[0, 0, :, :] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        lse_ref[0, 0, :, :] = m_ref[:, :1] + jnp.log(jnp.maximum(l, 1e-30))
+        # empty rows (l == 0) record lse = 0, NOT NEG_INF + log(1e-30):
+        # the backward pass computes p = exp(s - lse), and a huge-negative
+        # lse would blow exp() up to garbage gradients for those rows;
+        # with lse = 0, exp(NEG_INF - 0) = 0 and the row's grads vanish
+        lse_ref[0, 0, :, :] = jnp.where(
+            l > 0, m_ref[:, :1] + jnp.log(jnp.maximum(l, 1e-30)), 0.0)
 
 
 def _seg_specs(bq, bk, q_major=True):

@@ -177,3 +177,35 @@ def test_attn_fn_adapter_accepts_padding_mask():
     out = attn(q, k, v, mask4)
     ref = reference_attention(q, k, v, jnp.asarray(_seg_mask(valid, valid)))
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_empty_query_rows_emit_zeros_with_zero_grads():
+    """A (q_seg, kv_seg) pair where some query segment matches NO key: the
+    empty rows output zeros (not a garbage average of values) and their
+    gradients vanish — guarded in both the forward and backward kernels
+    (advisor finding, flash_attention.py empty-row case)."""
+    B, S = 1, 128
+    shape = (B, S, 2, 32)
+    q, k, v = (_rand(shape, seed=i) for i in range(3))
+    q_seg = np.zeros((B, S), np.int32)
+    q_seg[:, S // 2:] = 7           # segment 7 appears in NO key
+    kv_seg = np.zeros((B, S), np.int32)
+    out = flash_attention(q, k, v, False,
+                          segment_ids=(jnp.asarray(q_seg),
+                                       jnp.asarray(kv_seg)))
+    # live rows match the reference; empty rows are exactly zero
+    ref = reference_attention(q, k, v, jnp.asarray(_seg_mask(q_seg, kv_seg)))
+    np.testing.assert_allclose(out[:, :S // 2], ref[:, :S // 2],
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_array_equal(np.asarray(out[:, S // 2:]), 0.0)
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, False,
+                            segment_ids=(jnp.asarray(q_seg),
+                                         jnp.asarray(kv_seg)))
+        return jnp.sum(o * o)
+    dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g in (dq, dk, dv):
+        assert np.all(np.isfinite(np.asarray(g)))
+    # empty query rows contribute nothing anywhere
+    np.testing.assert_array_equal(np.asarray(dq[:, S // 2:]), 0.0)
