@@ -4,4 +4,26 @@ from autodist_tpu.checkpoint.sharded import ShardedSaver
 from autodist_tpu.checkpoint.saved_model_builder import (SavedModelBuilder,
                                                          export_for_serving)
 
-__all__ = ["Saver", "ShardedSaver", "SavedModelBuilder", "export_for_serving"]
+
+def latest_checkpoint(directory):
+    """(step, saver) of the newest committed checkpoint in ``directory``
+    across BOTH formats (plain Saver and ShardedSaver), or (None, None).
+    The single authority for "is there something to restore, and through
+    which saver" — auto-resume (Runner.init) and the sync-elastic restart
+    gate (coordinator) must agree on the answer."""
+    best = (None, None)
+    for saver_cls in (Saver, ShardedSaver):
+        try:
+            saver = saver_cls(directory=directory)
+            base = saver.latest()
+        except OSError:
+            continue
+        if base is not None:
+            step = int(base.rsplit("ckpt-", 1)[1])
+            if best[0] is None or step > best[0]:
+                best = (step, saver)
+    return best
+
+
+__all__ = ["Saver", "ShardedSaver", "SavedModelBuilder",
+           "export_for_serving", "latest_checkpoint"]

@@ -261,8 +261,16 @@ class ShardedSaver:
             dstep.flush_ps()  # in-flight pipelined push lands first
             store.drain()
             for name, plan in sorted(store.plans.items()):
-                n_shards = len(plan.shard_ranges()) if plan.partitioned else 1
-                ps_meta[name] = {"axis": plan.axis, "nshards": n_shards}
+                ranges = plan.shard_ranges() if plan.partitioned else None
+                n_shards = len(ranges) if ranges else 1
+                ps_meta[name] = {
+                    "axis": plan.axis, "nshards": n_shards,
+                    # explicit split-axis sizes so a restore under a
+                    # DIFFERENT shard layout can re-slice without reading
+                    # every saved shard just to learn its extent
+                    "shard_sizes": ([hi - lo for lo, hi in ranges]
+                                    if ranges else None),
+                }
             for name, si in store.checkpoint_pairs(const.is_chief()):
                 def ps_group(name=name, si=si):
                     value, opt_flat = store.shard_state(name, si)
@@ -452,25 +460,57 @@ class ShardedSaver:
             raise ValueError("not a sharded checkpoint: %s" % path)
         return meta
 
-    def _check_topology(self, meta: dict, dstep):
+    def _topology_matches(self, meta: dict, dstep) -> bool:
         want_axes = list(dstep.mesh.axis_names)
         want_shape = [int(dstep.mesh.shape[a]) for a in want_axes]
         have = meta["mesh"]
-        if (have["axes"] != want_axes or have["shape"] != want_shape
-                or meta["process_count"] != jax.process_count()):
+        return (have["axes"] == want_axes and have["shape"] == want_shape
+                and meta["process_count"] == jax.process_count())
+
+    def _flex_precheck(self, meta: dict, dstep, suffix: str):
+        """Raise when a cross-topology restore is impossible. Flexible
+        restore needs global-mesh checkpoints (one SPMD program at save
+        AND restore — between-graph local-mesh keys are process-private
+        views with no global slice identity) and every saved leaf's mesh
+        axes present on the running mesh. Topology-independence is the
+        reference's ``SaveSliceInfo`` property (reference
+        ``autodist/kernel/partitioner.py:292-347``): keys carry global
+        slice ranges, so any consumer topology can reassemble."""
+        if suffix or any("@" in k for k in meta["keys"]):
             raise ValueError(
-                "sharded restore needs the SAME topology it was saved on "
-                "(saved: mesh %s=%s over %d processes; running: %s=%s over "
-                "%d). Convert with ShardedSaver.export_full() and restore "
-                "through Saver instead."
-                % (have["axes"], have["shape"], meta["process_count"],
-                   want_axes, want_shape, jax.process_count()))
+                "cross-topology sharded restore requires global-mesh "
+                "checkpoints on both sides; this one involves a "
+                "between-graph (process-local mesh) program. Convert with "
+                "ShardedSaver.export_full() and restore through Saver.")
+        mesh_axes = set(dstep.mesh.axis_names)
+        for lkey, lm in meta["leaves"].items():
+            for entry in lm["spec"]:
+                for ax in (entry if isinstance(entry, list) else [entry]):
+                    if ax is not None and ax not in mesh_axes:
+                        raise ValueError(
+                            "saved leaf %r is sharded over mesh axis %r, "
+                            "absent from the running mesh %s — restore "
+                            "under a strategy with the same axis names"
+                            % (lkey, ax, sorted(mesh_axes)))
+        logging.warning(
+            "sharded restore across topologies: saved mesh %s=%s over %d "
+            "processes -> running %s over %d processes; reassembling from "
+            "global slice ranges",
+            meta["mesh"]["axes"], meta["mesh"]["shape"],
+            meta["process_count"],
+            {a: int(dstep.mesh.shape[a]) for a in dstep.mesh.axis_names},
+            jax.process_count())
 
     def _restore_device_tree(self, kind: str, template, meta, reader, mesh,
-                             suffix: str):
+                             suffix: str, flex_layouts=None):
         """Rebuild one device tree: every leaf assembled from exactly the
-        slices this process's devices need."""
+        slices this process's devices need. With ``flex_layouts`` (leaf
+        name -> VarLayout of the RUNNING program, or absent), the mesh may
+        differ from the one the checkpoint was saved on: each needed slice
+        is reassembled from the overlapping saved slices (cross-file
+        reads), re-padding the split axis for the new layout."""
         names, leaves, treedef = variable_utils.flatten_named(template)
+        groups = _group_keys(meta) if flex_layouts is not None else None
         out = []
         for name, _tmpl in zip(names, leaves):
             lm = meta["leaves"].get("%s|%s" % (kind, name))
@@ -478,6 +518,12 @@ class ShardedSaver:
                 raise KeyError(
                     "checkpoint has no %s leaf %r — was it saved under a "
                     "different strategy?" % (kind, name))
+            if flex_layouts is not None:
+                out.append(self._flex_leaf(
+                    kind, name, lm, reader, mesh,
+                    flex_layouts.get(name),
+                    groups.get("%s|%s" % (kind, name), [])))
+                continue
             shape = tuple(lm["shape"])
             dtype = np.dtype(lm["dtype"])
             sharding = NamedSharding(mesh, _spec_from_json(lm["spec"]))
@@ -496,9 +542,114 @@ class ShardedSaver:
                 shape, sharding, bufs))
         return variable_utils.unflatten_named(treedef, out)
 
+    def _flex_leaf(self, kind: str, name: str, lm: dict, reader, mesh,
+                   layout, saved_keys: List[str]):
+        """One leaf restored onto a mesh DIFFERENT from the save mesh.
+
+        Coordinates: saved slice tokens are in the save-time PADDED frame;
+        ``lm['unpad']`` recovers the original extent. The running program's
+        padding (``layout.padded_dim``) generally differs — e.g. dim 10
+        split 4 ways pads to 12, split 2 ways to 10 — so assembly goes
+        saved-padded -> original -> new-padded. Pad regions are zeros in
+        both frames (VarLayout.pad zero-pads), so only the original region
+        is ever copied; memory peak per slice = the needed slice plus one
+        overlapping saved slice."""
+        saved_shape = tuple(lm["shape"])
+        dtype = np.dtype(lm["dtype"])
+        unpad = lm.get("unpad")
+        orig_shape = list(saved_shape)
+        if unpad:
+            orig_shape[int(unpad[0])] = int(unpad[1])
+        orig_shape = tuple(orig_shape)
+
+        # the RUNNING program's layout decides the new shape and spec (the
+        # saved spec reflects the save-time strategy compile, which can
+        # differ — e.g. a dim-4 var partitions on a 2-device mesh but stays
+        # replicated on 8); leaves without a layout (sync state, scalar
+        # optimizer counts) keep the saved spec
+        new_shape = list(orig_shape)
+        if layout is not None:
+            spec = layout.pspec
+            if (layout.partitioned and len(orig_shape) > layout.axis
+                    and orig_shape[layout.axis] == layout.orig_dim):
+                new_shape[layout.axis] = layout.padded_dim
+        else:
+            spec = _spec_from_json(lm["spec"])
+        new_shape = tuple(new_shape)
+        if unpad and layout is not None and layout.partitioned \
+                and int(unpad[0]) != layout.axis:
+            raise ValueError(
+                "leaf %s|%s: saved split axis %d != running split axis %d "
+                "— cross-topology restore keeps the partition axis"
+                % (kind, name, int(unpad[0]), layout.axis))
+
+        # saved pieces: key -> its range per dim, CLIPPED to the original
+        # extent (the clipped-off tail is save-time padding, all zeros)
+        pieces = []
+        for key in saved_keys:
+            token = key.split("|", 2)[2]
+            ranges = []
+            for (lo, hi), odim in zip(
+                    ((s.start, s.stop) for s in _token_slices(token))
+                    if token != "-" else (), orig_shape):
+                ranges.append((lo, min(hi, odim)))
+            pieces.append((key, ranges))
+
+        sharding = NamedSharding(mesh, spec)
+        imap = sharding.devices_indices_map(new_shape)
+        bufs, seen = [], {}
+        for d in sharding.addressable_devices:
+            token = _index_token(imap[d], new_shape)
+            data = seen.get(token)
+            if data is None:
+                data = self._assemble_flex_slice(
+                    _token_slices(token), new_shape, orig_shape, dtype,
+                    pieces, reader)
+                seen[token] = data
+            bufs.append(jax.device_put(data, d))
+        return jax.make_array_from_single_device_arrays(
+            new_shape, sharding, bufs)
+
+    @staticmethod
+    def _assemble_flex_slice(need, new_shape, orig_shape, dtype, pieces,
+                             reader) -> np.ndarray:
+        """One needed slice (ranges in NEW-padded coords) filled from the
+        overlapping saved pieces (ranges in original coords)."""
+        if not new_shape:  # scalar: the single '-' piece is the value
+            key = pieces[0][0]
+            return np.asarray(reader(key), dtype=dtype)
+        need_r = [(s.start, s.stop) for s in need]
+        out = np.zeros([hi - lo for lo, hi in need_r], dtype)
+        # the needed slice's overlap with the ORIGINAL region (identical
+        # coordinates below the original extent; beyond it is new padding)
+        need_orig = [(lo, min(hi, odim))
+                     for (lo, hi), odim in zip(need_r, orig_shape)]
+        if any(lo >= hi for lo, hi in need_orig):
+            return out  # pure padding slice
+        for key, pranges in pieces:
+            ov = [(max(nl, pl), min(nh, ph))
+                  for (nl, nh), (pl, ph) in zip(need_orig, pranges)]
+            if any(lo >= hi for lo, hi in ov):
+                continue
+            arr = np.asarray(reader(key))
+            src = tuple(slice(lo - pl, hi - pl)
+                        for (lo, hi), (pl, _) in zip(ov, pranges))
+            dst = tuple(slice(lo - nl, hi - nl)
+                        for (lo, hi), (nl, _) in zip(ov, need_r))
+            out[dst] = arr[src]
+        return out
+
     def restore(self, runner, path: Optional[str] = None) -> Tuple[Any, int]:
         """Restore a Runner's state reading only this process's slices.
-        Returns (state, step)."""
+        Returns (state, step).
+
+        The mesh/process topology may DIFFER from the save-time one
+        (scale-down after losing a host, scale-up after adding some): npz
+        keys carry global slice ranges, so each needed slice is
+        reassembled from the overlapping saved slices — no process ever
+        materializes a full leaf set in either direction (the reference's
+        topology-independent ``SaveSliceInfo`` restore, reference
+        ``autodist/kernel/partitioner.py:292-347``)."""
         self.wait()
         path = path or self.latest()
         if path is None:
@@ -506,37 +657,57 @@ class ShardedSaver:
                                     % self.directory)
         dstep = runner.distributed_step
         meta = self._read_meta(path)
-        self._check_topology(meta, dstep)
+        suffix = self._mesh_suffix(dstep)
+        same = self._topology_matches(meta, dstep)
+        if not same:
+            self._flex_precheck(meta, dstep, suffix)
         if meta.get("strategy_id") != dstep.strategy.id:
             logging.warning(
                 "sharded checkpoint %s was saved under strategy %s, "
                 "restoring under %s — layouts must match or this will fail",
                 path, meta.get("strategy_id"), dstep.strategy.id)
         reader = self._ShardReader(path, meta)
-        suffix = self._mesh_suffix(dstep)
         try:
             item = dstep.model_item
             holed = dstep._holed_template
-            params = self._restore_device_tree("P", holed, meta, reader,
-                                               dstep.mesh, suffix)
             opt_template = jax.eval_shape(item.optimizer.init, holed)
+            p_flex = o_flex = s_flex = None
+            if not same:
+                p_flex = dict(dstep.layouts)
+                o_flex = dict(dstep.layouts)
+                names_o, leaves_o, _ = variable_utils.flatten_named(
+                    opt_template)
+                for n, l in zip(names_o, leaves_o):
+                    var = variable_utils.match_state_to_var(
+                        n, tuple(getattr(l, "shape", ())),
+                        item.var_infos, dstep.layouts)
+                    if var and var in dstep.layouts:
+                        o_flex[n] = dstep.layouts[var]
+                s_flex = {}
+            params = self._restore_device_tree("P", holed, meta, reader,
+                                               dstep.mesh, suffix, p_flex)
             opt_state = self._restore_device_tree("O", opt_template, meta,
-                                                  reader, dstep.mesh, suffix)
+                                                  reader, dstep.mesh, suffix,
+                                                  o_flex)
             sync_template = dstep._sync_state_init()
             sync_state = self._restore_device_tree("S", sync_template, meta,
-                                                   reader, dstep.mesh, suffix)
+                                                   reader, dstep.mesh, suffix,
+                                                   s_flex)
             store = dstep.ps_store
             if store is not None:
                 # a staged prefetch of pre-restore values must not survive
                 dstep.invalidate_ps()
                 groups = _group_keys(meta)
-
-                def provider(name, si):
-                    value = np.asarray(reader("H|%s::%d" % (name, si)))
-                    prefix = "Ho|%s::%d|" % (name, si)
-                    opt_flat = {k[len(prefix):]: np.asarray(reader(k))
-                                for k in groups.get(prefix[:-1], [])}
-                    return value, opt_flat
+                if same:
+                    def provider(name, si):
+                        value = np.asarray(reader("H|%s::%d" % (name, si)))
+                        prefix = "Ho|%s::%d|" % (name, si)
+                        opt_flat = {k[len(prefix):]: np.asarray(reader(k))
+                                    for k in groups.get(prefix[:-1], [])}
+                        return value, opt_flat
+                else:
+                    provider = self._flex_ps_provider(meta, reader, groups,
+                                                      store)
                 store.load_shard_states(provider)
         finally:
             reader.close()
@@ -549,6 +720,76 @@ class ShardedSaver:
         logging.info("restored sharded checkpoint %s (step %d, local slices "
                      "only)", path, step)
         return state, step
+
+    def _flex_ps_provider(self, meta, reader, groups, store):
+        """Provider for :meth:`PSStore.load_shard_states` when the RUNNING
+        store's shard layout differs from the saved one (host count / load
+        balance changed): each new shard's range re-slices the saved shards
+        along the split axis, reading only the overlapping ones."""
+        ps_meta = meta.get("ps", {})
+
+        def gather_range(keys, lo, hi, axis, offs):
+            """Saved shards ``keys`` (with cumulative ``offs`` along
+            ``axis``) re-sliced to the half-open range [lo, hi); hi < 0
+            means the full extent."""
+            parts = []
+            for s, k in enumerate(keys):
+                if hi >= 0:
+                    slo, shi = offs[s], offs[s + 1]
+                    olo, ohi = max(lo, slo), min(hi, shi)
+                    if olo >= ohi:
+                        continue
+                    arr = np.asarray(reader(k))
+                    idx = [slice(None)] * arr.ndim
+                    idx[axis] = slice(olo - slo, ohi - slo)
+                    parts.append(arr[tuple(idx)])
+                else:
+                    parts.append(np.asarray(reader(k)))
+            if not parts:
+                raise ValueError("PS shard range [%d,%d) matches no saved "
+                                 "shard" % (lo, hi))
+            return (parts[0] if len(parts) == 1
+                    else np.concatenate(parts, axis=axis))
+
+        def provider(name, si):
+            pm = ps_meta.get(name)
+            if pm is None:
+                raise KeyError("checkpoint has no host-PS var %r" % name)
+            plan = store.plans[name]
+            axis, nsaved = int(pm["axis"]), int(pm["nshards"])
+            if plan.partitioned and plan.axis != axis:
+                raise ValueError(
+                    "PS var %r: saved split axis %d != running split axis "
+                    "%d" % (name, axis, plan.axis))
+            sizes = pm.get("shard_sizes")
+            if not sizes:  # single saved shard, or a pre-shard_sizes meta
+                sizes = [int(np.asarray(
+                    reader("H|%s::%d" % (name, s))).shape[axis])
+                    for s in range(nsaved)]
+            offs = [0]
+            for s in sizes:
+                offs.append(offs[-1] + int(s))
+            lo, hi = ((plan.shard_ranges()[si]) if plan.partitioned
+                      else (0, -1))
+            vkeys = ["H|%s::%d" % (name, s) for s in range(nsaved)]
+            value = gather_range(vkeys, lo, hi, axis, offs)
+            # optimizer leaves: var-shaped ones re-slice like the value;
+            # shard-invariant ones (step counts, scalars) copy shard 0's
+            opt_flat: Dict[str, np.ndarray] = {}
+            leaf_names = sorted({
+                k.split("|", 2)[2]
+                for s in range(nsaved)
+                for k in groups.get("Ho|%s::%d" % (name, s), [])})
+            for ln in leaf_names:
+                lkeys = ["Ho|%s::%d|%s" % (name, s, ln)
+                         for s in range(nsaved)]
+                probe = np.asarray(reader(lkeys[0]))
+                if probe.ndim > axis and probe.shape[axis] == sizes[0]:
+                    opt_flat[ln] = gather_range(lkeys, lo, hi, axis, offs)
+                else:
+                    opt_flat[ln] = probe
+            return value, opt_flat
+        return provider
 
     # ---------------------------------------------------------------- export
 

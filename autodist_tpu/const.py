@@ -99,11 +99,23 @@ class ENV(Enum):
     ADT_AUTO_RESUME = ("ADT_AUTO_RESUME", bool, False)
     # checkpoint directory the auto-resume (and its periodic saves) use
     ADT_CKPT_DIR = ("ADT_CKPT_DIR", str, DEFAULT_CHECKPOINT_DIR)
+    # sync-elastic reduced-world restart: comma-separated worker addresses
+    # treated as PERMANENTLY lost — AutoDist drops them from the resource
+    # spec at construction, so the restarted job runs at reduced world
+    # size (the cross-topology sharded restore reassembles state). Set by
+    # the coordinator when a worker's death triggers two consecutive
+    # whole-job restarts; can also be set by hand to decommission a host.
+    ADT_ELASTIC_EXCLUDE = ("ADT_ELASTIC_EXCLUDE", str, "")
     # host-PS transfer/compute overlap (parallel/ps.py PSPipeline): 1 =
     # background push + prefetched pull (bit-exact for sync PS; with
     # staleness>=1 or async serving the prefetch overlaps compute fully);
     # 0 = the serial pull->step->push baseline
     ADT_PS_OVERLAP = ("ADT_PS_OVERLAP", int, 1)
+    # host-PS apply parallelism: shard updates are independent by
+    # construction, so they run on a thread pool of this many workers
+    # (0 = auto: min(4, cpu_count); 1 = the single-dispatch baseline).
+    # Bit-exact either way — grouping never changes per-shard math.
+    ADT_PS_APPLY_THREADS = ("ADT_PS_APPLY_THREADS", int, 0)
 
     @property
     def val(self):

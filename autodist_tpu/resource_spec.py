@@ -253,6 +253,31 @@ class ResourceSpec:
     def is_single_node(self) -> bool:
         return len(self._nodes) == 1
 
+    def without_nodes(self, addresses) -> "ResourceSpec":
+        """A copy with ``addresses`` removed — the sync-elastic
+        reduced-world restart path (a permanently lost worker is dropped
+        and the job resumes on the survivors). The chief is never
+        removable: its death ends the job outright."""
+        drop = {a for a in addresses if a}
+        if not drop:
+            return self
+        if self._chief_address in drop:
+            raise ValueError("cannot exclude the chief node %s"
+                             % self._chief_address)
+        unknown = drop - set(self._nodes)
+        if unknown:
+            logging.warning("excluded nodes %s not in the resource spec",
+                            sorted(unknown))
+        spec = ResourceSpec()
+        spec._nodes = {a: n for a, n in self._nodes.items() if a not in drop}
+        spec._chief_address = self._chief_address
+        spec._ssh_config_map = self._ssh_config_map
+        spec._slice_info = dict(self._slice_info)
+        logging.warning("resource spec reduced: dropped %s, %d node(s) "
+                        "remain", sorted(drop & set(self._nodes)),
+                        len(spec._nodes))
+        return spec
+
     def __repr__(self):
         return "ResourceSpec(nodes=%s, chief=%s, tpus=%d)" % (
             self.node_addresses, self.chief, self.num_tpus)

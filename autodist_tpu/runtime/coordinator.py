@@ -94,9 +94,13 @@ class Coordinator:
         self._live_procs: dict = {}        # address -> current launcher proc
         # sync-elastic (checkpoint-restore orchestration): worker death
         # restarts the WHOLE job from the latest checkpoint instead of
-        # relaunching one worker (autodist.py enables it for sync
-        # strategies under ADT_ELASTIC)
-        self._sync_elastic = False
+        # relaunching one worker. ADT_ELASTIC_SYNC at bring-up declares the
+        # job sync-elastic from CONSTRUCTION — a worker dying in the join
+        # window (before the chief has even built the strategy) must route
+        # to the whole-job path, not the per-worker soundness gate; the
+        # build path re-confirms via enable_sync_elastic()
+        self._sync_elastic = (const.ENV.ADT_ELASTIC.val > 0
+                              and const.ENV.ADT_ELASTIC_SYNC.val)
         atexit.register(self.join)
 
     def enable_sync_elastic(self):
@@ -223,7 +227,7 @@ class Coordinator:
             for e in (const.ENV.ADT_MIN_LOG_LEVEL, const.ENV.ADT_IS_TESTING,
                       const.ENV.ADT_PATCH_OPTAX, const.ENV.ADT_ELASTIC,
                       const.ENV.ADT_ELASTIC_SYNC, const.ENV.ADT_AUTO_RESUME,
-                      const.ENV.ADT_CKPT_DIR,
+                      const.ENV.ADT_CKPT_DIR, const.ENV.ADT_ELASTIC_EXCLUDE,
                       const.ENV.ADT_HEARTBEAT_TIMEOUT_S):
                 raw = os.environ.get(e.name_str)
                 if raw is not None:
@@ -332,19 +336,45 @@ class Coordinator:
                 "budget (%d) is spent — failing fast", address, code,
                 self._max_restarts)
             return False
-        from autodist_tpu.checkpoint.saver import Saver
+        # same probe the runner's auto-resume uses — the nothing-to-restore
+        # fail-fast here and the actual resume there must agree
+        from autodist_tpu.checkpoint import latest_checkpoint
         ckpt_dir = const.ENV.ADT_CKPT_DIR.val
-        try:
-            has_ckpt = Saver(directory=ckpt_dir).latest() is not None
-        except OSError:
-            has_ckpt = False
-        if not has_ckpt:
+        found, _saver = latest_checkpoint(ckpt_dir)
+        cur_step = -1 if found is None else found
+        if cur_step < 0:
             logging.error(
                 "sync-elastic: worker %s died (code %s) before any "
                 "checkpoint landed in %s — nothing to restore, failing "
                 "fast (save at least once per restart window)", address,
                 code, ckpt_dir)
             return False
+        # permanently-lost detection: a worker whose death triggers two
+        # whole-job restarts WITHOUT checkpoint progress in between (it
+        # died, the job restarted, it died again before any new step was
+        # committed) is excluded — the restarted job runs at REDUCED world
+        # size, with the cross-topology sharded restore
+        # (checkpoint/sharded.py) reassembling the survivors' state. The
+        # checkpoint-step guard keeps transient preemptions hours apart
+        # from decommissioning a healthy host: any committed progress
+        # resets the "consecutive" condition.
+        last_dead = os.environ.get("ADT_ELASTIC_LAST_DEAD", "")
+        last_step = int(os.environ.get("ADT_ELASTIC_LAST_DEAD_STEP", "-1"))
+        exclude = [a for a in
+                   os.environ.get(const.ENV.ADT_ELASTIC_EXCLUDE.name_str,
+                                  "").split(",") if a]
+        if (address == last_dead and cur_step <= last_step
+                and address not in exclude):
+            exclude.append(address)
+            os.environ[const.ENV.ADT_ELASTIC_EXCLUDE.name_str] = (
+                ",".join(exclude))
+            logging.error(
+                "sync-elastic: worker %s died twice with no checkpoint "
+                "progress (still at step %d) — treating it as PERMANENTLY "
+                "lost; the job restarts at reduced world size without it "
+                "(excluded: %s)", address, cur_step, exclude)
+        os.environ["ADT_ELASTIC_LAST_DEAD"] = address
+        os.environ["ADT_ELASTIC_LAST_DEAD_STEP"] = str(cur_step)
         logging.warning(
             "sync-elastic: worker %s died (code %s) mid-lockstep — "
             "restarting the WHOLE job from the latest checkpoint "

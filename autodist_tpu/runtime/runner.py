@@ -112,15 +112,20 @@ class Runner:
         every process calls init(), so the restore's collective placement
         runs everywhere."""
         if const.ENV.ADT_AUTO_RESUME.val:
-            from autodist_tpu.checkpoint.saver import Saver
-            saver = Saver(directory=const.ENV.ADT_CKPT_DIR.val)
-            if saver.latest() is not None:
+            # probe BOTH checkpoint formats — a sync-elastic job that
+            # checkpoints through ShardedSaver (the scale path) must
+            # auto-resume from its shard files, not fail fast because no
+            # plain-format meta exists; when both exist, the newer step wins
+            from autodist_tpu.checkpoint import latest_checkpoint
+            _, saver = latest_checkpoint(const.ENV.ADT_CKPT_DIR.val)
+            if saver is not None:
                 # restore() builds the placed state itself — a fresh
                 # init_state first would materialize the whole tree on
                 # device just to throw it away
                 _, step = saver.restore(self)
-                logging.warning("ADT_AUTO_RESUME: restored step %d from %s",
-                                step, const.ENV.ADT_CKPT_DIR.val)
+                logging.warning("ADT_AUTO_RESUME: restored step %d from %s "
+                                "(%s)", step, const.ENV.ADT_CKPT_DIR.val,
+                                type(saver).__name__)
                 return self.state
             if const.ENV.ADT_NUM_PROCESSES.val > 1:
                 # one process starting fresh while lockstep peers restore

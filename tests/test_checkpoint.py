@@ -347,7 +347,94 @@ def test_sharded_max_to_keep_and_async(tmp_path):
     assert step == 4
 
 
-def test_sharded_topology_mismatch_raises(tmp_path):
+def _ragged_problem():
+    """Split dim 18 is NOT divisible by 8/4/2 the same way, so every mesh
+    size pads differently (8-way -> 24, 4-way -> 20, 2-way -> 18): the
+    cross-topology restore must re-pad, not just re-slice."""
+    rng = np.random.RandomState(7)
+    params = {"emb": jnp.asarray(rng.randn(18, 4).astype(np.float32)),
+              "w": jnp.asarray(rng.randn(4, 2).astype(np.float32))}
+
+    def loss_fn(p, batch):
+        feat = jnp.take(p["emb"], batch["ids"], axis=0)
+        pred = feat @ p["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    batch = {"ids": rng.randint(0, 18, (16,)).astype(np.int32),
+             "y": rng.randn(16, 2).astype(np.float32)}
+    return params, loss_fn, batch
+
+
+def _cpu_spec(n):
+    from autodist_tpu.resource_spec import ResourceSpec
+    return ResourceSpec.from_dict(
+        {"nodes": [{"address": "127.0.0.1", "chief": True,
+                    "cpus": list(range(n))}]})
+
+
+@pytest.mark.parametrize("builder", ["PartitionedAR", "PartitionedPS"])
+def test_sharded_restore_across_topologies(tmp_path, builder):
+    """VERDICT-r4 #1: save on an 8-device mesh, restore BIT-EXACT on 4 and
+    on 2 (different padding each time), then scale back up 2 -> 8 — slices
+    reassembled from the global ranges in the npz keys, the reference's
+    topology-independent SaveSliceInfo property
+    (reference ``autodist/kernel/partitioner.py:292-347``)."""
+    from autodist_tpu.checkpoint import ShardedSaver
+    make = lambda: getattr(S, builder)()  # noqa: E731
+    params, loss_fn, batch = _ragged_problem()
+    opt = optax.adam(0.05)
+    ad8 = autodist_tpu.AutoDist(strategy_builder=make())
+    runner8 = ad8.build(loss_fn, opt, params, batch)
+    runner8.init(params)
+    for _ in range(3):
+        runner8.run(batch)
+    want = {k: np.asarray(v) for k, v in runner8.gather_params().items()}
+    saver = ShardedSaver(directory=str(tmp_path))
+    saver.save(runner8)
+
+    down_losses = {}
+    for n in (4, 2):
+        autodist_tpu.reset()
+        ad_n = autodist_tpu.AutoDist(resource_spec=_cpu_spec(n),
+                                     strategy_builder=make())
+        runner_n = ad_n.build(loss_fn, opt, params, batch)
+        runner_n.init(params)
+        state, step = saver.restore(runner_n)
+        assert step == 3
+        got = {k: np.asarray(v) for k, v in runner_n.gather_params().items()}
+        assert sorted(got) == sorted(want)
+        for k in want:
+            np.testing.assert_array_equal(got[k], want[k],
+                                          err_msg="8->%d %s" % (n, k))
+        # training continues: the restored optimizer state is live too
+        down_losses[n] = [float(runner_n.run(batch)["loss"])
+                          for _ in range(2)]
+        if n == 2:
+            saver2 = ShardedSaver(directory=str(tmp_path / "from2"))
+            saver2.save(runner_n)
+            want2 = {k: np.asarray(v)
+                     for k, v in runner_n.gather_params().items()}
+
+    # data-parallel math is device-count-invariant (global-batch mean), so
+    # the two scale-down continuations must agree closely
+    np.testing.assert_allclose(down_losses[4], down_losses[2], rtol=1e-5)
+
+    # scale-UP: the 2-device checkpoint restores bit-exact on 8 devices
+    autodist_tpu.reset()
+    ad8b = autodist_tpu.AutoDist(strategy_builder=make())
+    runner8b = ad8b.build(loss_fn, opt, params, batch)
+    runner8b.init(params)
+    state, step = saver2.restore(runner8b)
+    assert step == 5
+    got = {k: np.asarray(v) for k, v in runner8b.gather_params().items()}
+    for k in want2:
+        np.testing.assert_array_equal(got[k], want2[k],
+                                      err_msg="2->8 %s" % k)
+
+
+def test_sharded_flex_refuses_unknown_axis(tmp_path):
+    """Cross-topology restore still refuses what it cannot do: a leaf
+    sharded over a mesh axis the running mesh does not have."""
     from autodist_tpu.checkpoint import ShardedSaver
     params, loss_fn, batch = _problem()
     ad = autodist_tpu.AutoDist(strategy_builder=S.PartitionedAR())
@@ -356,12 +443,12 @@ def test_sharded_topology_mismatch_raises(tmp_path):
     runner.run(batch)
     saver = ShardedSaver(directory=str(tmp_path))
     base = saver.save(runner)
-    # forge a meta claiming a different topology
     import json
     meta = json.load(open(base + ".shard-meta.json"))
-    meta["mesh"]["shape"] = [4]
+    meta["mesh"]["shape"] = [4]  # force the flex path
+    meta["leaves"]["P|emb"]["spec"] = ["model"]
     json.dump(meta, open(base + ".shard-meta.json", "w"))
-    with pytest.raises(ValueError, match="SAME topology"):
+    with pytest.raises(ValueError, match="absent from the running mesh"):
         saver.restore(runner)
 
 

@@ -566,3 +566,53 @@ def test_two_process_sharded_async_ownership(tmp_path):
                                           ckpt)
         for r in (res0, res1):
             assert all(np.isfinite(r["losses"])), r["losses"]
+
+
+def _launch_sharded_single(tmp_path, builder, ckpt_dir, n_devices):
+    """Resume a sharded checkpoint in ONE fresh process with ``n_devices``
+    local devices — a different world size AND mesh shape than the
+    2-process x 4-device save."""
+    spec = tmp_path / ("spec1-%d.yml" % n_devices)
+    spec.write_text(
+        "nodes:\n  - address: 127.0.0.1\n    chief: true\n    cpus: [%s]\n"
+        % ", ".join(str(i) for i in range(n_devices)))
+    out = tmp_path / ("sh1-resume-%d.json" % n_devices)
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    for k in ("ADT_COORDINATOR_ADDR", "ADT_NUM_PROCESSES",
+              "ADT_PROCESS_ID", "ADT_WORKER", "ADT_EXTERNAL_LAUNCH"):
+        env.pop(k, None)
+    env.update({
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=%d" % n_devices,
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(HERE)] +
+            ([os.environ["PYTHONPATH"]]
+             if os.environ.get("PYTHONPATH") else [])),
+    })
+    proc = subprocess.Popen(
+        [sys.executable, SHARDED_DRIVER, str(spec), str(out), builder,
+         "resume", str(ckpt_dir)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    log = proc.communicate(timeout=240)[0]
+    assert proc.returncode == 0, "single resume failed:\n%s" % log
+    return json.loads(out.read_text())
+
+
+@pytest.mark.parametrize("builder", ["PartitionedAR", "PartitionedPS"])
+def test_sharded_cross_world_resume(tmp_path, builder):
+    """VERDICT-r4 #1 acceptance at the process level: a checkpoint saved
+    by 2 processes over an 8-device mesh restores in ONE process over a
+    4-device mesh (reduced world size — the permanently-lost-worker
+    shape), reading slices from BOTH saved shard files, and training
+    continues on the uninterrupted run's trajectory."""
+    ckpt = tmp_path / "ckpt"
+    run0, _run1 = _launch_sharded_pair(tmp_path, builder, "run", ckpt)
+    res = _launch_sharded_single(tmp_path, builder, ckpt, 4)
+    assert res["process_count"] == 1
+    # steps 4..5 after the cross-topology restore track the uninterrupted
+    # run (reduction ORDER differs across device counts, so allclose)
+    np.testing.assert_allclose(run0["losses"][3:], res["losses"],
+                               rtol=1e-4, atol=1e-6)
+    for k in run0["params"]:
+        np.testing.assert_allclose(run0["params"][k], res["params"][k],
+                                   rtol=1e-4, atol=1e-6, err_msg=k)
