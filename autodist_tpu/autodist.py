@@ -83,6 +83,14 @@ class AutoDist:
             self._resource_spec = ResourceSpec(resource_spec_file)
         else:
             self._resource_spec = ResourceSpec.from_local()
+        excluded = [a for a in
+                    const.ENV.ADT_ELASTIC_EXCLUDE.val.split(",") if a]
+        if excluded:
+            # permanently-lost workers (sync-elastic reduced-world
+            # restart): every process sees the same reduced spec, so the
+            # chief builds the strategy for — and the workers join — the
+            # smaller world
+            self._resource_spec = self._resource_spec.without_nodes(excluded)
         if strategy_builder is None:
             from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing
             strategy_builder = PSLoadBalancing()  # default, as in reference autodist.py:70
@@ -246,20 +254,33 @@ class AutoDist:
     def build(self, loss_fn: Callable, optimizer, params, example_batch,
               has_aux: bool = False, apply_fn: Optional[Callable] = None,
               trainable_filter: Optional[Callable] = None,
-              mp_rules=None) -> Runner:
+              mp_rules=None, mp_meta=None) -> Runner:
         """Capture + compile + lower; returns a Runner (uninitialized).
         ``mp_rules`` (e.g. ``models.tp_lm.tp_rules()``) registers the
-        model's tensor-parallel sharding map so AutoStrategy searches the
-        TP space too."""
+        model's model-parallel sharding map so AutoStrategy searches the
+        TP/PP/EP space too; ``mp_meta`` carries the search hints
+        (pp_microbatches, pp_schedules, seq_parallel)."""
         item = ModelItem(loss_fn=loss_fn, optimizer=optimizer, params=params,
                          example_batch=example_batch, has_aux=has_aux,
                          apply_fn=apply_fn,
                          trainable_filter=trainable_filter,
-                         mp_rules=mp_rules).prepare()
+                         mp_rules=mp_rules, mp_meta=mp_meta).prepare()
         strategy = self._build_or_load_strategy(item)
         compiled = StrategyCompiler(item, self._resource_spec).compile(strategy)
         logging.info("compiled %r", compiled)
         logging.debug("compiled strategy:\n%s", compiled)
+        # the pipeline schedule is baked into the loss at model-build time;
+        # a strategy claiming a different one (an AutoStrategy alternate
+        # from mp_meta['pp_schedules']) would be priced/gated for a program
+        # that never runs — fail with the rebuild instruction instead
+        declared = (item.mp_meta or {}).get("pp_schedule")
+        picked = compiled.graph_config.pp_schedule
+        if declared and picked and declared != picked:
+            raise ValueError(
+                "the strategy wants pipeline schedule %r but the loss was "
+                "built with %r — rebuild the model's loss "
+                "(make_train_setup(schedule=%r)) and declare it via "
+                "mp_meta['pp_schedule']" % (picked, declared, picked))
         self._setup(compiled)
         is_async = self._validate_async(compiled, item)
         if (const.ENV.ADT_ELASTIC.val > 0 and not is_async
@@ -302,6 +323,30 @@ class AutoDist:
         dstep = GraphTransformer(compiled, mesh, item).transform()
         if is_async and dstep.ps_store is not None:
             self._wire_async_ps(dstep)
+        self._runner = Runner(dstep, tracing=self._tracing)
+        return self._runner
+
+    def build_step(self, step_fn: Callable, state, example_batch) -> Runner:
+        """Opaque-step capture mode: distribute a hand-written
+        ``step_fn(state, batch) -> (new_state, metrics)`` by assigning
+        strategy-derived shardings (state leaves get their layout's pspec,
+        the batch splits over the data axis) — no gradient interception,
+        so AllReduce/Partitioned families only (host-PS and compressors
+        need :meth:`build`'s loss_fn mode). ``state`` is the user's whole
+        training state (params + optimizer state bundled however they
+        like); the framework never looks inside the step."""
+        item = ModelItem(step_fn=step_fn, params=state,
+                         example_batch=example_batch).prepare()
+        strategy = self._build_or_load_strategy(item)
+        compiled = StrategyCompiler(item, self._resource_spec).compile(strategy)
+        logging.info("compiled %r (step_fn mode)", compiled)
+        if self._validate_async(compiled, item):
+            raise ValueError("async host-PS strategies cannot lower an "
+                             "opaque step_fn — use loss_fn mode")
+        self._setup(compiled)
+        mesh = mesh_lib.mesh_from_strategy(compiled, self._resource_spec,
+                                           backend=self._backend)
+        dstep = GraphTransformer(compiled, mesh, item).transform()
         self._runner = Runner(dstep, tracing=self._tracing)
         return self._runner
 

@@ -189,7 +189,10 @@ class ModelItem:
       ``(scalar, aux)`` with ``has_aux=True``).
     * ``step_fn`` mode: an opaque user step; strategies can only assign
       shardings (the reference has no analog — its kernels always rewrite the
-      graph — but this is the natural JAX low-level escape hatch).
+      graph — but this is the natural JAX low-level escape hatch). Lowered
+      by ``GraphTransformer._transform_step_fn`` (jit in/out_shardings from
+      the layouts; AllReduce/Partitioned families; entry:
+      ``AutoDist.build_step``).
     """
 
     def __init__(self,
@@ -201,7 +204,7 @@ class ModelItem:
                  step_fn: Optional[Callable] = None,
                  apply_fn: Optional[Callable] = None,
                  trainable_filter: Optional[Callable[[str], bool]] = None,
-                 mp_rules=None):
+                 mp_rules=None, mp_meta=None):
         if loss_fn is None and step_fn is None:
             raise ValueError("ModelItem needs loss_fn or step_fn")
         self.loss_fn = loss_fn
@@ -213,8 +216,14 @@ class ModelItem:
         self.has_aux = has_aux
         # model-parallel sharding rules the model family exports (e.g.
         # models.tp_lm.tp_rules()); registering them lets AutoStrategy
-        # enumerate TensorParallel candidates for this model
+        # enumerate model-parallel candidates for this model — the rules'
+        # axis names decide the family (model -> TP, pipe -> PP,
+        # expert -> EP; see strategy/auto_strategy.mp_candidates)
         self.mp_rules = list(mp_rules) if mp_rules else None
+        # extra search hints: pp_microbatches / pp_schedules the loss was
+        # built with, seq_parallel=True when the model's attention shards
+        # the sequence dim (ring/Ulysses)
+        self.mp_meta = dict(mp_meta) if mp_meta else None
         # default: everything trains except flax's batch_stats collection
         # (BatchNorm running statistics are EMA state, not weights — updating
         # them by gradient would corrupt normalization)

@@ -1,0 +1,114 @@
+"""Opaque step_fn capture mode (VERDICT-r4 #2).
+
+The framework's analog of the reference's distribute-any-graph generality
+(reference ``tests/integration/cases/c4.py:31`` distributes arbitrary
+captured graphs, while-loops and all): a hand-written
+``step_fn(state, batch) -> (new_state, metrics)`` — gradients, momentum,
+update rule all inside, invisible to the framework — lowers by sharding
+assignment (``GraphTransformer._transform_step_fn``) and matches
+single-device numerics under the AllReduce and Partitioned families.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import autodist_tpu
+from autodist_tpu import strategy as S
+
+
+def _opaque_problem():
+    rng = np.random.RandomState(3)
+    w = jnp.asarray(rng.randn(16, 4) * 0.3, jnp.float32)
+    b = jnp.zeros((4,), jnp.float32)
+    # the state bundles params AND optimizer state (momentum) in one opaque
+    # tree — the framework must not need to understand its structure
+    state = {"w": w, "b": b,
+             "mom": {"w": jnp.zeros_like(w), "b": jnp.zeros_like(b)}}
+    batch = {"x": rng.randn(32, 16).astype(np.float32),
+             "y": rng.randn(32, 4).astype(np.float32)}
+
+    def step_fn(state, batch):
+        def loss(p):
+            pred = batch["x"] @ p["w"] + p["b"]
+            return jnp.mean((pred - batch["y"]) ** 2)
+        l, g = jax.value_and_grad(loss)({"w": state["w"], "b": state["b"]})
+        mom = {k: 0.9 * state["mom"][k] + g[k] for k in g}
+        new = {"w": state["w"] - 0.1 * mom["w"],
+               "b": state["b"] - 0.1 * mom["b"], "mom": mom}
+        return new, {"loss": l}
+
+    return state, step_fn, batch
+
+
+def _flatten(tree):
+    from autodist_tpu.kernel.common.variable_utils import flatten_named
+    names, leaves, _ = flatten_named(tree)
+    return dict(zip(names, (np.asarray(l) for l in leaves)))
+
+
+@pytest.mark.parametrize("builder", ["AllReduce", "PartitionedAR"])
+def test_step_fn_matches_single_device(builder):
+    state, step_fn, batch = _opaque_problem()
+
+    # single-device reference trajectory
+    sstep = jax.jit(step_fn)
+    ref_state, ref_losses = state, []
+    for _ in range(5):
+        ref_state, m = sstep(ref_state, batch)
+        ref_losses.append(float(m["loss"]))
+
+    autodist_tpu.reset()
+    ad = autodist_tpu.AutoDist(strategy_builder=getattr(S, builder)())
+    runner = ad.build_step(step_fn, state, batch)
+    runner.init(state)
+    losses = [float(runner.run(batch)["loss"]) for _ in range(5)]
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5, atol=1e-6)
+
+    got = _flatten(runner.gather_params())
+    want = _flatten(ref_state)
+    assert sorted(got) == sorted(want)
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-5, atol=1e-6,
+                                    err_msg=k)
+    autodist_tpu.reset()
+
+
+def test_step_fn_partitioned_storage_is_sharded():
+    """PartitionedAR assigns ZeRO-style sharded storage: the big state
+    leaves live sharded over the data axis (one shard per device), and the
+    lowered program carries the implied gathers."""
+    state, step_fn, batch = _opaque_problem()
+    autodist_tpu.reset()
+    ad = autodist_tpu.AutoDist(strategy_builder=S.PartitionedAR())
+    runner = ad.build_step(step_fn, state, batch)
+    runner.init(state)
+    runner.run(batch)
+    w = runner.state.params["w"]
+    from jax.sharding import PartitionSpec as P
+    assert w.sharding.spec == P("data"), w.sharding
+    # 16 rows over 8 devices -> 2-row shards, no padding on the opaque path
+    assert w.addressable_shards[0].data.shape == (2, 4)
+    autodist_tpu.reset()
+
+
+def test_step_fn_refuses_host_ps():
+    state, step_fn, batch = _opaque_problem()
+    autodist_tpu.reset()
+    ad = autodist_tpu.AutoDist(strategy_builder=S.PartitionedPS())
+    with pytest.raises(ValueError, match="step_fn capture mode cannot"):
+        ad.build_step(step_fn, state, batch)
+    autodist_tpu.reset()
+
+
+def test_step_fn_bad_structure_raises():
+    state, _step, batch = _opaque_problem()
+
+    def bad(state, batch):
+        return state  # no metrics
+
+    autodist_tpu.reset()
+    ad = autodist_tpu.AutoDist(strategy_builder=S.AllReduce())
+    with pytest.raises(ValueError, match="must return"):
+        ad.build_step(bad, state, batch)
+    autodist_tpu.reset()
