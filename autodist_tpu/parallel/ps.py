@@ -39,6 +39,7 @@ device arrays.
 """
 import dataclasses
 import functools
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -268,6 +269,16 @@ class PSStore:
         # full_values) may hold references to the stored buffers while the
         # async apply thread runs; donating would invalidate them mid-read.
         self._apply_batch = jax.jit(self._apply_batch_impl)
+        # shard updates are independent, so the apply fans out over a
+        # thread pool (DLRM-scale tables: one CPU core running the whole
+        # optimizer pass leaves the rest of the host idle). Deterministic
+        # round-robin grouping -> stable jit cache AND bit-exact results.
+        from autodist_tpu import const as _const
+        n = _const.ENV.ADT_PS_APPLY_THREADS.val
+        if n <= 0:
+            n = min(4, os.cpu_count() or 1)
+        self._apply_threads = n
+        self._apply_pool = None  # lazily built on first parallel apply
 
     # ------------------------------------------------------------ lifecycle
 
@@ -284,6 +295,40 @@ class PSStore:
         for key in shards:
             new_vals[key], new_opts[key] = self._apply_impl(
                 shards[key], opt_states[key], grads[key])
+        return new_vals, new_opts
+
+    def _apply_sharded(self, shards, opts, gshards):
+        """Dispatch the per-shard updates — one jitted program when the
+        pool is disabled or there is a single shard, else round-robin
+        groups over the thread pool. Grouping is deterministic (sorted
+        keys, fixed stride), so the jit cache is stable across steps and
+        the per-shard math — hence the result — is identical to the
+        single-dispatch baseline."""
+        keys = sorted(shards)
+        n = min(self._apply_threads, len(keys))
+        if n <= 1:
+            return self._apply_batch(shards, opts, gshards)
+        if self._apply_pool is None:
+            import concurrent.futures
+            self._apply_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=self._apply_threads,
+                thread_name_prefix="adt-ps-apply")
+        groups = [keys[i::n] for i in range(n)]
+
+        def run(group):
+            # jax.default_device is THREAD-local: without re-entering it,
+            # pool workers would dispatch the host update onto the
+            # accelerator (observed: 250x slower through a TPU tunnel)
+            with jax.default_device(self._cpu):
+                return self._apply_batch({k: shards[k] for k in group},
+                                         {k: opts[k] for k in group},
+                                         {k: gshards[k] for k in group})
+        futures = [self._apply_pool.submit(run, g) for g in groups]
+        new_vals, new_opts = {}, {}
+        for f in futures:
+            nv, no = f.result()
+            new_vals.update(nv)
+            new_opts.update(no)
         return new_vals, new_opts
 
     @staticmethod
@@ -570,7 +615,7 @@ class PSStore:
                     add(name, si, np.asarray(gs))
             if not order:
                 return
-            new_vals, new_opts = self._apply_batch(shards, opts, gshards)
+            new_vals, new_opts = self._apply_sharded(shards, opts, gshards)
             per_var: Dict[str, Dict[int, Tuple]] = {}
             for name, si, key in order:
                 per_var.setdefault(name, {})[si] = (
@@ -679,6 +724,9 @@ class PSStore:
                 grp["worker"].drain(timeout)
 
     def close(self) -> None:
+        if self._apply_pool is not None:
+            self._apply_pool.shutdown(wait=True)
+            self._apply_pool = None
         if self._serve_groups is not None:
             for grp in self._serve_groups.values():
                 stopped = True

@@ -83,6 +83,46 @@ def timed_run(overlap: int, staleness: int, steps: int = 8):
     return out
 
 
+def apply_scaling(n_shards: int = 4, rows: int = 16384, cols: int = 2048,
+                  iters: int = 12, threads=(1, 2, 4)):
+    """Store-level microbench of the host optimizer apply: one DLRM-ish
+    partitioned table, adam, timed through PSStore.apply_local with the
+    thread pool at 1 (baseline) vs N workers. Shards are independent, so
+    the update parallelizes across host cores (ADT_PS_APPLY_THREADS)."""
+    import jax.numpy as jnp
+    import optax
+    from autodist_tpu.parallel.ps import PSStore, PSVarPlan
+
+    rng = np.random.RandomState(0)
+    full = rng.randn(rows, cols).astype(np.float32) * 0.02
+    grad = rng.randn(rows, cols).astype(np.float32) * 0.001
+    sizes = tuple([rows // n_shards] * n_shards)
+    plan = PSVarPlan(var_name="emb", destinations=("127.0.0.1",) * n_shards,
+                     shard_sizes=sizes)
+
+    class _Info:
+        shape = (rows, cols)
+    out = {"bench": "apply_scaling", "n_shards": n_shards,
+           "mb": round(full.nbytes / 1e6, 1)}
+    base_ms = None
+    for n in threads:
+        os.environ["ADT_PS_APPLY_THREADS"] = str(n)
+        store = PSStore({"emb": plan}, {"emb": _Info()}, optax.adam(1e-3))
+        store.init_params({"emb": jnp.asarray(full)})
+        store.push({"emb": grad})  # warmup: trace + compile the groups
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            store.push({"emb": grad})
+        ms = 1e3 * (time.perf_counter() - t0) / iters
+        store.close()
+        if base_ms is None:
+            base_ms = ms
+        out["threads_%d_ms" % n] = round(ms, 2)
+        out["threads_%d_speedup" % n] = round(base_ms / ms, 2)
+    os.environ.pop("ADT_PS_APPLY_THREADS", None)
+    return out
+
+
 def main():
     results = []
     for staleness in (0, 1):
@@ -96,6 +136,7 @@ def main():
         "stale1_speedup": round(by[("serial", 1)] / by[("overlap", 1)], 3),
     }
     print(json.dumps({"summary": summary}), flush=True)
+    print(json.dumps(apply_scaling()), flush=True)
 
 
 if __name__ == "__main__":
