@@ -93,7 +93,8 @@ def _block(p, x, dt, model_axis):
 
 def forward(params, input_ids, cfg: TPLMConfig, n_microbatches: int = 1,
             pipe_axis: str = const.PIPELINE_AXIS,
-            model_axis: str = const.MODEL_AXIS):
+            model_axis: str = const.MODEL_AXIS,
+            virtual_stages: int = 1, pp_shards: int = 0):
     dt = cfg.dtype
     seq_len = input_ids.shape[-1]
     x = tensor.vocab_parallel_embed(params["embed"], input_ids, model_axis)
@@ -107,8 +108,13 @@ def forward(params, input_ids, cfg: TPLMConfig, n_microbatches: int = 1,
         return pipeline.stacked_scan(
             lambda p, hh: _block(p, hh, dt, model_axis), blocks_local, h)
 
-    x = pipeline.pipeline_apply(stage_fn, params["blocks"], x,
-                                n_microbatches, pipe_axis)
+    if virtual_stages > 1:
+        x = pipeline.pipeline_apply_interleaved(
+            stage_fn, params["blocks"], x, n_microbatches, virtual_stages,
+            pipe_axis, pp_shards_hint=pp_shards)
+    else:
+        x = pipeline.pipeline_apply(stage_fn, params["blocks"], x,
+                                    n_microbatches, pipe_axis)
     x = _layer_norm(x, params["final_ln"])
     return tensor.vocab_parallel_logits(x, params["embed"].astype(dt))
 
@@ -117,21 +123,37 @@ def make_train_setup(cfg: Optional[TPLMConfig] = None, seq_len: int = 128,
                      batch_size: int = 8, seed: int = 0,
                      n_microbatches: int = 1,
                      model_axis: str = const.MODEL_AXIS,
-                     schedule: str = "gpipe"):
+                     schedule: str = "gpipe",
+                     virtual_stages: int = 2, pp_shards: int = 0):
     """``schedule="1f1b"`` trains through the fused 1F1B pipeline
     (``parallel/pipeline.pipeline_loss_1f1b``): the loss head moves
     INSIDE the pipelined region so backward microbatches interleave with
     forward ones, bounding activation residency at S microbatches
-    instead of GPipe's M. Same math to float tolerance."""
+    instead of GPipe's M. Same math to float tolerance.
+
+    ``schedule="interleaved"`` uses the virtual-stage schedule
+    (``pipeline_apply_interleaved``): each rank runs ``virtual_stages``
+    layer chunks, cutting the bubble fraction from (S-1)/M to
+    (S-1)/(V*M); pass ``pp_shards`` so single-device traces emulate the
+    same logical layer order (needed for exact reference comparisons)."""
     cfg = cfg or TPLMConfig()
     params = init_params(cfg, seed)
-    if schedule not in ("gpipe", "1f1b"):
-        raise ValueError("schedule must be 'gpipe' or '1f1b'")
+    if schedule not in ("gpipe", "1f1b", "interleaved"):
+        raise ValueError("schedule must be 'gpipe', '1f1b' or 'interleaved'")
+    if schedule == "interleaved" and pp_shards < 2:
+        # without the stage count the single-device degenerate trace
+        # CANNOT emulate the schedule-defined layer order (physical chunk
+        # r*V+c = logical stage c*S+r) and would silently compute a
+        # different network than the pipelined program
+        raise ValueError("schedule='interleaved' requires pp_shards>=2 "
+                         "(the intended pipeline stage count)")
+    vstages = virtual_stages if schedule == "interleaved" else 1
 
     def loss_fn_gpipe(p, batch):
         tokens = batch["tokens"]
         logits = forward(p, tokens[:, :-1], cfg, n_microbatches,
-                         model_axis=model_axis)
+                         model_axis=model_axis, virtual_stages=vstages,
+                         pp_shards=pp_shards)
         nll = tensor.vocab_parallel_xent(logits, tokens[:, 1:], model_axis)
         return jnp.mean(nll)
 
@@ -164,5 +186,7 @@ def make_train_setup(cfg: Optional[TPLMConfig] = None, seq_len: int = 128,
     example_batch = {"tokens": npr.randint(
         0, cfg.vocab_size, (batch_size, seq_len + 1)).astype(np.int32)}
     apply_fn = lambda p, ids: forward(p, ids, cfg, n_microbatches,  # noqa: E731
-                                      model_axis=model_axis)
+                                      model_axis=model_axis,
+                                      virtual_stages=vstages,
+                                      pp_shards=pp_shards)
     return loss_fn, params, example_batch, apply_fn

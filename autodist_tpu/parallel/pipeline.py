@@ -93,6 +93,113 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
     return outs.reshape((B,) + x.shape[1:])
 
 
+def _chunk(tree, c, V):
+    """Chunk ``c`` of a rank-local layer stack: leading dim L_local splits
+    into [V, L_local/V]; works with a traced ``c`` (dynamic index)."""
+    def take(a):
+        sub = a.reshape((V, a.shape[0] // V) + a.shape[1:])
+        return jax.lax.dynamic_index_in_dim(sub, c, 0, keepdims=False)
+    return jax.tree_util.tree_map(take, tree)
+
+
+def pipeline_apply_interleaved(stage_fn: Callable, stage_params, x,
+                               n_microbatches: int, virtual_stages: int,
+                               axis_name: str = const.PIPELINE_AXIS,
+                               pp_shards_hint: int = 0):
+    """Interleaved (virtual-stage) pipeline schedule — Megatron-LM's
+    bubble-cutting variant (Narayanan et al. 2104.04473): each rank holds
+    ``V = virtual_stages`` layer CHUNKS instead of one contiguous block,
+    and microbatches visit rank r's chunk c as virtual stage
+    ``s = c*S + r``. Per-rank work slots go from M (GPipe, V-sized
+    chunks) to M*V (1/V-sized chunks) while the fill/drain bubble stays
+    S-1 slots — the bubble FRACTION shrinks from (S-1)/M to (S-1)/(V*M).
+
+    Model definition: physical stack position ``r*V + c`` (rank-major
+    chunk grid) holds logical stage ``c*S + r``; the unbound degenerate
+    path below applies the same logical order, so single-device traces
+    and the pipelined program compute identical math.
+
+    Slot schedule (forward; AD derives the backward through scan/ppermute
+    exactly as for GPipe): stage s of microbatch m runs at slot
+    ``u = (s mod S) + (s//S)*S + (m mod S) + (m//S)*V*S`` — consecutive
+    stages always land on consecutive slots on ring-adjacent ranks, so
+    the wire is ONE full-ring ppermute per slot (the wraparound edge
+    S-1 -> 0 carries chunk-boundary hops; GPipe's chain never uses it).
+    Needs ``M % S == 0`` (the standard interleaved-schedule constraint)
+    and ``L_local % V == 0``.
+    """
+    V = int(virtual_stages)
+    if V < 1:
+        raise ValueError("virtual_stages must be >= 1")
+    if not axis_bound(axis_name):
+        # Degenerate path: single-device traces (capture, references) see
+        # the FULL stack. The logical network visits physical chunk-grid
+        # position (s % S)*V + s//S for s = 0..S*V-1, so with the
+        # intended stage count as a hint the emulation applies the SAME
+        # permuted order the pipelined program computes; without a hint
+        # (S unknowable) it falls back to the plain sequential stack
+        # (exact only for S == 1).
+        S_hint = int(pp_shards_hint)
+        if S_hint > 1:
+            h = x
+            for s in range(S_hint * V):
+                g = (s % S_hint) * V + (s // S_hint)
+                h = stage_fn(
+                    jax.tree_util.tree_map(
+                        lambda a, g=g: a.reshape(
+                            (S_hint * V, a.shape[0] // (S_hint * V))
+                            + a.shape[1:])[g],
+                        stage_params), h)
+            return h
+        return stage_fn(stage_params, x)
+
+    S = jax.lax.psum(1, axis_name)
+    S_int = int(S)
+    rank = jax.lax.axis_index(axis_name)
+    M = n_microbatches
+    B = x.shape[0]
+    if B % M != 0:
+        raise ValueError("batch %d not divisible by %d microbatches" % (B, M))
+    if M % S_int != 0:
+        raise ValueError(
+            "interleaved schedule needs n_microbatches (%d) divisible by "
+            "pipeline stages (%d)" % (M, S_int))
+    x_mb = x.reshape((M, B // M) + x.shape[1:])
+    ring = [(i, (i + 1) % S_int) for i in range(S_int)]
+
+    state0 = jnp.zeros_like(x_mb[0])
+    outs0 = jnp.zeros_like(x_mb)
+
+    def tick(carry, t):
+        state, outs = carry
+        q = t - rank                       # this rank's work-slot index
+        on = (q >= 0) & (q < M * V)
+        blk = jnp.clip(q, 0, M * V - 1) % (V * S_int)
+        c = jnp.clip(blk // S_int, 0, V - 1)       # chunk = virtual row
+        j = blk % S_int
+        k = jnp.clip(q, 0, M * V - 1) // (V * S_int)
+        m = jnp.clip(k * S_int + j, 0, M - 1)      # microbatch index
+        first = (rank == 0) & (c == 0)             # virtual stage 0
+        inp = jnp.where(first,
+                        jax.lax.dynamic_index_in_dim(x_mb, m, 0,
+                                                     keepdims=False),
+                        state)
+        out = stage_fn(_chunk(stage_params, c, V), inp)
+        out = jnp.where(on, out, jnp.zeros_like(out))
+        # virtual stage V*S-1 = rank S-1's chunk V-1 finishes microbatch m
+        done = on & (rank == S - 1) & (c == V - 1)
+        written = jax.lax.dynamic_update_slice_in_dim(outs, out[None], m, 0)
+        outs = jnp.where(done, written, outs)
+        state = jax.lax.ppermute(out, axis_name, ring)
+        return (state, outs), None
+
+    T = M * V + S_int - 1
+    (_, outs), _ = jax.lax.scan(tick, (state0, outs0), jnp.arange(T))
+    outs = jax.lax.psum(
+        jnp.where(rank == S - 1, outs, jnp.zeros_like(outs)), axis_name)
+    return outs.reshape((B,) + x.shape[1:])
+
+
 def stacked_scan(block_fn: Callable, stacked_params, h):
     """Apply ``block_fn(params_i, h) -> h`` for each leading-dim slice of
     ``stacked_params`` via ``lax.scan`` (compile-time-friendly for deep

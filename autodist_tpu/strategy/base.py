@@ -187,10 +187,14 @@ class GraphConfig:
     # cost model can price the pipeline bubble ((S-1+M)/M compute
     # inflation) from the serialized strategy alone
     pp_microbatches: Optional[int] = None
-    # pipeline schedule: "gpipe" (all-M activation residency) or "1f1b"
+    # pipeline schedule: "gpipe" (all-M activation residency), "1f1b"
     # (residency bounded at S in-flight microbatches; the model must build
-    # its loss through pipeline_loss_1f1b) — priced by the cost model
+    # its loss through pipeline_loss_1f1b), or "interleaved" (V virtual
+    # stage chunks per rank, bubble cut to (S-1)/(V*M) — model builds
+    # through pipeline_apply_interleaved) — priced by the cost model
     pp_schedule: Optional[str] = None
+    # virtual-stage chunks per rank for the interleaved schedule (V >= 2)
+    pp_virtual: Optional[int] = None
     # strict sparse wire: a builder that PLANNED on (ids, values) gradient
     # shipping (DLRM/NCF embedding strategies) sets this so a silent
     # fallback to dense sync — a >10x wire regression — raises in the
@@ -202,6 +206,7 @@ class GraphConfig:
                 "seq_axis": self.seq_axis, "batch_axes": self.batch_axes,
                 "remat": self.remat, "pp_microbatches": self.pp_microbatches,
                 "pp_schedule": self.pp_schedule,
+                "pp_virtual": self.pp_virtual,
                 "require_sparse": self.require_sparse}
 
     @classmethod
@@ -213,6 +218,7 @@ class GraphConfig:
                    remat=d.get("remat"),
                    pp_microbatches=d.get("pp_microbatches"),
                    pp_schedule=d.get("pp_schedule"),
+                   pp_virtual=d.get("pp_virtual"),
                    require_sparse=bool(d.get("require_sparse", False)))
 
 

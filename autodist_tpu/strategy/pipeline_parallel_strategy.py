@@ -28,18 +28,30 @@ class PipelineParallel(AllReduce):
                  n_microbatches: int = 4, tp_shards: int = 1,
                  chunk_size: int = 128, all_reduce_spec: str = "AUTO",
                  compressor: str = "NoneCompressor",
-                 schedule: str = "gpipe"):
+                 schedule: str = "gpipe", virtual_stages: int = 2):
         super().__init__(chunk_size, all_reduce_spec, compressor)
         if pp_shards < 1 or tp_shards < 1:
             raise ValueError("pp_shards/tp_shards must be >= 1")
         if n_microbatches < 1:
             raise ValueError("n_microbatches must be >= 1")
-        if schedule not in ("gpipe", "1f1b"):
-            raise ValueError("schedule must be 'gpipe' or '1f1b'")
+        if schedule not in ("gpipe", "1f1b", "interleaved"):
+            raise ValueError(
+                "schedule must be 'gpipe', '1f1b' or 'interleaved'")
+        if schedule == "interleaved":
+            if virtual_stages < 2:
+                raise ValueError("interleaved schedule needs "
+                                 "virtual_stages >= 2")
+            if n_microbatches % pp_shards:
+                raise ValueError(
+                    "interleaved schedule needs n_microbatches (%d) "
+                    "divisible by pp_shards (%d)"
+                    % (n_microbatches, pp_shards))
         self.pp_shards = pp_shards
         self.tp_shards = tp_shards
         self.n_microbatches = n_microbatches
         self.schedule = schedule
+        self.virtual_stages = virtual_stages if schedule == "interleaved" \
+            else None
         self.mp_rules = list(mp_rules)
 
     def build(self, model_item, resource_spec) -> Strategy:
@@ -58,6 +70,7 @@ class PipelineParallel(AllReduce):
         strategy.graph_config.mesh_shape = mesh_shape
         strategy.graph_config.pp_microbatches = self.n_microbatches
         strategy.graph_config.pp_schedule = self.schedule
+        strategy.graph_config.pp_virtual = self.virtual_stages
         add_frozen_nodes(strategy, model_item)
         n = apply_mp_rules(strategy, self.mp_rules)
         logging.info("PipelineParallel: %d/%d vars pipe-sharded, mesh %s, "

@@ -129,7 +129,14 @@ def test_pp_trains():
 # ------------------------------------------------------------------- 1F1B
 
 
-@pytest.mark.parametrize("pp,tp,micro", [(2, 1, 2), (4, 1, 4), (2, 2, 2)])
+@pytest.mark.parametrize("pp,tp,micro", [
+    (2, 1, 2), (4, 1, 4), (2, 2, 2),
+    # DEEP cases (VERDICT-r4 #7): M >> S drives the circular stash through
+    # many wrap-arounds (M/S full rotations), and pp8 runs the deepest
+    # pipe the 8-device mesh allows (dp1) — the indexing regimes structure
+    # tests can't certify numerically
+    (4, 1, 16), (8, 1, 8),
+])
 def test_pp_lm_1f1b_matches_single_device(pp, tp, micro):
     """Full stack with schedule='1f1b' == plain single-device training of
     the same (degenerate-path) loss — the interleaved schedule computes
@@ -138,9 +145,10 @@ def test_pp_lm_1f1b_matches_single_device(pp, tp, micro):
     (legal: branch parity is uniform over the model axis)."""
     cfg = TPLMConfig.tiny(num_layers=max(2, pp))
     model_axis = const.MODEL_AXIS if tp > 1 else None
+    dp = 8 // (pp * tp)
     loss_fn, params, batch, _ = pipe_lm.make_train_setup(
-        cfg, seq_len=16, batch_size=8, seed=1, n_microbatches=micro,
-        schedule="1f1b", model_axis=model_axis)
+        cfg, seq_len=16, batch_size=max(8, micro * dp), seed=1,
+        n_microbatches=micro, schedule="1f1b", model_axis=model_axis)
     opt = optax.sgd(0.05)
     rng = np.random.RandomState(2)
     batches = [batch, {"tokens": rng.randint(
@@ -177,7 +185,7 @@ def test_1f1b_schedule_structure():
     activation residency the schedule exists for (GPipe's AD instead
     stashes all M+S-1 ticks' residuals)."""
     from autodist_tpu.parallel import pipeline as pl
-    S, M, B, D = 4, 8, 16, 6
+    S, M, B, D = 4, 16, 16, 6  # M >> S: the stash must stay S-slot
     mesh = Mesh(np.array(jax.devices()[:S]), (const.PIPELINE_AXIS,))
 
     def stage_fn(w, h):
@@ -250,3 +258,171 @@ def test_cost_model_ranks_1f1b_when_activations_dominate():
     r = tight.rank(cands)
     assert r[0].label == "pp/1f1b"
     assert r[0].breakdown.feasible and not r[1].breakdown.feasible
+
+
+# ----------------------------------------------------------- interleaved
+
+
+def test_interleaved_primitive_matches_logical_reference():
+    """pipeline_apply_interleaved == the single-device logical-order
+    emulation (pp_shards_hint), forward AND gradient, at S=4 x V=2 with
+    M=8 microbatches."""
+    from autodist_tpu.parallel import pipeline as pl
+    S, V, M, B, D = 4, 2, 8, 16, 6
+    L = S * V * 3
+    rng = np.random.RandomState(0)
+    ws = jnp.asarray(rng.randn(L, D, D) * 0.2, jnp.float32)
+    x = jnp.asarray(rng.randn(B, D), jnp.float32)
+
+    def stage_fn(w, h):
+        return pl.stacked_scan(lambda p, hh: jnp.tanh(hh @ p), w, h)
+
+    ref = pl.pipeline_apply_interleaved(stage_fn, ws, x, M, V,
+                                        pp_shards_hint=S)
+    mesh = Mesh(np.array(jax.devices()[:S]), (const.PIPELINE_AXIS,))
+    out = jax.jit(jax.shard_map(
+        lambda w, xx: pl.pipeline_apply_interleaved(stage_fn, w, xx, M, V),
+        mesh=mesh, in_specs=(P(const.PIPELINE_AXIS), P()), out_specs=P(),
+        check_vma=False))(ws, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+    def loss_ref(w):
+        return jnp.sum(pl.pipeline_apply_interleaved(
+            stage_fn, w, x, M, V, pp_shards_hint=S) ** 2)
+    g_ref = jax.grad(loss_ref)(ws)
+    g = jax.jit(jax.shard_map(
+        lambda w, xx: jax.grad(lambda ww: jnp.sum(
+            pl.pipeline_apply_interleaved(stage_fn, ww, xx, M, V) ** 2))(w),
+        mesh=mesh, in_specs=(P(const.PIPELINE_AXIS), P()),
+        out_specs=P(const.PIPELINE_AXIS), check_vma=False))(ws, x)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_interleaved_schedule_structure():
+    """The interleaved scan runs M*V + S - 1 slots (vs GPipe's M + S - 1
+    of V-times-bigger chunks) and its ring ppermute carries the
+    wraparound edge S-1 -> 0 that GPipe's chain never uses — the
+    chunk-boundary hop the schedule is made of."""
+    from autodist_tpu.parallel import pipeline as pl
+    from autodist_tpu.kernel.common import op_info
+    S, V, M, B, D = 4, 2, 8, 16, 6
+    mesh = Mesh(np.array(jax.devices()[:S]), (const.PIPELINE_AXIS,))
+    ws = jnp.zeros((S * V, D, D), jnp.float32)
+    x = jnp.zeros((B, D), jnp.float32)
+
+    def stage_fn(w, h):
+        return pl.stacked_scan(lambda p, hh: jnp.tanh(hh @ p), w, h)
+
+    jaxpr = jax.make_jaxpr(jax.shard_map(
+        lambda w, xx: pl.pipeline_apply_interleaved(stage_fn, w, xx, M, V),
+        mesh=mesh, in_specs=(P(const.PIPELINE_AXIS), P()), out_specs=P(),
+        check_vma=False))(ws, x)
+    scans, perms = [], []
+
+    def walk(jp):
+        for eqn in jp.eqns:
+            if eqn.primitive.name == "scan":
+                scans.append(int(eqn.params.get("length", 0)))
+            if eqn.primitive.name == "ppermute":
+                perms.append(eqn.params.get("perm"))
+            for sub in op_info.sub_jaxprs(eqn):
+                walk(sub)
+    walk(jaxpr.jaxpr)
+    assert (M * V + S - 1) in scans, scans
+    ring = [p for p in perms if (S - 1, 0) in [tuple(e) for e in p]]
+    assert ring, "no full-ring ppermute (wraparound edge) found: %s" % perms
+
+
+def test_pp_lm_interleaved_matches_single_device():
+    """Full stack with schedule='interleaved': the model's logical layer
+    order is schedule-defined (physical chunk r*V+c = logical stage
+    c*S+r), its degenerate path emulates the same order via
+    pp_shards, and distributed training matches jax.grad of that very
+    loss."""
+    pp, V, micro = 2, 2, 4
+    dp = 8 // pp
+    cfg = TPLMConfig.tiny(num_layers=pp * V)
+    loss_fn, params, batch, _ = pipe_lm.make_train_setup(
+        cfg, seq_len=16, batch_size=micro * dp, seed=1,
+        n_microbatches=micro, schedule="interleaved",
+        virtual_stages=V, pp_shards=pp, model_axis=None)
+    opt = optax.sgd(0.05)
+    rng = np.random.RandomState(2)
+    batches = [batch, {"tokens": rng.randint(
+        0, cfg.vocab_size, batch["tokens"].shape).astype(np.int32)}]
+
+    @jax.jit
+    def step(p, s, b):
+        g = jax.grad(loss_fn)(p, b)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s
+
+    ref, state = params, opt.init(params)
+    for b in batches:
+        ref, state = step(ref, state, b)
+
+    ad = adt.AutoDist(strategy_builder=strategy.PipelineParallel(
+        pp_shards=pp, n_microbatches=micro, schedule="interleaved",
+        virtual_stages=V, mp_rules=pipe_lm.pp_rules()))
+    runner = ad.build(loss_fn, opt, params, batches[0])
+    gc = runner.distributed_step.strategy.graph_config
+    assert gc.pp_schedule == "interleaved" and gc.pp_virtual == V
+    runner.init(params)
+    for b in batches:
+        m = runner.run(b)
+    assert np.isfinite(m["loss"])
+    got = runner.gather_params()
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-5, atol=5e-6),
+        got, ref)
+
+
+def test_cost_model_ranks_interleaved_at_small_m():
+    """At M close to S the GPipe bubble (S-1)/M dominates; the interleaved
+    schedule's (S-1)/(V*M) must price faster — and the gap must shrink as
+    M grows."""
+    from autodist_tpu.simulator.simulator import Simulator
+    from autodist_tpu.model_item import ModelItem
+    from autodist_tpu.resource_spec import ResourceSpec
+    cfg = TPLMConfig.tiny(num_layers=8)
+    loss_fn, params, batch, _ = pipe_lm.make_train_setup(
+        cfg, seq_len=16, batch_size=32, n_microbatches=8)
+    item = ModelItem(loss_fn=loss_fn, optimizer=optax.sgd(0.01),
+                     params=params, example_batch=batch).prepare()
+    spec = ResourceSpec.from_dict(
+        {"nodes": [{"address": "127.0.0.1", "chief": True, "tpus": 8}]})
+    sim = Simulator(item, spec)
+
+    def t(schedule, m, **kw):
+        s = strategy.PipelineParallel(
+            pp_shards=8, n_microbatches=m, schedule=schedule,
+            mp_rules=pipe_lm.pp_rules(), **kw).build(item, spec)
+        return sim.simulate(s).breakdown.compute_s
+
+    assert t("interleaved", 8, virtual_stages=4) < t("gpipe", 8)
+    gap_small_m = t("gpipe", 8) / t("interleaved", 8, virtual_stages=4)
+    gap_big_m = t("gpipe", 64) / t("interleaved", 64, virtual_stages=4)
+    assert gap_small_m > gap_big_m > 1.0
+
+
+def test_build_rejects_schedule_loss_mismatch():
+    """The schedule is baked into the loss; a strategy claiming another
+    one (e.g. an AutoStrategy alternate) must fail the build with a
+    rebuild instruction, not run GPipe while priced as 1F1B."""
+    cfg = TPLMConfig.tiny()
+    loss_fn, params, batch, _ = pipe_lm.make_train_setup(
+        cfg, seq_len=16, batch_size=8, n_microbatches=2, schedule="gpipe")
+    ad = adt.AutoDist(strategy_builder=strategy.PipelineParallel(
+        pp_shards=2, n_microbatches=2, schedule="1f1b",
+        mp_rules=pipe_lm.pp_rules()))
+    with pytest.raises(ValueError, match="rebuild the model's loss"):
+        ad.build(loss_fn, optax.sgd(0.05), params, batch,
+                 mp_meta={"pp_schedule": "gpipe"})
+
+
+def test_interleaved_setup_requires_pp_shards():
+    with pytest.raises(ValueError, match="requires pp_shards"):
+        pipe_lm.make_train_setup(TPLMConfig.tiny(num_layers=4),
+                                 schedule="interleaved", virtual_stages=2)
