@@ -52,6 +52,50 @@ COMPRESSED_BYTES = {"HorovodCompressor": 2, "HorovodCompressorEF": 2,
                     "Int8Compressor": 1, "Int8CompressorEF": 1}
 PER_COLLECTIVE_LATENCY_S = 5e-6   # launch overhead per collective/bucket
 
+# forward wire factors per cost class at axis size k: bytes crossing each
+# link of a ring, relative to the TRACED payload (gather traces one shard,
+# scatter/permute/alltoall trace the full input, reduce traces the psum
+# operand — see _COLLECTIVE_KINDS in kernel/common/utils.py)
+_FWD_WIRE_FACTOR = {
+    "reduce": lambda k: 2.0 * (k - 1) / k,   # ring all-reduce
+    "gather": lambda k: float(k - 1),        # all_gather of one shard
+    "scatter": lambda k: (k - 1) / k,        # reduce_scatter of the input
+    "permute": lambda k: (k - 1) / k,        # ring hop amortized
+    "alltoall": lambda k: (k - 1) / k,
+}
+
+# the transpose of each collective is its DUAL class
+_DUAL_CLASS = {"gather": "scatter", "scatter": "gather",
+               "reduce": "reduce", "permute": "permute",
+               "alltoall": "alltoall"}
+
+
+def collective_wire_bytes(kind: str, traced_bytes: float, k: int,
+                          direction: str = "fwd") -> float:
+    """Ring wire bytes for one collective of ``kind`` with
+    ``traced_bytes`` payload at axis size ``k``.
+
+    ``direction="bwd"`` prices the TRANSPOSE as its dual class with the
+    dual's payload:
+
+    - gather (traced B = one shard) transposes to a reduce_scatter of the
+      FULL cotangent k*B: wire (k-1)/k * kB = (k-1)B — equal to fwd.
+    - scatter (traced B = full input) transposes to an all_gather of k
+      shards of B/k: wire (k-1) * B/k — equal to fwd's (k-1)/k * B.
+    - reduce's transpose is free, but every Megatron-style layer pairs a
+      fwd psum with its dual layer's bwd psum (row- vs column-parallel),
+      so the program-level backward moves the same reduce bytes.
+    - permute/alltoall are self-dual (inverted permutation / shuffle).
+    """
+    if direction == "bwd":
+        dual = _DUAL_CLASS[kind]
+        if kind == "gather":
+            return collective_wire_bytes(dual, traced_bytes * k, k, "fwd")
+        if kind == "scatter":
+            return collective_wire_bytes(dual, traced_bytes / k, k, "fwd")
+        return collective_wire_bytes(dual, traced_bytes, k, "fwd")
+    return _FWD_WIRE_FACTOR[kind](k) * traced_bytes
+
 
 @dataclasses.dataclass
 class CostBreakdown:
@@ -265,33 +309,26 @@ class CostModel:
         """Serial model-parallel collective seconds per step, by cost
         class (see ``_COLLECTIVE_KINDS`` in kernel/common/utils.py for
         how each class's traced bytes relate to real wire at axis size
-        k). The 2x prices the backward pass, and is EXACT per class
-        under the size-1 trace convention, because each collective's
-        transpose moves the same wire bytes as the forward:
-
-        - gather (traced bytes B = one shard): fwd all_gather wire
-          (k-1)B; bwd is reduce_scatter of the FULL cotangent kB, wire
-          (k-1)/k * kB = (k-1)B — equal, despite the different factors.
-        - scatter (traced B = full input): fwd wire (k-1)/k * B; bwd
-          all_gather reassembles the full B from k shards of B/k, wire
-          (k-1)/k * B — equal.
-        - reduce: the transpose of psum is free, but every Megatron-style
-          layer pairs a fwd psum with a bwd psum from its dual layer
-          (row- vs column-parallel), so 2x holds at program level.
-        - permute/alltoall: self-dual (inverted permutation / inverse
-          shuffle), identical wire."""
+        k). The backward is priced as each collective's DUAL CLASS via
+        :func:`collective_wire_bytes` — a gather's transpose is a
+        reduce_scatter of the full cotangent, a scatter's is an
+        all_gather of the shards, reduce pairs with its dual layer's
+        psum (row- vs column-parallel), permute/alltoall invert
+        themselves. Per class the dual's wire equals the forward's (see
+        the algebra in ``collective_wire_bytes``), so the total comes
+        out fwd+bwd = 2x — now computed, not asserted
+        (tests/test_simulator.py::test_dual_class_backward_pricing)."""
         mesh_shape = strategy.graph_config.mesh_shape or {}
         total = 0.0
         for axis, by_kind in self._collective_profile().items():
             k = int(mesh_shape.get(axis, 1))
             if k <= 1:
                 continue  # axis not materialized: collective is a no-op
-            wire = (by_kind.get("reduce", 0.0) * 2.0 * (k - 1) / k
-                    + by_kind.get("gather", 0.0) * (k - 1)
-                    + by_kind.get("scatter", 0.0) * (k - 1) / k
-                    + by_kind.get("permute", 0.0) * (k - 1) / k
-                    + by_kind.get("alltoall", 0.0) * (k - 1) / k)
-            total += 2.0 * wire / ici_bw
+            wire = sum(
+                collective_wire_bytes(kind, traced, k, "fwd")
+                + collective_wire_bytes(kind, traced, k, "bwd")
+                for kind, traced in by_kind.items())
+            total += wire / ici_bw
         return total
 
     def hbm_bytes(self, strategy: Strategy) -> float:
@@ -402,6 +439,7 @@ class CostModel:
         ps_load: Dict[str, float] = {}
         groups = set()
         num_ps_transfers = 0
+        mesh_cfg = strategy.graph_config.mesh_shape or {}
         for node in strategy.node_config:
             info = infos.get(node.var_name)
             if info is None:
@@ -409,9 +447,23 @@ class CostModel:
             syncs = ([node.synchronizer] if node.synchronizer else
                      [p.synchronizer for p in node.part_configs])
             partitioned = bool(node.partitioner)
+            # model-parallel vars sync their LOCAL shard over the
+            # complement axes only: the payload is 1/extent of the var
+            # per sharded mesh axis, and with a trivial complement
+            # (dp == 1) there is no gradient collective at all — pricing
+            # the full dense bytes here is what made EP/TP/PP candidates
+            # look as wire-heavy as plain AllReduce
+            mp_share, mp_extent = 1.0, 1
+            for _dim, ax in dict(node.mp_axes or {}).items():
+                e = max(int(mesh_cfg.get(ax, 1)), 1)
+                mp_share /= e
+                mp_extent *= e
+            complement = max(n // mp_extent, 1)
             for sync in syncs:
                 if isinstance(sync, AllReduceSynchronizer):
-                    ar_bytes += self._wire_bytes(
+                    if node.mp_axes and complement == 1:
+                        continue  # whole mesh is model axes: no grad sync
+                    ar_bytes += mp_share * self._wire_bytes(
                         info, sync,
                         compressed=not partitioned) / max(len(syncs), 1)
                     groups.add(sync.group)
@@ -453,7 +505,14 @@ class CostModel:
         pp = int(mesh_shape_cfg.get(_const.PIPELINE_AXIS, 1))
         if pp > 1:
             m = int(strategy.graph_config.pp_microbatches or 1)
-            compute_s *= (pp - 1 + m) / m
+            if strategy.graph_config.pp_schedule == "interleaved":
+                # virtual stages cut the fill/drain bubble by V: per-rank
+                # work slots go M -> M*V while the bubble stays S-1 slots
+                # (Narayanan et al. 2104.04473)
+                v = max(int(strategy.graph_config.pp_virtual or 2), 1)
+                compute_s *= ((pp - 1) / v + m) / m
+            else:
+                compute_s *= (pp - 1 + m) / m
             if strategy.graph_config.pp_schedule == "1f1b":
                 # the fused schedule recomputes each stage forward from
                 # the stashed input in its backward tick (per-microbatch

@@ -57,24 +57,110 @@ def default_candidates() -> List[Tuple[str, StrategyBuilder]]:
 
 def mp_candidates(model_item, resource_spec
                   ) -> List[Tuple[str, StrategyBuilder]]:
-    """Tensor-parallel candidates enumerated from the model's registered
-    ``mp_rules`` (set via ``AutoDist.build(..., mp_rules=...)`` or
-    ``ModelItem(mp_rules=...)``): one TP entry per power-of-two shard
-    count dividing the device count. The cost model prices their
-    forward-collective traffic (mp_comm_time) and sharded storage, so
-    they rank against the data-parallel family on one scale."""
+    """Model-parallel candidates enumerated from the model's registered
+    ``mp_rules`` (set via ``AutoDist.build(..., mp_rules=...)``): the
+    FAMILY comes from which mesh axes the rules reference —
+    ``model`` -> TensorParallel, ``pipe`` -> PipelineParallel (every
+    schedule the model's loss supports, plus composite pp x tp grids when
+    both axes appear), ``expert`` -> ExpertParallel — and
+    SequenceParallel joins when the model declares a shardable sequence
+    dim (``mp_meta['seq_parallel']``). ``mp_meta`` also carries the
+    pipeline knobs the model's loss was built with (``pp_microbatches``,
+    ``pp_schedules``). The cost model prices forward-collective traffic
+    (mp_comm_time), schedule bubbles, and sharded storage, so every
+    family ranks against the data-parallel pool on one scale — the
+    reference's AutoSync ambition over the WHOLE space
+    (reference ``docs/design/rationale.rst``)."""
+    from autodist_tpu import const
     rules = getattr(model_item, "mp_rules", None)
-    if not rules:
-        return []
-    from autodist_tpu.strategy.tensor_parallel_strategy import TensorParallel
+    meta = getattr(model_item, "mp_meta", None) or {}
     n_devices = len(resource_spec.devices)
     out: List[Tuple[str, StrategyBuilder]] = []
-    k = 2
-    while k <= n_devices and k <= 8:
-        if n_devices % k == 0:
-            out.append(("TensorParallel/%d" % k,
-                        TensorParallel(tp_shards=k, mp_rules=rules)))
-        k *= 2
+
+    def pow2s(limit=8):
+        k = 2
+        while k <= n_devices and k <= limit:
+            if n_devices % k == 0:
+                yield k
+            k *= 2
+
+    if rules:
+        axes = {a for _, dims in rules for a in dims.values()}
+        has_tp = const.MODEL_AXIS in axes
+        has_pp = const.PIPELINE_AXIS in axes
+        has_ep = const.EXPERT_AXIS in axes
+        if has_tp and not has_pp:
+            from autodist_tpu.strategy.tensor_parallel_strategy import (
+                TensorParallel)
+            for k in pow2s():
+                out.append(("TensorParallel/%d" % k,
+                            TensorParallel(tp_shards=k, mp_rules=rules)))
+        if has_pp:
+            from autodist_tpu.strategy.pipeline_parallel_strategy import (
+                PipelineParallel)
+            m = int(meta.get("pp_microbatches", 4))
+            v = int(meta.get("pp_virtual", 2))
+            # "pp_schedule" declares the schedule the loss was BUILT with;
+            # "pp_schedules" additionally enumerates alternates the model
+            # family supports — if the picker selects one the loss does
+            # not implement, AutoDist.build fails loudly with a rebuild
+            # instruction (the schedule is baked into the loss, so a
+            # silent mismatch would price a program that never runs).
+            # Alternates therefore REQUIRE the built schedule to be
+            # declared too: without it the mismatch guard has nothing to
+            # compare against, and the pick could silently misprice.
+            alternates = meta.get("pp_schedules")
+            if alternates and not meta.get("pp_schedule"):
+                logging.warning(
+                    "mp_meta['pp_schedules'] ignored: declare the BUILT "
+                    "schedule via mp_meta['pp_schedule'] too, or the "
+                    "picker could select a schedule the loss does not "
+                    "implement without the build guard catching it")
+                alternates = None
+            schedules = list(alternates
+                             or [meta.get("pp_schedule", "gpipe")])
+
+            def pp_builder(k, sched, t=1):
+                if sched == "interleaved":
+                    if m % k:
+                        return None  # schedule constraint: M % S == 0
+                    return PipelineParallel(pp_shards=k, tp_shards=t,
+                                            n_microbatches=m,
+                                            schedule=sched, mp_rules=rules,
+                                            virtual_stages=v)
+                return PipelineParallel(pp_shards=k, tp_shards=t,
+                                        n_microbatches=m, schedule=sched,
+                                        mp_rules=rules)
+
+            for k in pow2s():
+                for sched in schedules:
+                    b = pp_builder(k, sched)
+                    if b is not None:
+                        out.append(("PipelineParallel/%d/%s" % (k, sched),
+                                    b))
+                if has_tp:
+                    # composite dp x pp x tp grids (big-model/small-HBM)
+                    for t in (2, 4):
+                        if k * t <= n_devices and n_devices % (k * t) == 0:
+                            b = pp_builder(k, schedules[0], t)
+                            if b is not None:
+                                out.append((
+                                    "PP%d x TP%d/%s"
+                                    % (k, t, schedules[0]), b))
+        if has_ep:
+            from autodist_tpu.strategy.expert_parallel_strategy import (
+                ExpertParallel)
+            for k in pow2s():
+                out.append(("ExpertParallel/%d" % k,
+                            ExpertParallel(ep_shards=k, mp_rules=rules)))
+    if meta.get("seq_parallel"):
+        from autodist_tpu.strategy.sequence_parallel_strategy import (
+            SequenceParallelAR)
+        attention = meta.get("sp_attention", "ring")
+        for k in pow2s(4):
+            out.append(("SequenceParallel/%d" % k,
+                        SequenceParallelAR(seq_shards=k,
+                                           attention=attention)))
     return out
 
 

@@ -593,3 +593,206 @@ def test_auto_enumerates_tp_candidates_from_mp_rules():
     tp = [r for r in auto.last_ranking
           if r.label.startswith("TensorParallel/")][0]
     assert tp.breakdown.mp_s > 0  # the TP psums are priced, not free
+
+
+# --------------------------------------------- PP/EP/SP search (r5)
+
+
+def test_auto_enumerates_pp_candidates_and_picks_1f1b_under_squeeze():
+    """VERDICT-r4 #3: a stacked-blocks model registering pipe rules enters
+    the PipelineParallel search space (gpipe AND 1f1b, per its mp_meta);
+    under an HBM squeeze between the two schedules' footprints the auto
+    pick lands on PP/1f1b, justified by the feasibility gate in its
+    CostBreakdown."""
+    from autodist_tpu.models import pipe_lm
+    from autodist_tpu.models.tp_lm import TPLMConfig
+    cfg = TPLMConfig.tiny(num_layers=8, d_model=64, mlp_dim=256)
+    loss_fn, params, batch, _ = pipe_lm.make_train_setup(
+        cfg, seq_len=64, batch_size=64, n_microbatches=16)
+    item = ModelItem(loss_fn=loss_fn, optimizer=optax.adam(1e-3),
+                     params=params, example_batch=batch,
+                     mp_rules=pipe_lm.pp_rules(),
+                     mp_meta={"pp_microbatches": 16,
+                              "pp_schedule": "gpipe",
+                              "pp_schedules": ["gpipe", "1f1b"]}).prepare()
+    spec = ResourceSpec.from_dict(
+        {"nodes": [{"address": "127.0.0.1", "chief": True, "tpus": 8}],
+         "slice": {"type": "v5e", "ici_bandwidth": 400}})
+
+    roomy = AutoStrategy(hbm_capacity_bytes=1e15)
+    roomy.build(item, spec)
+    labels = {r.label for r in roomy.last_ranking}
+    assert any(l.startswith("PipelineParallel/") and l.endswith("gpipe")
+               for l in labels), labels
+    assert any(l.endswith("1f1b") for l in labels), labels
+    by = {r.label: r for r in roomy.last_ranking}
+    g = by["PipelineParallel/8/gpipe"].breakdown.hbm_bytes
+    f = by["PipelineParallel/8/1f1b"].breakdown.hbm_bytes
+    assert f < g  # the schedule's whole point: S-bounded residency
+
+    # squeeze: cap between the leanest 1f1b candidate and everything else
+    f_min = min(r.breakdown.hbm_bytes for r in roomy.last_ranking
+                if "1f1b" in r.label)
+    others = min(r.breakdown.hbm_bytes for r in roomy.last_ranking
+                 if "1f1b" not in r.label)
+    assert f_min < others, "1f1b must be the leanest family here"
+    cap = (f_min + others) / 2
+    tight = AutoStrategy(hbm_capacity_bytes=cap)
+    tight.build(item, spec)
+    best = tight.last_ranking[0]
+    assert "1f1b" in best.label, [r.label for r in tight.last_ranking[:5]]
+    assert best.breakdown.feasible
+    assert not tight.last_ranking[-1].breakdown.feasible
+
+
+def test_auto_enumerates_ep_for_moe_model():
+    """A MoE ModelItem (expert-axis rules) enters the ExpertParallel
+    space; with slow inter-chip links and an HBM cap that rules out the
+    host-PS family's pulled copies, the auto pick IS an EP candidate —
+    its expert-sharded stacks sync only the 1/ep local shard over the
+    dp complement (the dense families ship every expert's gradient)."""
+    from autodist_tpu.models import moe_lm
+    cfg = moe_lm.MoEConfig.tiny(num_experts=8, d_model=64, expert_dim=512)
+    loss_fn, params, batch, _ = moe_lm.make_train_setup(
+        cfg, seq_len=32, batch_size=32)
+    item = ModelItem(loss_fn=loss_fn, optimizer=optax.adam(1e-3),
+                     params=params, example_batch=batch,
+                     mp_rules=moe_lm.ep_rules()).prepare()
+    spec = ResourceSpec.from_dict(
+        {"nodes": [{"address": "127.0.0.1", "chief": True, "tpus": 8}],
+         "slice": {"type": "v5e", "ici_bandwidth": 1}})
+    auto = AutoStrategy(hbm_capacity_bytes=1e15)
+    auto.build(item, spec)
+    by = {r.label: r for r in auto.last_ranking}
+    assert "ExpertParallel/8" in by, sorted(by)
+    # expert-sharded storage undercuts dense replication...
+    assert (by["ExpertParallel/8"].breakdown.hbm_bytes
+            < by["AllReduce/512"].breakdown.hbm_bytes)
+    # ...and its gradient wire is the 1/ep local shard, not the full stack
+    assert (by["ExpertParallel/8"].breakdown.allreduce_s
+            < 0.2 * by["AllReduce/512"].breakdown.allreduce_s)
+    # cap between EP-8 and the PS family's pulled-copy footprint: the
+    # feasible set is the storage-sharded families, and EP's lean wire
+    # beats ZeRO's full param gather on the slow links
+    cap = (by["ExpertParallel/8"].breakdown.hbm_bytes
+           + by["PS"].breakdown.hbm_bytes) / 2
+    tight = AutoStrategy(hbm_capacity_bytes=cap)
+    tight.build(item, spec)
+    best = tight.last_ranking[0]
+    assert best.label.startswith("ExpertParallel/"), \
+        [r.label for r in tight.last_ranking[:5]]
+    assert best.breakdown.feasible
+    by_t = {r.label: r for r in tight.last_ranking}
+    assert not by_t["PS"].breakdown.feasible
+
+
+def test_auto_composite_pp_tp_for_big_model_small_hbm():
+    """pipe+model rules yield composite PP x TP grids. The regime where
+    a composite genuinely wins: long-sequence activations dominate HBM
+    (ZeRO's param sharding is beside the point), the 1F1B schedule's S/M
+    residency beats pure data parallelism's 1/dp, and the tp dims shave
+    the remaining param share below pure-PP — under a cap between the
+    composite and pure-PP footprints, only composites are feasible and
+    the pick is PPxTP, justified by the HBM gate."""
+    from autodist_tpu.models import pipe_lm
+    from autodist_tpu.models.tp_lm import TPLMConfig
+    cfg = TPLMConfig.tiny(num_layers=8, d_model=256, mlp_dim=1024,
+                          num_heads=8, max_seq_len=512)
+    loss_fn, params, batch, _ = pipe_lm.make_train_setup(
+        cfg, seq_len=512, batch_size=64, n_microbatches=64,
+        model_axis="model", schedule="1f1b")
+    item = ModelItem(loss_fn=loss_fn, optimizer=optax.adam(1e-3),
+                     params=params, example_batch=batch,
+                     mp_rules=pipe_lm.pp_rules(model_axis="model"),
+                     mp_meta={"pp_microbatches": 64,
+                              "pp_schedule": "1f1b",
+                              "pp_schedules": ["1f1b"]}).prepare()
+    spec = ResourceSpec.from_dict(
+        {"nodes": [{"address": "127.0.0.1", "chief": True, "tpus": 8}],
+         "slice": {"type": "v5e", "ici_bandwidth": 400}})
+    auto = AutoStrategy(hbm_capacity_bytes=1e15)
+    auto.build(item, spec)
+    by = {r.label: r for r in auto.last_ranking}
+    comp = [l for l in by if l.startswith("PP") and "TP" in l]
+    assert comp, sorted(by)
+    comp_hbm = min(by[l].breakdown.hbm_bytes for l in comp)
+    others = min(v.breakdown.hbm_bytes for l, v in by.items()
+                 if l not in comp)
+    assert comp_hbm < others  # composites are the leanest family here
+    cap = (comp_hbm + others) / 2
+    tight = AutoStrategy(hbm_capacity_bytes=cap)
+    tight.build(item, spec)
+    best = tight.last_ranking[0]
+    assert best.label.startswith("PP") and "TP" in best.label, \
+        [r.label for r in tight.last_ranking[:5]]
+    assert best.breakdown.feasible
+    # the gate did the picking: ZeRO and pure-PP price infeasible here
+    assert not tight.last_ranking[-1].breakdown.feasible
+    by_t = {r.label: r for r in tight.last_ranking}
+    assert not by_t["PartitionedAR"].breakdown.feasible
+
+
+def test_auto_enumerates_sp_when_model_declares_it():
+    """mp_meta['seq_parallel'] puts SequenceParallel candidates in the
+    pool (the long-context family has no var rules to detect from)."""
+    item = _item()
+    item.mp_meta = {"seq_parallel": True, "sp_attention": "ring"}
+    spec = _spec()
+    auto = AutoStrategy(hbm_capacity_bytes=1e15)
+    auto.build(item, spec)
+    labels = {r.label for r in auto.last_ranking}
+    assert any(l.startswith("SequenceParallel/") for l in labels), labels
+
+
+def test_dual_class_backward_pricing():
+    """VERDICT-r4 #9: the backward collective is priced as its DUAL class
+    with the dual's payload (gather <-> scatter, permute/alltoall
+    self-dual) — and per class the dual's wire equals the forward's, so
+    the fwd+bwd sum reproduces the old 2x shortcut by ALGEBRA, not by
+    assertion."""
+    from autodist_tpu.simulator.cost_model import collective_wire_bytes
+    k, B = 8, 1024.0
+    # gather traces one shard B: fwd all_gather moves (k-1)B; the
+    # transpose is a reduce_scatter of the FULL kB cotangent
+    assert collective_wire_bytes("gather", B, k, "fwd") == (k - 1) * B
+    assert (collective_wire_bytes("gather", B, k, "bwd")
+            == collective_wire_bytes("scatter", k * B, k, "fwd")
+            == pytest.approx((k - 1) * B))
+    # scatter traces the full input B: fwd reduce_scatter moves (k-1)/k B;
+    # the transpose all_gathers k shards of B/k
+    assert (collective_wire_bytes("scatter", B, k, "fwd")
+            == pytest.approx((k - 1) / k * B))
+    assert (collective_wire_bytes("scatter", B, k, "bwd")
+            == collective_wire_bytes("gather", B / k, k, "fwd")
+            == pytest.approx((k - 1) / k * B))
+    # reduce pairs with its dual layer's psum; permute/alltoall self-dual
+    for kind in ("reduce", "permute", "alltoall"):
+        assert (collective_wire_bytes(kind, B, k, "bwd")
+                == collective_wire_bytes(kind, B, k, "fwd"))
+
+
+def test_pp_candidate_enumeration_skips_invalid_interleaved_geometry():
+    """An interleaved alternate whose M is not divisible by some pp_shards
+    (or by a composite's pp) is SKIPPED, not a crash inside
+    mp_candidates() before the per-candidate try/except."""
+    from autodist_tpu.strategy.auto_strategy import mp_candidates
+    from autodist_tpu.models import pipe_lm
+    from autodist_tpu.models.tp_lm import TPLMConfig
+    cfg = TPLMConfig.tiny(num_layers=8)
+    loss_fn, params, batch, _ = pipe_lm.make_train_setup(
+        cfg, seq_len=16, batch_size=8, n_microbatches=4)
+    item = ModelItem(loss_fn=loss_fn, optimizer=optax.sgd(0.1),
+                     params=params, example_batch=batch,
+                     mp_rules=pipe_lm.pp_rules(model_axis="model"),
+                     mp_meta={"pp_microbatches": 4,
+                              "pp_schedule": "interleaved",
+                              "pp_virtual": 2}).prepare()
+    spec = ResourceSpec.from_dict(
+        {"nodes": [{"address": "127.0.0.1", "chief": True, "tpus": 8}]})
+    cands = mp_candidates(item, spec)  # must not raise
+    labels = [l for l, _ in cands]
+    # pp8 x M4 violates M % S == 0: absent, while pp2/pp4 are present
+    assert any("PipelineParallel/2/interleaved" == l for l in labels)
+    assert not any(l.startswith("PipelineParallel/8/") for l in labels)
+    # composites inherit the same guard (PP4 x TP2 ok, PP8 never built)
+    assert any(l.startswith("PP4 x TP2") for l in labels), labels
