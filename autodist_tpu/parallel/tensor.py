@@ -70,18 +70,33 @@ def row_parallel_dense(x, kernel, bias=None,
     return y
 
 
-def vocab_parallel_embed(table, ids, axis_name: str = const.MODEL_AXIS):
+def vocab_parallel_embed(table, ids, axis_name: str = const.MODEL_AXIS,
+                         name: str = "embed"):
     """Embedding lookup with the vocab dim of ``table`` sharded over the
     model axis: each rank looks up the ids it owns, others contribute zeros,
     one psum assembles the full embedding (Megatron VocabParallelEmbedding).
-    """
+
+    When the model axis is UNBOUND (pp-only / dp-only configs) the lookup
+    routes through ``ops.embedding.embedding_lookup(name=...)`` so the
+    sparse-wire discovery sees it — for a tied table the discovery then
+    deliberately keeps the dense sync (the output-head gradient is dense),
+    but it decides that from evidence instead of warning about an
+    un-routed gather."""
+    from autodist_tpu.ops.embedding import embedding_lookup
     if not axis_bound(axis_name):
-        return jnp.take(table, ids, axis=0)
+        return embedding_lookup(table, ids, name=name)
     rank = jax.lax.axis_index(axis_name)
     v_local = table.shape[0]
     local_ids = ids - rank * v_local
     ok = (local_ids >= 0) & (local_ids < v_local)
-    emb = jnp.take(table, jnp.clip(local_ids, 0, v_local - 1), axis=0)
+    # also via embedding_lookup: the sparse-wire discovery traces under
+    # size-1 bound axes (where this branch runs) while the real program
+    # may leave the axis unbound (pp-only) — both branches must present
+    # the same named lookup or discovery misses it. On a truly
+    # vocab-sharded table the var is mp-sharded, so no tap engages and
+    # this is exactly jnp.take.
+    emb = embedding_lookup(table, jnp.clip(local_ids, 0, v_local - 1),
+                           name=name)
     emb = jnp.where(ok[..., None], emb, 0)
     return jax.lax.psum(emb, axis_name)
 

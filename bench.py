@@ -95,37 +95,49 @@ def _compiled_flops(lowered_compiled) -> float:
         return 0.0
 
 
-def _model_spec(label):
-    """(registry name, setup kwargs, batch key) for a flagship label."""
+def _model_spec(label, batch_size=None):
+    """(registry name, setup kwargs, batch key, flops_extra) for a
+    flagship label. ``flops_extra`` corrects XLA cost-analysis blind
+    spots (it counts a ``lax.scan`` body ONCE regardless of trip count)
+    with closed-form hand counts, so memory-lean scanned ops can be
+    benched at their best operating point without misreporting MFU."""
     import jax.numpy as jnp
     if label == "resnet50":
         # batch 256: a realistic v5e operating point (batch 64 leaves the
         # MXU underfed; see BENCHMARKS.md for the batch-64 comparison)
-        return "resnet50", dict(batch_size=256), "image"
+        return "resnet50", dict(batch_size=batch_size or 256), "image", 0.0
     if label == "bert_base":
-        # bf16 like every real TPU deployment; batch 128 is the measured
-        # best operating point on the 16 GB v5e (+9% over batch 64,
-        # probed MFU 0.55 vs 0.50; batch 256 RESOURCE_EXHAUSTs)
-        return "bert_base", dict(batch_size=128, seq_len=128,
-                                 dtype=jnp.bfloat16), "input_ids"
+        # bf16 like every real TPU deployment; the driver's child benches
+        # batch 64 AND 128 as paired phases in one run and headlines the
+        # artifact winner (batch 256 RESOURCE_EXHAUSTs on the 16 GB v5e)
+        return "bert_base", dict(batch_size=batch_size or 128, seq_len=128,
+                                 dtype=jnp.bfloat16), "input_ids", 0.0
     if label == "lm1b":
         from autodist_tpu.models.lm import LMConfig
-        # lean_head pinned OFF for the bench: XLA cost_analysis counts
-        # scan bodies once, so the chunked head's MFU would underreport
-        # (throughput is ~equal at this batch; the lean head's own
-        # numbers — incl. fitting batch 64 where this config OOMs — are
-        # in BENCHMARKS.md "Memory-lean LM head")
-        return "lm", dict(config=LMConfig.lm1b(dtype=jnp.bfloat16),
-                          batch_size=32, seq_len=256,
-                          lean_head=False), "tokens"
+        cfg = LMConfig.lm1b(dtype=jnp.bfloat16)
+        batch, seq = batch_size or 64, 256
+        # lean (chunked) LM head: the ONLY head that fits batch 64 on the
+        # 16 GB chip (the standard head OOMs — BENCHMARKS.md "Memory-lean
+        # LM head"). XLA's cost analysis counts its vocab-chunk scan body
+        # once, so the head FLOPs are hand-computed in closed form:
+        # fwd logits matmul 2*T*D*V + backward dx and dW matmuls (4*T*D*V)
+        # = 6*T*D*V total, of which XLA sees one chunk's worth.
+        from autodist_tpu.ops.xent import _layout
+        chunk_eff, _n = _layout(cfg.vocab_size, 8192)
+        tokens = batch * seq
+        flops_extra = 6.0 * tokens * cfg.d_model * (cfg.vocab_size
+                                                    - chunk_eff)
+        return "lm", dict(config=cfg, batch_size=batch, seq_len=seq,
+                          lean_head=True), "tokens", flops_extra
     if label == "smoke":  # tiny CPU-runnable config for harness tests
-        return "resnet18", dict(batch_size=4, image_size=32), "image"
+        return ("resnet18", dict(batch_size=batch_size or 4, image_size=32),
+                "image", 0.0)
     raise ValueError(label)
 
 
-def bench_model(label, pairs=8, iters=4, deadline=None):
+def bench_model(label, pairs=8, iters=4, deadline=None, batch_size=None):
     import jax
-    name, setup_kw, batch_key = _model_spec(label)
+    name, setup_kw, batch_key, flops_extra = _model_spec(label, batch_size)
     print("bench_model:", label, setup_kw, file=sys.stderr, flush=True)
     import optax
     import autodist_tpu as adt
@@ -158,6 +170,8 @@ def bench_model(label, pairs=8, iters=4, deadline=None):
     baseline_exec = baseline_step.lower(
         base_box[0], base_box[1], base_batch).compile()
     flops = _compiled_flops(baseline_exec)
+    if flops:
+        flops += flops_extra  # closed-form scan-body correction
     print("  baseline compiled in %.1fs, flops/step=%.3g"
           % (time.perf_counter() - t0, flops), file=sys.stderr, flush=True)
 
@@ -266,7 +280,20 @@ def child_main(label):
         jax.config.update("jax_platforms", os.environ["ADT_BENCH_PLATFORM"])
     budget = float(os.environ.get("ADT_BENCH_MODEL_BUDGET_S", "600"))
     deadline = time.perf_counter() + budget
-    res = bench_model(label, deadline=deadline)
+    if label == "bert_base":
+        # BOTH operating points measured in ONE artifact run; the
+        # headline is the artifact winner — never a one-off probe
+        # (VERDICT-r4 #4: the table must quote the artifact)
+        mid = time.perf_counter() + (deadline - time.perf_counter()) / 2
+        r64 = bench_model(label, deadline=mid, batch_size=64)
+        r128 = bench_model(label, deadline=deadline, batch_size=128)
+        win = r128 if (r128["examples_per_sec"]
+                       >= r64["examples_per_sec"]) else r64
+        res = dict(win)
+        res["batch_64"] = r64
+        res["batch_128"] = r128
+    else:
+        res = bench_model(label, deadline=deadline)
     print(RESULT_TAG + json.dumps(res), flush=True)
 
 
