@@ -281,17 +281,32 @@ def child_main(label):
     budget = float(os.environ.get("ADT_BENCH_MODEL_BUDGET_S", "600"))
     deadline = time.perf_counter() + budget
     if label == "bert_base":
-        # BOTH operating points measured in ONE artifact run; the
-        # headline is the artifact winner — never a one-off probe
-        # (VERDICT-r4 #4: the table must quote the artifact)
-        mid = time.perf_counter() + (deadline - time.perf_counter()) / 2
-        r64 = bench_model(label, deadline=mid, batch_size=64)
-        r128 = bench_model(label, deadline=deadline, batch_size=128)
-        win = r128 if (r128["examples_per_sec"]
-                       >= r64["examples_per_sec"]) else r64
-        res = dict(win)
-        res["batch_64"] = r64
-        res["batch_128"] = r128
+        # ALL candidate operating points measured in ONE artifact run;
+        # the headline is the artifact winner — never a one-off probe
+        # (VERDICT-r4 #4: the table must quote the artifact). 160 is the
+        # probed sweet spot (192 flat, 256 RESOURCE_EXHAUSTs).
+        batches = (64, 128, 160)
+        res, results = None, {}
+        for i, bs in enumerate(batches):
+            share = (deadline - time.perf_counter()) / (len(batches) - i)
+            try:
+                r = bench_model(label, deadline=time.perf_counter() + share,
+                                batch_size=bs)
+            except Exception as e:  # noqa: BLE001 — one operating point
+                # near the OOM cliff must not discard the others' results
+                r = {"error": "%s: %s" % (type(e).__name__, str(e)[:160])}
+                print("  bert batch %d failed: %s" % (bs, r["error"]),
+                      file=sys.stderr, flush=True)
+            results["batch_%d" % bs] = r
+            if "examples_per_sec" in r and (
+                    res is None
+                    or r["examples_per_sec"] > res["examples_per_sec"]):
+                res = r
+        if res is None:
+            raise RuntimeError("every bert operating point failed: %s"
+                               % results)
+        res = dict(res)
+        res.update(results)
     else:
         res = bench_model(label, deadline=deadline)
     print(RESULT_TAG + json.dumps(res), flush=True)
