@@ -88,10 +88,11 @@ class TransformerLM(nn.Module):
 def make_train_setup(config: Optional[LMConfig] = None, seq_len: int = 128,
                      batch_size: int = 32, seed: int = 0,
                      attention: str = "auto", lean_head="auto"):
-    """``attention``: "auto" (pallas flash kernel on TPU, XLA elsewhere),
-    "flash" (force the kernel; interpreted off-TPU), or "default" (XLA
-    softmax attention). Flash is 4.4x over the XLA path at seq 8192 on
-    chip and O(seq) memory, which is what makes long contexts fit.
+    """``attention``: "auto" (XLA softmax attention below seq 8192, the
+    pallas flash kernel at/above it on TPU — the measured crossover:
+    XLA is ~20% faster at seq 256 but falls over the [S, S] logits HBM
+    wall at 8192, where flash is 4.4x and O(seq) memory), "flash"
+    (force the kernel; interpreted off-TPU), or "default" (XLA always).
 
     ``lean_head``: True routes the loss through the chunked cross-entropy
     (``ops.xent.chunked_softmax_xent``) — the [tokens, vocab] fp32 logits
@@ -109,11 +110,17 @@ def make_train_setup(config: Optional[LMConfig] = None, seq_len: int = 128,
         raise ValueError("seq_len %d exceeds config.max_seq_len %d"
                          % (seq_len, cfg.max_seq_len))
     attn_fn = None
+    # "auto" matches the measured crossover (BENCHMARKS.md, same policy
+    # as models/bert.py): XLA's fused softmax attention is FASTER below
+    # seq 8192 (order-alternated on-chip pairs at lm1b seq 256 read
+    # ~290 vs ~244 seq/s) and only falls over the [S, S] logits HBM wall
+    # at/above it, where the flash kernel's O(S) memory keeps running.
     if attention == "flash" or (attention == "auto"
-                                and jax.default_backend() == "tpu"):
+                                and jax.default_backend() == "tpu"
+                                and seq_len >= 8192):
         from autodist_tpu.ops.flash_attention import make_flash_attn_fn
         attn_fn = make_flash_attn_fn(causal=True)
-    elif attention not in ("auto", "default"):
+    elif attention not in ("auto", "flash", "default"):
         raise ValueError("attention must be auto|flash|default, got %r"
                          % attention)
     model = TransformerLM(cfg, attn_fn=attn_fn)

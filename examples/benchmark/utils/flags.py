@@ -1,0 +1,193 @@
+"""Declarative benchmark flag system.
+
+Analog of the reference's vendored TF-official flag package
+(reference ``examples/benchmark/utils/flags/`` — ``core.py`` re-exporting
+absl-style ``DEFINE_*`` plus grouped ``define_base`` /
+``define_performance`` / ``define_benchmark`` helpers, consumed as
+``flags.FLAGS`` in ``examples/benchmark/bert.py:50-79`` etc.). The
+reference vendors absl; here the same declarative surface is ~150 lines
+over argparse — a registry of typed flags, a module-level ``FLAGS``
+namespace populated by ``parse()``, and the grouped define helpers the
+benchmark scripts share.
+
+Usage mirrors the reference scripts::
+
+    from examples.benchmark.utils import flags
+
+    flags.DEFINE_integer("train_batch_size", 8, "Total batch size.")
+    flags.DEFINE_boolean("proxy", True, "turn on/off the proxy")
+    flags.define_base()
+    flags.define_performance()
+
+    FLAGS = flags.FLAGS
+    flags.parse()            # or parse(argv) for tests
+    print(FLAGS.train_batch_size)
+
+Flags may also be set from the environment as ``ADT_FLAG_<NAME>``
+(checked at parse time, command line wins) — the knob the reference's
+benchmark CI used absl's ``--flagfile`` for.
+"""
+import argparse
+import os
+from typing import Any, Dict, Optional, Sequence
+
+
+class _FlagValues:
+    """The ``FLAGS`` namespace: attribute access to parsed values;
+    raises before ``parse()`` so an unparsed read cannot silently hand
+    out defaults the command line would have overridden."""
+
+    def __init__(self):
+        object.__setattr__(self, "_values", None)
+
+    def __getattr__(self, name):
+        values = object.__getattribute__(self, "_values")
+        if values is None:
+            raise AttributeError(
+                "FLAGS.%s read before flags.parse()" % name)
+        try:
+            return values[name]
+        except KeyError:
+            raise AttributeError("unknown flag %r (defined: %s)"
+                                 % (name, sorted(values))) from None
+
+    def __setattr__(self, name, value):
+        values = object.__getattribute__(self, "_values")
+        if values is None:
+            raise AttributeError("FLAGS assignment before flags.parse()")
+        values[name] = value
+
+
+FLAGS = _FlagValues()
+
+_registry: Dict[str, dict] = {}
+
+
+def _define(name: str, default, help_str: str, typ, choices=None):
+    if name in _registry:
+        raise ValueError("flag %r already defined" % name)
+    _registry[name] = {"default": default, "help": help_str, "type": typ,
+                       "choices": choices}
+
+
+def DEFINE_string(name, default, help):  # noqa: A002 — absl surface
+    _define(name, default, help, str)
+
+
+def DEFINE_integer(name, default, help):  # noqa: A002
+    _define(name, default, help, int)
+
+
+def DEFINE_float(name, default, help):  # noqa: A002
+    _define(name, default, help, float)
+
+
+def DEFINE_boolean(name=None, default=None, help=None, **kw):  # noqa: A002
+    # the reference calls both positionally and with keywords
+    # (``flags.DEFINE_boolean(name='proxy', default=True, ...)``)
+    name = kw.get("name", name)
+    default = kw.get("default", default)
+    _define(name, bool(default), kw.get("help", help), bool)
+
+
+DEFINE_bool = DEFINE_boolean
+
+
+def DEFINE_enum(name, default, enum_values, help):  # noqa: A002
+    _define(name, default, help, str, choices=list(enum_values))
+
+
+# ---------------------------------------------------------------- groups
+
+
+def define_base(data_dir=True, model_dir=True, train_epochs=True,
+                batch_size=True):
+    """The reference's shared training flags
+    (``utils/flags/_base.py:28``)."""
+    if data_dir and "data_dir" not in _registry:
+        DEFINE_string("data_dir", "/tmp/data",
+                      "Directory with input data (ADT record files).")
+    if model_dir and "model_dir" not in _registry:
+        DEFINE_string("model_dir", "/tmp/model",
+                      "Directory for checkpoints/exports.")
+    if train_epochs and "train_epochs" not in _registry:
+        DEFINE_integer("train_epochs", 1, "Number of training epochs.")
+    if batch_size and "batch_size" not in _registry:
+        DEFINE_integer("batch_size", 32, "Global batch size.")
+
+
+def define_performance(dtype=True, synthetic_data=True):
+    """The reference's performance flags
+    (``utils/flags/_performance.py:57``), TPU-native knobs."""
+    if dtype and "dtype" not in _registry:
+        DEFINE_enum("dtype", "bf16", ["bf16", "fp32"],
+                    "Compute dtype (bf16 is the TPU deployment default).")
+    if synthetic_data and "use_synthetic_data" not in _registry:
+        DEFINE_boolean("use_synthetic_data", True,
+                       "Synthetic batches instead of reading data_dir.")
+
+
+def define_benchmark(benchmark_log_dir=True):
+    """The reference's benchmark-logging flags
+    (``utils/flags/_benchmark.py:26``); the BigQuery uploader has no
+    analog here — logs are JSON lines (``utils/logs.py``)."""
+    if benchmark_log_dir and "benchmark_log_dir" not in _registry:
+        DEFINE_string("benchmark_log_dir", "",
+                      "Where BenchmarkLogger writes metric JSON lines "
+                      "('' = stderr only).")
+
+
+# ----------------------------------------------------------------- parse
+
+
+def parse(argv: Optional[Sequence[str]] = None) -> argparse.Namespace:
+    """Parse ``argv`` (default sys.argv[1:]) against every defined flag.
+    Precedence: command line > ``ADT_FLAG_<NAME>`` env > default."""
+    p = argparse.ArgumentParser()
+    for name, spec in sorted(_registry.items()):
+        default = spec["default"]
+        env = os.environ.get("ADT_FLAG_" + name.upper())
+        if env is not None:
+            if spec["type"] is bool:
+                low = env.strip().lower()
+                if low in ("1", "true", "yes", "on"):
+                    default = True
+                elif low in ("", "0", "false", "no", "off"):
+                    default = False
+                else:
+                    raise SystemExit(
+                        "ADT_FLAG_%s=%r is not a boolean (use 1/0, "
+                        "true/false, yes/no, on/off)" % (name.upper(), env))
+            else:
+                default = spec["type"](env)
+                if spec["choices"] and default not in spec["choices"]:
+                    # argparse only validates EXPLICIT values, not defaults
+                    raise SystemExit(
+                        "ADT_FLAG_%s=%r not in choices %s"
+                        % (name.upper(), env, spec["choices"]))
+        if spec["type"] is bool:
+            p.add_argument("--" + name, default=default,
+                           action=argparse.BooleanOptionalAction,
+                           help=spec["help"])
+        else:
+            p.add_argument("--" + name, type=spec["type"], default=default,
+                           choices=spec["choices"], help=spec["help"])
+    ns = p.parse_args(argv)
+    object.__setattr__(FLAGS, "_values", vars(ns))
+    return ns
+
+
+def reset() -> None:
+    """Drop every defined flag and parsed value (tests)."""
+    _registry.clear()
+    object.__setattr__(FLAGS, "_values", None)
+
+
+
+def flags_dict() -> Dict[str, Any]:
+    """The parsed values as a plain dict (the reference logger's
+    ``flags_core.get_nondefault_flags_as_str`` use case)."""
+    values = object.__getattribute__(FLAGS, "_values")
+    if values is None:
+        raise RuntimeError("flags.parse() has not run")
+    return dict(values)

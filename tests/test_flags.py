@@ -1,0 +1,83 @@
+"""Benchmark flag system (the reference's vendored TF-official
+``utils/flags`` package, reference ``examples/benchmark/utils/flags/``)."""
+import pytest
+
+from examples.benchmark.utils import flags
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    flags.reset()
+    yield
+    flags.reset()
+
+
+def test_define_parse_and_read():
+    flags.DEFINE_integer("train_batch_size", 8, "Total batch size.")
+    flags.DEFINE_string("strategy", "Parallax", "Strategy builder name.")
+    flags.DEFINE_boolean(name="proxy", default=True, help="proxy toggle")
+    flags.DEFINE_float("lr", 1e-3, "learning rate")
+    flags.DEFINE_enum("dtype", "bf16", ["bf16", "fp32"], "compute dtype")
+    flags.parse(["--train_batch_size", "64", "--no-proxy",
+                 "--dtype", "fp32"])
+    assert flags.FLAGS.train_batch_size == 64
+    assert flags.FLAGS.strategy == "Parallax"      # default
+    assert flags.FLAGS.proxy is False              # BooleanOptionalAction
+    assert flags.FLAGS.lr == 1e-3
+    assert flags.FLAGS.dtype == "fp32"
+    assert flags.flags_dict()["train_batch_size"] == 64
+
+
+def test_read_before_parse_raises():
+    flags.DEFINE_integer("n", 1, "")
+    with pytest.raises(AttributeError, match="before flags.parse"):
+        flags.FLAGS.n
+
+
+def test_unknown_flag_and_redefine():
+    flags.DEFINE_integer("n", 1, "")
+    flags.parse([])
+    with pytest.raises(AttributeError, match="unknown flag"):
+        flags.FLAGS.missing
+    with pytest.raises(ValueError, match="already defined"):
+        flags.DEFINE_integer("n", 2, "")
+
+
+def test_grouped_defines_and_env_override(monkeypatch):
+    flags.define_base()
+    flags.define_performance()
+    flags.define_benchmark()
+    monkeypatch.setenv("ADT_FLAG_BATCH_SIZE", "128")
+    monkeypatch.setenv("ADT_FLAG_USE_SYNTHETIC_DATA", "0")
+    flags.parse([])
+    assert flags.FLAGS.batch_size == 128          # env beats default
+    assert flags.FLAGS.use_synthetic_data is False
+    assert flags.FLAGS.dtype == "bf16"
+    flags.reset()
+    flags.define_base()
+    monkeypatch.setenv("ADT_FLAG_BATCH_SIZE", "128")
+    flags.parse(["--batch_size", "256"])          # CLI beats env
+    assert flags.FLAGS.batch_size == 256
+
+
+def test_enum_rejects_bad_choice():
+    flags.define_performance()
+    with pytest.raises(SystemExit):
+        flags.parse(["--dtype", "int8"])
+
+
+def test_env_overrides_are_validated(monkeypatch):
+    """Env overrides get the SAME validation as CLI values: argparse only
+    checks explicit args, so parse() must validate enum choices and
+    boolean spellings itself."""
+    flags.define_performance()
+    monkeypatch.setenv("ADT_FLAG_DTYPE", "int8")
+    with pytest.raises(SystemExit, match="not in choices"):
+        flags.parse([])
+    monkeypatch.setenv("ADT_FLAG_DTYPE", "fp32")
+    monkeypatch.setenv("ADT_FLAG_USE_SYNTHETIC_DATA", "FALSE")
+    flags.parse([])
+    assert flags.FLAGS.use_synthetic_data is False  # uppercase spelling
+    monkeypatch.setenv("ADT_FLAG_USE_SYNTHETIC_DATA", "maybe")
+    with pytest.raises(SystemExit, match="not a boolean"):
+        flags.parse([])
