@@ -671,7 +671,7 @@ class ShardedSaver:
             item = dstep.model_item
             holed = dstep._holed_template
             opt_template = jax.eval_shape(item.optimizer.init, holed)
-            p_flex = o_flex = s_flex = None
+            p_flex = o_flex = None
             if not same:
                 p_flex = dict(dstep.layouts)
                 o_flex = dict(dstep.layouts)
@@ -683,16 +683,29 @@ class ShardedSaver:
                         item.var_infos, dstep.layouts)
                     if var and var in dstep.layouts:
                         o_flex[n] = dstep.layouts[var]
-                s_flex = {}
             params = self._restore_device_tree("P", holed, meta, reader,
                                                dstep.mesh, suffix, p_flex)
             opt_state = self._restore_device_tree("O", opt_template, meta,
                                                   reader, dstep.mesh, suffix,
                                                   o_flex)
             sync_template = dstep._sync_state_init()
-            sync_state = self._restore_device_tree("S", sync_template, meta,
-                                                   reader, dstep.mesh, suffix,
-                                                   s_flex)
+            if same:
+                sync_state = self._restore_device_tree(
+                    "S", sync_template, meta, reader, dstep.mesh, suffix)
+            else:
+                # compressor state (error-feedback residuals, PowerSGD
+                # factors) is PER-DEVICE — stored with a leading device
+                # axis sized by the SAVE topology. Re-slicing it across a
+                # different device count would silently assign residuals
+                # to the wrong devices (or fail outright on scale-up), so
+                # a cross-topology restore resets it to fresh init:
+                # error feedback restarts from zero, a safe transient.
+                if any(k.startswith("S|") for k in meta["leaves"]):
+                    logging.warning(
+                        "cross-topology restore: per-device compressor "
+                        "state reset to fresh init (residuals are "
+                        "topology-bound)")
+                sync_state = dstep.place_sync_state(sync_template)
             store = dstep.ps_store
             if store is not None:
                 # a staged prefetch of pre-restore values must not survive

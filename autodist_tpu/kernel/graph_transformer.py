@@ -190,6 +190,13 @@ class DistributedStep:
         from autodist_tpu.parallel.mesh import host_to_mesh
         return host_to_mesh(self.mesh, value, pspec)
 
+    def place_sync_state(self, sync_state):
+        """Compressor state onto the mesh in its storage layout (leading
+        device axis over all mesh axes) — the ONE placement rule, shared
+        by init_state and the cross-topology restore's reset path."""
+        return jax.tree_util.tree_map(
+            lambda arr: self._put(arr, P(self.all_axes)), sync_state)
+
     def init_state(self, params, opt_state=None, sync_state=None) -> TrainState:
         """Shard initial params/optimizer state into storage layout: PS
         leaves go to the host store; device leaves are padded (partitioned
@@ -249,8 +256,7 @@ class DistributedStep:
         opt_placed = _tree_map_layouts(place_var, opt_state, opt_layout_tree)
         if sync_state is None:
             sync_state = self._sync_state_init()
-        sync_placed = jax.tree_util.tree_map(
-            lambda arr: self._put(arr, P(self.all_axes)), sync_state)
+        sync_placed = self.place_sync_state(sync_state)
         step0 = self._put(np.zeros((), np.int32), P())
         return TrainState(step=step0, params=params_placed,
                           opt_state=opt_placed, sync_state=sync_placed)

@@ -536,3 +536,74 @@ def test_sharded_roundtrip_tensor_parallel(tmp_path):
         lambda a, b: np.testing.assert_array_equal(np.asarray(a),
                                                    np.asarray(b)),
         final_a, final_b)
+
+
+def test_sharded_flex_restore_resets_compressor_state(tmp_path):
+    """Per-device compressor state (EF residuals, leading device axis
+    sized by the SAVE topology) cannot be re-sliced across device counts
+    — a cross-topology restore resets it to fresh init (a safe error-
+    feedback restart) while params/opt restore bit-exact, and training
+    continues in BOTH directions (8 -> 4 and 4 -> 8, where naive
+    re-slicing would crash on the uneven leading dim)."""
+    from autodist_tpu.checkpoint import ShardedSaver
+    params, loss_fn, batch = _problem()
+    make = lambda: S.AllReduce(compressor="HorovodCompressorEF")  # noqa: E731
+    ad8 = autodist_tpu.AutoDist(strategy_builder=make())
+    runner8 = ad8.build(loss_fn, optax.sgd(0.05), params, batch)
+    runner8.init(params)
+    for _ in range(3):
+        runner8.run(batch)
+    want = {k: np.asarray(v) for k, v in runner8.gather_params().items()}
+    saver = ShardedSaver(directory=str(tmp_path))
+    saver.save(runner8)
+
+    autodist_tpu.reset()
+    ad4 = autodist_tpu.AutoDist(resource_spec=_cpu_spec(4),
+                                strategy_builder=make())
+    runner4 = ad4.build(loss_fn, optax.sgd(0.05), params, batch)
+    runner4.init(params)
+    _, step = saver.restore(runner4)
+    assert step == 3
+    got = {k: np.asarray(v) for k, v in runner4.gather_params().items()}
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+    # residuals reset: sync state equals a fresh init, and training runs
+    fresh = runner4.distributed_step._sync_state_init()
+    restored = runner4.distributed_step.gather_sync_state(runner4.state)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        fresh, restored)
+    assert np.isfinite(runner4.run(batch)["loss"])
+    for _ in range(2):
+        runner4.run(batch)
+    saver2 = ShardedSaver(directory=str(tmp_path / "up"))
+    saver2.save(runner4)
+
+    # scale UP 4 -> 8: the leading device axis would not even divide
+    autodist_tpu.reset()
+    ad8b = autodist_tpu.AutoDist(strategy_builder=make())
+    runner8b = ad8b.build(loss_fn, optax.sgd(0.05), params, batch)
+    runner8b.init(params)
+    _, step = saver2.restore(runner8b)
+    assert step == 6
+    assert np.isfinite(runner8b.run(batch)["loss"])
+    autodist_tpu.reset()
+
+
+def test_fit_save_every_with_sharded_saver(tmp_path):
+    """Runner.fit(save_every=N, saver=ShardedSaver) commits sharded
+    checkpoints on the training loop (same call contract as Saver), and
+    auto-resume machinery can read them back."""
+    from autodist_tpu.checkpoint import ShardedSaver, latest_checkpoint
+    params, loss_fn, batch = _problem()
+    ad = autodist_tpu.AutoDist(strategy_builder=S.PartitionedAR())
+    runner = ad.build(loss_fn, optax.sgd(0.05), params, batch)
+    runner.init(params)
+    saver = ShardedSaver(directory=str(tmp_path), async_save=True)
+    history = runner.fit(iter([batch] * 5), save_every=2, saver=saver)
+    assert len(history) == 5
+    step, found = latest_checkpoint(str(tmp_path))
+    assert isinstance(found, ShardedSaver) and step == 5
+    state, got_step = found.restore(runner)
+    assert got_step == 5

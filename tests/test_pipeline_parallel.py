@@ -426,3 +426,42 @@ def test_interleaved_setup_requires_pp_shards():
     with pytest.raises(ValueError, match="requires pp_shards"):
         pipe_lm.make_train_setup(TPLMConfig.tiny(num_layers=4),
                                  schedule="interleaved", virtual_stages=2)
+
+
+def test_pp_lm_interleaved_with_tp_matches_single_device():
+    """interleaved x tensor-parallel composition: V chunks per pipe rank
+    with Megatron column/row compute inside each chunk — the schedule has
+    no per-tick branching (unlike 1F1B's lax.cond), so in-chunk model-axis
+    collectives stay trivially matched."""
+    pp, tp, V, micro = 2, 2, 2, 4
+    dp = 8 // (pp * tp)
+    cfg = TPLMConfig.tiny(num_layers=pp * V)
+    loss_fn, params, batch, _ = pipe_lm.make_train_setup(
+        cfg, seq_len=16, batch_size=micro * dp, seed=1,
+        n_microbatches=micro, schedule="interleaved",
+        virtual_stages=V, pp_shards=pp, model_axis=const.MODEL_AXIS)
+    opt = optax.sgd(0.05)
+
+    @jax.jit
+    def step(p, s, b):
+        g = jax.grad(loss_fn)(p, b)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s
+
+    ref, state = params, opt.init(params)
+    for _ in range(2):
+        ref, state = step(ref, state, batch)
+
+    ad = adt.AutoDist(strategy_builder=strategy.PipelineParallel(
+        pp_shards=pp, tp_shards=tp, n_microbatches=micro,
+        schedule="interleaved", virtual_stages=V,
+        mp_rules=pipe_lm.pp_rules(model_axis=const.MODEL_AXIS)))
+    runner = ad.build(loss_fn, opt, params, batch)
+    runner.init(params)
+    for _ in range(2):
+        m = runner.run(batch)
+    assert np.isfinite(m["loss"])
+    got = runner.gather_params()
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-5, atol=5e-6),
+        got, ref)
