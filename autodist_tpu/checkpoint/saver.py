@@ -211,9 +211,15 @@ class Saver:
             raise FileNotFoundError("no checkpoint in %s" % self.directory)
         dstep = runner.distributed_step
         params = self.restore_params(dstep.model_item.params, path)
-        opt_flat = dict(np.load(path + ".opt.npz"))
-        opt_template = dstep.model_item.optimizer.init(dstep.model_item.params)
-        opt_state = _flat_to_tree(opt_template, opt_flat)
+        if dstep.model_item.optimizer is not None:
+            opt_flat = dict(np.load(path + ".opt.npz"))
+            opt_template = dstep.model_item.optimizer.init(
+                dstep.model_item.params)
+            opt_state = _flat_to_tree(opt_template, opt_flat)
+        else:
+            # step_fn mode: whatever optimizer state exists lives inside
+            # the user's opaque state (saved under params)
+            opt_state = {}
         sync_state = None
         if os.path.exists(path + ".sync.npz"):
             sync_flat = dict(np.load(path + ".sync.npz"))

@@ -670,7 +670,10 @@ class ShardedSaver:
         try:
             item = dstep.model_item
             holed = dstep._holed_template
-            opt_template = jax.eval_shape(item.optimizer.init, holed)
+            # step_fn mode has no framework optimizer: the opaque state's
+            # own moments live under P| and the O tree is empty
+            opt_template = (jax.eval_shape(item.optimizer.init, holed)
+                            if item.optimizer is not None else {})
             p_flex = o_flex = None
             if not same:
                 p_flex = dict(dstep.layouts)

@@ -285,7 +285,9 @@ def child_main(label):
         # the headline is the artifact winner — never a one-off probe
         # (VERDICT-r4 #4: the table must quote the artifact). 160 is the
         # probed sweet spot (192 flat, 256 RESOURCE_EXHAUSTs).
-        batches = (64, 128, 160)
+        # winner-first order: if the budget kills the child mid-sweep,
+        # the headline operating point is already measured
+        batches = (160, 128, 64)
         res, results = None, {}
         for i, bs in enumerate(batches):
             share = (deadline - time.perf_counter()) / (len(batches) - i)

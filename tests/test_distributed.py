@@ -27,14 +27,14 @@ import pytest
 HERE = os.path.dirname(os.path.abspath(__file__))
 DRIVER = os.path.join(HERE, "dist_driver.py")
 
-SPEC_YAML = """
-nodes:
-  - address: 127.0.0.1
-    chief: true
-    cpus: [0, 1, 2, 3]
-  - address: localhost
-    cpus: [0, 1, 2, 3]
-"""
+def _pair_spec_yaml(devices_per_proc=4):
+    cpus = ", ".join(str(i) for i in range(devices_per_proc))
+    return ("nodes:\n"
+            "  - address: 127.0.0.1\n    chief: true\n    cpus: [%s]\n"
+            "  - address: localhost\n    cpus: [%s]\n" % (cpus, cpus))
+
+
+SPEC_YAML = _pair_spec_yaml()
 
 
 def _free_port():
@@ -460,18 +460,22 @@ def test_remap_feed_local_validates_replica_divisibility(monkeypatch):
 SHARDED_DRIVER = os.path.join(HERE, "sharded_driver.py")
 
 
-def _launch_sharded_pair(tmp_path, builder, phase, ckpt_dir):
-    spec = tmp_path / "spec.yml"
-    spec.write_text(SPEC_YAML)
+def _launch_sharded_pair(tmp_path, builder, phase, ckpt_dir,
+                         devices_per_proc=4):
+    spec = tmp_path / ("spec-%d.yml" % devices_per_proc)
+    spec.write_text(_pair_spec_yaml(devices_per_proc))
     port = _free_port()
-    strategy_id = "sharded-%s-%s-%d" % (builder, phase, os.getpid())
+    strategy_id = "sharded-%s-%s-%d-%d" % (builder, phase, os.getpid(),
+                                           devices_per_proc)
     outs, procs = [], []
     for pid in range(2):
-        out = tmp_path / ("sh-%s-%d.json" % (phase, pid))
+        out = tmp_path / ("sh-%s-%d-%d.json" % (phase, pid,
+                                                devices_per_proc))
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)
         env.update({
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=%d"
+                         % devices_per_proc,
             "ADT_COORDINATOR_ADDR": "127.0.0.1:%d" % port,
             "ADT_NUM_PROCESSES": "2",
             "ADT_PROCESS_ID": str(pid),
@@ -616,3 +620,23 @@ def test_sharded_cross_world_resume(tmp_path, builder):
     for k in run0["params"]:
         np.testing.assert_allclose(run0["params"][k], res["params"][k],
                                    rtol=1e-4, atol=1e-6, err_msg=k)
+
+
+@pytest.mark.parametrize("builder", ["PartitionedAR"])
+def test_sharded_cross_mesh_resume_peak_memory(tmp_path, builder):
+    """Cross-TOPOLOGY restore keeps the memory property the format exists
+    for: a checkpoint saved by 2 processes over an 8-device mesh resumes
+    in 2 processes over a 4-device mesh (same world, halved mesh — every
+    new slice spans two saved slices), trajectory matching the
+    uninterrupted run, and NO process's restore peak approaches the full
+    tree (each still assembles only its own half)."""
+    ckpt = tmp_path / "ckpt"
+    run0, _run1 = _launch_sharded_pair(tmp_path, builder, "run", ckpt)
+    res0, res1 = _launch_sharded_pair(tmp_path, builder, "resume", ckpt,
+                                      devices_per_proc=2)
+    np.testing.assert_allclose(run0["losses"][3:], res0["losses"],
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_array_equal(res0["losses"], res1["losses"])
+    for r in (res0, res1):
+        assert r["peak_bytes"] < 0.6 * r["full_bytes"], \
+            (r["peak_bytes"], r["full_bytes"])
