@@ -94,7 +94,8 @@ def _block(p, x, dt, model_axis):
 def forward(params, input_ids, cfg: TPLMConfig, n_microbatches: int = 1,
             pipe_axis: str = const.PIPELINE_AXIS,
             model_axis: str = const.MODEL_AXIS,
-            virtual_stages: int = 1, pp_shards: int = 0):
+            virtual_stages: int = 1, pp_shards: int = 0,
+            remat_chunks: bool = False):
     dt = cfg.dtype
     seq_len = input_ids.shape[-1]
     x = tensor.vocab_parallel_embed(params["embed"], input_ids, model_axis)
@@ -111,7 +112,8 @@ def forward(params, input_ids, cfg: TPLMConfig, n_microbatches: int = 1,
     if virtual_stages > 1:
         x = pipeline.pipeline_apply_interleaved(
             stage_fn, params["blocks"], x, n_microbatches, virtual_stages,
-            pipe_axis, pp_shards_hint=pp_shards)
+            pipe_axis, pp_shards_hint=pp_shards,
+            remat_chunks=remat_chunks)
     else:
         x = pipeline.pipeline_apply(stage_fn, params["blocks"], x,
                                     n_microbatches, pipe_axis)
@@ -124,7 +126,8 @@ def make_train_setup(cfg: Optional[TPLMConfig] = None, seq_len: int = 128,
                      n_microbatches: int = 1,
                      model_axis: str = const.MODEL_AXIS,
                      schedule: str = "gpipe",
-                     virtual_stages: int = 2, pp_shards: int = 0):
+                     virtual_stages: int = 2, pp_shards: int = 0,
+                     remat_chunks: bool = False):
     """``schedule="1f1b"`` trains through the fused 1F1B pipeline
     (``parallel/pipeline.pipeline_loss_1f1b``): the loss head moves
     INSIDE the pipelined region so backward microbatches interleave with
@@ -153,7 +156,7 @@ def make_train_setup(cfg: Optional[TPLMConfig] = None, seq_len: int = 128,
         tokens = batch["tokens"]
         logits = forward(p, tokens[:, :-1], cfg, n_microbatches,
                          model_axis=model_axis, virtual_stages=vstages,
-                         pp_shards=pp_shards)
+                         pp_shards=pp_shards, remat_chunks=remat_chunks)
         nll = tensor.vocab_parallel_xent(logits, tokens[:, 1:], model_axis)
         return jnp.mean(nll)
 

@@ -105,7 +105,8 @@ def _chunk(tree, c, V):
 def pipeline_apply_interleaved(stage_fn: Callable, stage_params, x,
                                n_microbatches: int, virtual_stages: int,
                                axis_name: str = const.PIPELINE_AXIS,
-                               pp_shards_hint: int = 0):
+                               pp_shards_hint: int = 0,
+                               remat_chunks: bool = False):
     """Interleaved (virtual-stage) pipeline schedule — Megatron-LM's
     bubble-cutting variant (Narayanan et al. 2104.04473): each rank holds
     ``V = virtual_stages`` layer CHUNKS instead of one contiguous block,
@@ -127,6 +128,14 @@ def pipeline_apply_interleaved(stage_fn: Callable, stage_params, x,
     S-1 -> 0 carries chunk-boundary hops; GPipe's chain never uses it).
     Needs ``M % S == 0`` (the standard interleaved-schedule constraint)
     and ``L_local % V == 0``.
+
+    ``remat_chunks=True`` wraps each slot's chunk application in
+    ``jax.checkpoint``: AD then stashes only the slot INPUT per tick and
+    recomputes the chunk forward inside the backward — activation
+    residency drops from every intra-chunk layer activation across all
+    M*V slots to one microbatch activation per slot (the same
+    FLOPs-for-HBM trade the 1F1B schedule makes per microbatch), with
+    bit-identical numerics.
     """
     V = int(virtual_stages)
     if V < 1:
@@ -167,6 +176,8 @@ def pipeline_apply_interleaved(stage_fn: Callable, stage_params, x,
     x_mb = x.reshape((M, B // M) + x.shape[1:])
     ring = [(i, (i + 1) % S_int) for i in range(S_int)]
 
+    apply_chunk = (jax.checkpoint(stage_fn) if remat_chunks else stage_fn)
+
     state0 = jnp.zeros_like(x_mb[0])
     outs0 = jnp.zeros_like(x_mb)
 
@@ -184,7 +195,7 @@ def pipeline_apply_interleaved(stage_fn: Callable, stage_params, x,
                         jax.lax.dynamic_index_in_dim(x_mb, m, 0,
                                                      keepdims=False),
                         state)
-        out = stage_fn(_chunk(stage_params, c, V), inp)
+        out = apply_chunk(_chunk(stage_params, c, V), inp)
         out = jnp.where(on, out, jnp.zeros_like(out))
         # virtual stage V*S-1 = rank S-1's chunk V-1 finishes microbatch m
         done = on & (rank == S - 1) & (c == V - 1)
