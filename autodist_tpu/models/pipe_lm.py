@@ -143,6 +143,14 @@ def make_train_setup(cfg: Optional[TPLMConfig] = None, seq_len: int = 128,
     params = init_params(cfg, seed)
     if schedule not in ("gpipe", "1f1b", "interleaved"):
         raise ValueError("schedule must be 'gpipe', '1f1b' or 'interleaved'")
+    if remat_chunks and schedule != "interleaved":
+        # a silently-dropped memory flag is an OOM the user believes
+        # they already fixed; per-chunk remat only exists on the
+        # interleaved path (use WithRemat/graph_config.remat for the
+        # whole-program trade on the other schedules)
+        raise ValueError("remat_chunks=True requires "
+                         "schedule='interleaved' (whole-program remat: "
+                         "strategy.WithRemat)")
     if schedule == "interleaved" and pp_shards < 2:
         # without the stage count the single-device degenerate trace
         # CANNOT emulate the schedule-defined layer order (physical chunk
@@ -191,5 +199,6 @@ def make_train_setup(cfg: Optional[TPLMConfig] = None, seq_len: int = 128,
     apply_fn = lambda p, ids: forward(p, ids, cfg, n_microbatches,  # noqa: E731
                                       model_axis=model_axis,
                                       virtual_stages=vstages,
-                                      pp_shards=pp_shards)
+                                      pp_shards=pp_shards,
+                                      remat_chunks=remat_chunks)
     return loss_fn, params, example_batch, apply_fn
