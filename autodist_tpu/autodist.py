@@ -269,18 +269,35 @@ class AutoDist:
         compiled = StrategyCompiler(item, self._resource_spec).compile(strategy)
         logging.info("compiled %r", compiled)
         logging.debug("compiled strategy:\n%s", compiled)
-        # the pipeline schedule is baked into the loss at model-build time;
-        # a strategy claiming a different one (an AutoStrategy alternate
-        # from mp_meta['pp_schedules']) would be priced/gated for a program
-        # that never runs — fail with the rebuild instruction instead
-        declared = (item.mp_meta or {}).get("pp_schedule")
-        picked = compiled.graph_config.pp_schedule
-        if declared and picked and declared != picked:
-            raise ValueError(
-                "the strategy wants pipeline schedule %r but the loss was "
-                "built with %r — rebuild the model's loss "
-                "(make_train_setup(schedule=%r)) and declare it via "
-                "mp_meta['pp_schedule']" % (picked, declared, picked))
+        # pipeline knobs are baked into the loss at model-build time; a
+        # strategy claiming different ones (an AutoStrategy alternate from
+        # mp_meta) would be priced/gated for a program that never runs —
+        # or, for interleaved pp_shards, train a DIFFERENT logical layer
+        # order than every unbound trace emulates. Fail with the rebuild
+        # instruction instead.
+        meta = item.mp_meta or {}
+        gc = compiled.graph_config
+        picked_checks = [
+            ("pp_schedule", gc.pp_schedule, "schedule"),
+            ("pp_microbatches", gc.pp_microbatches, "n_microbatches"),
+            ("pp_virtual", gc.pp_virtual, "virtual_stages"),
+            ("pp_shards",
+             (gc.mesh_shape or {}).get(const.PIPELINE_AXIS), "pp_shards"),
+        ]
+        for key, picked, setup_kw in picked_checks:
+            declared = meta.get(key)
+            if key == "pp_shards" and meta.get("pp_schedule") != "interleaved":
+                # gpipe/1f1b losses read S off the mesh axis at run time;
+                # only the interleaved loss bakes the stage count
+                continue
+            if (declared is not None and picked is not None
+                    and declared != picked):
+                raise ValueError(
+                    "the strategy wants pipeline %s=%r but the loss was "
+                    "built with %r — rebuild the model's loss "
+                    "(make_train_setup(%s=%r)) and declare it via "
+                    "mp_meta[%r]"
+                    % (key, picked, declared, setup_kw, picked, key))
         self._setup(compiled)
         is_async = self._validate_async(compiled, item)
         if (const.ENV.ADT_ELASTIC.val > 0 and not is_async

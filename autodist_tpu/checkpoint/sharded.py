@@ -790,7 +790,12 @@ class ShardedSaver:
             vkeys = ["H|%s::%d" % (name, s) for s in range(nsaved)]
             value = gather_range(vkeys, lo, hi, axis, offs)
             # optimizer leaves: var-shaped ones re-slice like the value;
-            # shard-invariant ones (step counts, scalars) copy shard 0's
+            # shard-invariant ones (step counts, scalars, factored stats)
+            # copy shard 0's. Var-shaped means FULL shape equality with
+            # that saved shard's value — a per-column stats leaf whose one
+            # extent happens to match the shard size must not be sliced
+            # (same rule load_opt_from_full applies on the plain path).
+            shard0_shape = tuple(np.asarray(reader(vkeys[0])).shape)
             opt_flat: Dict[str, np.ndarray] = {}
             leaf_names = sorted({
                 k.split("|", 2)[2]
@@ -800,7 +805,7 @@ class ShardedSaver:
                 lkeys = ["Ho|%s::%d|%s" % (name, s, ln)
                          for s in range(nsaved)]
                 probe = np.asarray(reader(lkeys[0]))
-                if probe.ndim > axis and probe.shape[axis] == sizes[0]:
+                if tuple(probe.shape) == shard0_shape:
                     opt_flat[ln] = gather_range(lkeys, lo, hi, axis, offs)
                 else:
                     opt_flat[ln] = probe

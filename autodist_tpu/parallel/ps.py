@@ -724,9 +724,9 @@ class PSStore:
                 grp["worker"].drain(timeout)
 
     def close(self) -> None:
-        if self._apply_pool is not None:
-            self._apply_pool.shutdown(wait=True)
-            self._apply_pool = None
+        # stop the owner apply loops BEFORE shutting the apply pool: a
+        # still-running worker mid-apply_local would lazily rebuild a
+        # fresh pool after its shutdown, leaking threads forever
         if self._serve_groups is not None:
             for grp in self._serve_groups.values():
                 stopped = True
@@ -740,6 +740,9 @@ class PSStore:
                     # under a live thread mid-publish
                     logging.warning("PS owner apply thread did not stop; "
                                     "leaving its service open")
+        if self._apply_pool is not None:
+            self._apply_pool.shutdown(wait=True)
+            self._apply_pool = None
 
     def _densify(self, name: str, plan: PSVarPlan, pair) -> np.ndarray:
         """(indices, values) -> dense mean gradient for the full var.

@@ -134,25 +134,41 @@ class CoordinationClient:
 
     # ----------------------------------------------------------------- api
 
+    @staticmethod
+    def _token(name: str) -> str:
+        """Keys/queue/worker names ride the line protocol as single
+        space-separated tokens. Whitespace would shift the argument arity
+        — and on the BINARY commands the server would then take the
+        unknown-command branch with the payload already in flight,
+        parsing raw gradient bytes as command lines (the desync the
+        length validation closes for bad lengths). Reject loudly here."""
+        if not name or any(c.isspace() for c in name):
+            raise ValueError(
+                "coordination-service name %r must be non-empty with no "
+                "whitespace" % (name,))
+        return name
+
     def ping(self) -> bool:
         return self._cmd("PING") == "PONG"
 
     def put(self, key: str, value: str):
-        assert self._cmd("PUT %s %s" % (key, value)) == "OK"
+        assert self._cmd("PUT %s %s" % (self._token(key), value)) == "OK"
 
     def get(self, key: str) -> Optional[str]:
-        resp = self._cmd("GET %s" % key)
+        resp = self._cmd("GET %s" % self._token(key))
         return None if resp == "NONE" else resp[4:]
 
     def incr(self, name: str) -> int:
-        return int(self._cmd("INC %s" % name)[4:])
+        return int(self._cmd("INC %s" % self._token(name))[4:])
 
     def barrier(self, name: str, num_workers: int):
         """Block until ``num_workers`` processes reach this barrier."""
-        assert self._cmd("BARRIER %s %d" % (name, num_workers)) == "OK"
+        assert self._cmd("BARRIER %s %d"
+                         % (self._token(name), num_workers)) == "OK"
 
     def report_step(self, worker: str, step: int):
-        assert self._cmd("STEP %s %d" % (worker, step)) == "OK"
+        assert self._cmd("STEP %s %d"
+                         % (self._token(worker), step)) == "OK"
 
     def min_step(self) -> int:
         return int(self._cmd("MINSTEP")[4:])
@@ -165,23 +181,24 @@ class CoordinationClient:
     def goodbye(self, worker: str):
         """Clean deregister: a finished worker must not be counted dead by
         the watchdog nor keep bounding the staleness window."""
-        return self._cmd("GOODBYE %s" % worker)
+        return self._cmd("GOODBYE %s" % self._token(worker))
 
     def heartbeat(self, worker: str):
-        assert self._cmd("HEARTBEAT %s" % worker) == "OK"
+        assert self._cmd("HEARTBEAT %s" % self._token(worker)) == "OK"
 
     # ---- versioned blobs + FIFO queues (the async-PS wire; payloads are
     #      raw bytes, base64'd on the line protocol)
 
     def bput(self, key: str, version: int, payload: bytes):
         """Publish a versioned blob (binary frame — raw bytes on the wire)."""
-        resp = self._cmd_raw("BPUTB %s %d %d" % (key, version, len(payload)),
+        resp = self._cmd_raw("BPUTB %s %d %d"
+                             % (self._token(key), version, len(payload)),
                              payload)
         assert resp == "OK", resp
 
     def bget(self, key: str):
         """(version, payload) of the latest published blob, or None."""
-        resp = self._cmd("BGETB %s" % key)
+        resp = self._cmd("BGETB %s" % self._token(key))
         if resp == "NONE":
             return None
         _, ver, n = resp.split(" ", 2)
@@ -190,18 +207,19 @@ class CoordinationClient:
     def qpush(self, queue: str, payload: bytes):
         """Enqueue a blob (binary frame); raises when the service's queue
         cap rejects it (dead-owner backpressure)."""
-        resp = self._cmd_raw("QPUSHB %s %d" % (queue, len(payload)), payload)
+        resp = self._cmd_raw("QPUSHB %s %d"
+                             % (self._token(queue), len(payload)), payload)
         if resp != "OK":
             raise RuntimeError("qpush rejected: %s" % resp)
 
     def qpop(self, queue: str):
-        resp = self._cmd("QPOPB %s" % queue)
+        resp = self._cmd("QPOPB %s" % self._token(queue))
         if resp == "NONE":
             return None
         return self._recv_raw(int(resp.split(" ", 1)[1]))
 
     def qlen(self, queue: str) -> int:
-        return int(self._cmd("QLEN %s" % queue)[4:])
+        return int(self._cmd("QLEN %s" % self._token(queue))[4:])
 
     def dead_workers(self, timeout_s: float) -> List[str]:
         resp = self._cmd("DEADLIST %s" % timeout_s)

@@ -124,6 +124,13 @@ def mp_candidates(model_item, resource_spec
                 if sched == "interleaved":
                     if m % k:
                         return None  # schedule constraint: M % S == 0
+                    # the interleaved loss BAKES its stage count (the
+                    # degenerate trace emulates that logical layer
+                    # order); only the declared pp_shards is a valid
+                    # candidate — others would fail the build guard
+                    declared_s = meta.get("pp_shards")
+                    if declared_s is not None and k != int(declared_s):
+                        return None
                     return PipelineParallel(pp_shards=k, tp_shards=t,
                                             n_microbatches=m,
                                             schedule=sched, mp_rules=rules,

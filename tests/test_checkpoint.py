@@ -607,3 +607,42 @@ def test_fit_save_every_with_sharded_saver(tmp_path):
     assert isinstance(found, ShardedSaver) and step == 5
     state, got_step = found.restore(runner)
     assert got_step == 5
+
+
+def test_flex_ps_provider_copies_shape_coincident_leaves(tmp_path):
+    """A shard-invariant optimizer leaf whose one extent coincides with
+    the saved shard size (e.g. per-column stats of shape (8,) on (8, 8)
+    value shards) must be COPIED on a cross-layout restore, not
+    re-sliced — classification is full shape equality with the shard's
+    value, not an axis-extent coincidence."""
+    from autodist_tpu.checkpoint import ShardedSaver
+    from autodist_tpu.checkpoint.sharded import _group_keys
+    from autodist_tpu.parallel.ps import PSVarPlan
+
+    colstats = np.arange(8).astype(np.float32)  # (8,) == shard rows
+    data = {
+        "H|emb::0": np.arange(64).reshape(8, 8).astype(np.float32),
+        "H|emb::1": (np.arange(64) + 64).reshape(8, 8).astype(np.float32),
+        "Ho|emb::0|0/colstats/v": colstats,
+        "Ho|emb::1|0/colstats/v": colstats,
+        "Ho|emb::0|0/mu/v": np.zeros((8, 8), np.float32),
+        "Ho|emb::1|0/mu/v": np.ones((8, 8), np.float32),
+    }
+    meta = {"ps": {"emb": {"axis": 0, "nshards": 2, "shard_sizes": [8, 8]}},
+            "keys": {k: 0 for k in data}}
+
+    class _Store:
+        plans = {"emb": PSVarPlan(var_name="emb",
+                                  destinations=("h",) * 4,
+                                  shard_sizes=(4, 4, 4, 4))}
+
+    saver = ShardedSaver(directory=str(tmp_path))
+    provider = saver._flex_ps_provider(meta, data.__getitem__,
+                                       _group_keys(meta), _Store())
+    # new shard 1 covers saved rows 4:8 of saved shard 0
+    value, opt = provider("emb", 1)
+    np.testing.assert_array_equal(value, data["H|emb::0"][4:8])
+    # var-shaped leaf re-slices with the value...
+    np.testing.assert_array_equal(opt["0/mu/v"], np.zeros((4, 8)))
+    # ...the coincidence leaf is copied whole (a slice would read (4,))
+    np.testing.assert_array_equal(opt["0/colstats/v"], colstats)

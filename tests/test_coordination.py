@@ -288,15 +288,19 @@ def test_unparseable_blob_length_closes_connection(server):
     c2.close()
 
 
-def test_unparseable_blob_length_closes_connection(server):
-    """atol('x16') == 0 would accept a zero-byte frame and parse the real
-    payload as command lines; strict parsing must reject and close."""
+def test_whitespace_keys_rejected_client_side(server):
+    """A key with whitespace would shift the line-protocol arity — and on
+    the binary commands the payload would already be in flight when the
+    server takes the unknown-command branch, re-opening the desync. The
+    client rejects such names before any bytes hit the wire."""
     c = _client()
-    c._sock.sendall(b"BPUTB k 1 x16\n" + b"\nSHUTDOWN\nPUT pwned2 yes\n"[:16])
-    assert c._recv_line().startswith("ERR bad length")
-    c._sock.settimeout(5.0)
-    assert c._sock.recv(1) == b""  # closed: payload never parsed
-    c2 = _client()
-    assert c2.ping()                # service alive, nothing executed
-    assert c2.get("pwned2") is None
-    c2.close()
+    for call in (lambda: c.bput("my weight", 1, b"x"),
+                 lambda: c.qpush("q one", b"x"),
+                 lambda: c.put("a key", "v"),
+                 lambda: c.get("a\tkey"),
+                 lambda: c.heartbeat("worker one"),
+                 lambda: c.qpush("", b"x")):
+        with pytest.raises(ValueError, match="no\\s+whitespace|non-empty"):
+            call()
+    assert c.ping()  # connection untouched by the rejected calls
+    c.close()

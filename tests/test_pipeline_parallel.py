@@ -529,3 +529,32 @@ def test_interleaved_remat_chunks_same_numerics_smaller_stash():
     plain, remat = residual_bytes(False), residual_bytes(True)
     assert plain > 0 and remat > 0
     assert remat < 0.5 * plain, (plain, remat)
+
+
+def test_build_rejects_pp_knob_mismatches():
+    """The guard covers every baked pipeline knob, not just the schedule:
+    a strategy with different microbatches — or, for interleaved, a
+    different stage count than the loss's logical layer order — fails
+    loudly with the rebuild instruction."""
+    cfg = TPLMConfig.tiny(num_layers=4)
+    loss_fn, params, batch, _ = pipe_lm.make_train_setup(
+        cfg, seq_len=16, batch_size=8, n_microbatches=2, schedule="gpipe")
+    ad = adt.AutoDist(strategy_builder=strategy.PipelineParallel(
+        pp_shards=2, n_microbatches=4, mp_rules=pipe_lm.pp_rules()))
+    with pytest.raises(ValueError, match="pp_microbatches"):
+        ad.build(loss_fn, optax.sgd(0.05), params, batch,
+                 mp_meta={"pp_schedule": "gpipe", "pp_microbatches": 2})
+    adt.reset()
+
+    # interleaved: the loss bakes pp_shards=2; a pp4 strategy must refuse
+    loss_i, params_i, batch_i, _ = pipe_lm.make_train_setup(
+        cfg, seq_len=16, batch_size=8, n_microbatches=4,
+        schedule="interleaved", virtual_stages=2, pp_shards=2)
+    ad = adt.AutoDist(strategy_builder=strategy.PipelineParallel(
+        pp_shards=4, n_microbatches=4, schedule="interleaved",
+        virtual_stages=2, mp_rules=pipe_lm.pp_rules()))
+    with pytest.raises(ValueError, match="pp_shards"):
+        ad.build(loss_i, optax.sgd(0.05), params_i, batch_i,
+                 mp_meta={"pp_schedule": "interleaved",
+                          "pp_microbatches": 4, "pp_virtual": 2,
+                          "pp_shards": 2})
