@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from autodist_tpu import const
 from autodist_tpu.kernel.partitioner import VariablePartitioner, VarLayout
+from autodist_tpu.model_item import _normalize_path
 from autodist_tpu.kernel.common import variable_utils
 from autodist_tpu.kernel.synchronization.synchronizer import Synchronizer
 from autodist_tpu.parallel import collectives
@@ -57,6 +58,7 @@ class DistributedStep:
         self.mesh_axis = mesh_axis
         self.all_axes = tuple(mesh.axis_names)
         self.seq_axis = strategy.graph_config.seq_axis
+        self.seq_feed_keys = strategy.graph_config.seq_feed_keys
         self.batch_axes = tuple(strategy.graph_config.batch_axes or (mesh_axis,))
         self._step_fn = step_fn
         self._step_fn_nodonate = step_fn_nodonate or step_fn
@@ -152,10 +154,16 @@ class DistributedStep:
         self._push_ps(ps_grads)
         return new_state, metrics
 
-    def evaluate(self, state: TrainState, batch):
+    def evaluate(self, state: TrainState, batch, ps_vals=None):
         """Forward-only metrics: no grads, no optimizer, no gradient
-        collectives — ~3x cheaper than a train step."""
-        ps_vals = self._pull_ps()
+        collectives — ~3x cheaper than a train step. ``ps_vals`` lets an
+        eval LOOP pull the host-PS values once and reuse them across
+        batches (no push happens between eval batches, so per-batch
+        re-pulls would be pure PCIe waste — 1 GB of store-resident
+        params x 100 batches is 100 GB of transfer for unchanged
+        values)."""
+        if ps_vals is None:
+            ps_vals = self._pull_ps()
         if self._eval_fn is None:
             _, _, metrics = self._step_fn_nodonate(state, ps_vals, batch)
             return metrics
@@ -313,7 +321,8 @@ class DistributedStep:
         (delegates to the Remapper's validated feed path)."""
         from autodist_tpu.remapper import Remapper
         return Remapper(self.mesh, self.mesh_axis, seq_axis=self.seq_axis,
-                        batch_axes=self.batch_axes).remap_feed(batch)
+                        batch_axes=self.batch_axes,
+                        seq_keys=self.seq_feed_keys).remap_feed(batch)
 
 
 class GraphTransformer:
@@ -344,7 +353,8 @@ class GraphTransformer:
         from autodist_tpu.kernel.replicator import Replicator
         batch_axes = tuple(
             self._strategy.graph_config.batch_axes or (self._axis,))
-        return Replicator.apply(self._mesh, batch_axes, self._seq_axis)
+        return Replicator.apply(self._mesh, batch_axes, self._seq_axis,
+                                self._strategy.graph_config.seq_feed_keys)
 
     def _build_synchronizers(self, layouts, ps_names=frozenset(),
                              sparse_wire=frozenset()) -> Dict[str, Synchronizer]:
@@ -455,8 +465,10 @@ class GraphTransformer:
         state_specs = _tree_map_layouts(lambda _leaf, lay: lay.pspec,
                                         item.params, layout_tree)
         rep = self._replica_info()
-        batch_specs = jax.tree_util.tree_map(
-            lambda leaf: rep.batch_spec(np.ndim(leaf)), item.example_batch)
+        batch_specs = jax.tree_util.tree_map_with_path(
+            lambda path, leaf: rep.batch_spec(np.ndim(leaf),
+                                              _normalize_path(path)),
+            item.example_batch)
 
         out_aval = jax.eval_shape(item.step_fn, item.params,
                                   item.example_batch)
@@ -599,13 +611,13 @@ class GraphTransformer:
             # shapes cannot disagree with the actual batch split.
             rep = self._replica_info()
 
-            def local_aval(leaf):
+            def local_aval(path, leaf):
                 return jax.ShapeDtypeStruct(
-                    rep.local_shape(np.shape(leaf)),
+                    rep.local_shape(np.shape(leaf), _normalize_path(path)),
                     np.asarray(leaf).dtype
                     if not hasattr(leaf, "dtype") else leaf.dtype)
-            local_batch = jax.tree_util.tree_map(local_aval,
-                                                 item.example_batch)
+            local_batch = jax.tree_util.tree_map_with_path(
+                local_aval, item.example_batch)
             discovered = set()
             # the taps/safety traces run OUTSIDE the step's shard_map but
             # the loss may use mesh collectives (ring attention, Megatron
@@ -934,8 +946,10 @@ class GraphTransformer:
         # replication bookkeeping (replica count, batch specs, local
         # shapes) has a single owner: the Replicator kernel
         rep = self._replica_info()
-        batch_specs = jax.tree_util.tree_map(
-            lambda leaf: rep.batch_spec(np.ndim(leaf)), item.example_batch)
+        batch_specs = jax.tree_util.tree_map_with_path(
+            lambda path, leaf: rep.batch_spec(np.ndim(leaf),
+                                              _normalize_path(path)),
+            item.example_batch)
 
         # metrics out-structure from an abstract eval of the loss (may fail
         # for SP losses that need a bound axis; scalar-loss fallback)

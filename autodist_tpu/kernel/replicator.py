@@ -25,14 +25,16 @@ from autodist_tpu.kernel.kernel import Kernel
 
 class Replicator(Kernel):
     def __init__(self, key, mesh, batch_axes: Tuple[str, ...],
-                 seq_axis: Optional[str] = None):
+                 seq_axis: Optional[str] = None, seq_keys=None):
         super().__init__(key)
         self._mesh = mesh
         self._batch_axes = tuple(batch_axes)
         self._seq_axis = seq_axis
+        self._seq_keys = seq_keys
 
     def _apply(self) -> "ReplicaInfo":
-        return ReplicaInfo(self._mesh, self._batch_axes, self._seq_axis)
+        return ReplicaInfo(self._mesh, self._batch_axes, self._seq_axis,
+                           self._seq_keys)
 
 
 class ReplicaInfo:
@@ -40,10 +42,13 @@ class ReplicaInfo:
     ``GraphTransformer.transform``)."""
 
     def __init__(self, mesh, batch_axes: Tuple[str, ...],
-                 seq_axis: Optional[str] = None):
+                 seq_axis: Optional[str] = None, seq_keys=None):
         self.mesh = mesh
         self.batch_axes = tuple(batch_axes)
         self.seq_axis = seq_axis
+        # leaf names whose dim 1 is the sequence dim; None = every
+        # rank>=2 leaf (strategy graph_config.seq_feed_keys)
+        self.seq_keys = frozenset(seq_keys) if seq_keys else None
 
     @property
     def num_replicas(self) -> int:
@@ -61,22 +66,33 @@ class ReplicaInfo:
         """Sequence-dim division factor (1 without sequence parallelism)."""
         return int(self.mesh.shape[self.seq_axis]) if self.seq_axis else 1
 
-    def batch_spec(self, ndim: int) -> P:
+    def _seq_applies(self, ndim: int, name: Optional[str]) -> bool:
+        """Whether dim 1 of this leaf shards over the sequence axis.
+        With ``seq_keys`` declared, only the named leaves do — a one-hot
+        label leaf [B, C] must not have its CLASS dim sliced; without the
+        declaration every rank>=2 leaf does (legacy), which is only
+        correct when the batch is all token-shaped arrays."""
+        if not self.seq_axis or ndim < 2:
+            return False
+        return self.seq_keys is None or name in self.seq_keys
+
+    def batch_spec(self, ndim: int, name: Optional[str] = None) -> P:
         """PartitionSpec for one batch leaf: leading dim over the batch
-        axes; dim 1 over the sequence axis for rank>=2 leaves under SP."""
+        axes; dim 1 over the sequence axis when ``_seq_applies``."""
         if ndim == 0:
             return P()
-        if self.seq_axis and ndim >= 2:
+        if self._seq_applies(ndim, name):
             return P(self.batch_axes, self.seq_axis)
         return P(self.batch_axes)
 
-    def local_shape(self, shape: Tuple[int, ...]) -> Tuple[int, ...]:
+    def local_shape(self, shape: Tuple[int, ...],
+                    name: Optional[str] = None) -> Tuple[int, ...]:
         """Per-device shape of a batch leaf, when divisible — the inverse
         of the sharding ``batch_spec`` declares."""
         shape = list(shape)
         if len(shape) >= 1 and shape[0] % self.batch_factor == 0:
             shape[0] //= self.batch_factor
-        if self.seq_factor > 1 and len(shape) >= 2 \
+        if self._seq_applies(len(shape), name) \
                 and shape[1] % self.seq_factor == 0:
             shape[1] //= self.seq_factor
         return tuple(shape)

@@ -670,12 +670,18 @@ class PSStore:
                    "worker": None}
             if owned:
                 shard_set = frozenset(grp["pairs"])
+                # values ride the HOT channel (fetched by every worker's
+                # per-step pull); the optimizer moments publish on the
+                # side channel, fetched only at checkpoint time — under
+                # Adam this cuts the per-step serving wire ~3x
                 grp["worker"] = pss.AsyncPSWorker(
                     svc,
                     functools.partial(self.apply_local,
                                       shard_filter=shard_set),
                     functools.partial(self._local_shard_blobs,
-                                      grp["pairs"], with_opt=True)).start()
+                                      grp["pairs"]),
+                    opt_fn=functools.partial(self._local_opt_blobs,
+                                             grp["pairs"])).start()
             self._serve_groups[host] = grp
         logging.info("async PS serving: %d owner groups, this process (%s) "
                      "owns %s", len(self._serve_groups), my_host,
@@ -686,11 +692,8 @@ class PSStore:
         """{'name::si': shard value} for the given (name, si) pairs — the
         owner's publish payload (only the shards it owns). With
         ``with_opt``, the shard's optimizer-state leaves ride along as
-        ``name::si!<leaf>`` so a chief-side checkpoint can reconstruct a
-        COMPLETE opt state for variables whose shards it does not own
-        (per-shard ownership means no single process applies to every
-        shard — without the wire, peer shards' moments would silently
-        checkpoint as their frozen local init)."""
+        ``name::si!<leaf>`` (single-blob form; serving publishes them on
+        the separate opt channel instead, see ``_local_opt_blobs``)."""
         from autodist_tpu.kernel.common import variable_utils
         out = {}
         with self._lock:
@@ -702,6 +705,24 @@ class PSStore:
                         self._opt[name][si])
                     for ln, leaf in zip(names, leaves):
                         out["%s!%s" % (key, ln)] = np.asarray(leaf)
+        return out
+
+    def _local_opt_blobs(self, pairs) -> Dict[str, np.ndarray]:
+        """{'name::si!leaf': opt leaf} for the owned (name, si) pairs —
+        the optimizer-state side channel a chief-side checkpoint reads to
+        reconstruct a COMPLETE opt state for shards it does not own
+        (per-shard ownership means no single process applies to every
+        shard — without the wire, peer shards' moments would silently
+        checkpoint as their frozen local init)."""
+        from autodist_tpu.kernel.common import variable_utils
+        out = {}
+        with self._lock:
+            for name, si in pairs:
+                key = "%s::%d" % (name, si)
+                names, leaves, _ = variable_utils.flatten_named(
+                    self._opt[name][si])
+                for ln, leaf in zip(names, leaves):
+                    out["%s!%s" % (key, ln)] = np.asarray(leaf)
         return out
 
     @property
@@ -869,6 +890,14 @@ class PSStore:
         finally:
             for w in workers:
                 w.resume()
+        if self._serve_config is not None and self._serve_groups is None:
+            # serving was requested before any values existed (the
+            # ADT_AUTO_RESUME path restores through the sharded format
+            # BEFORE init_params ever runs): activate it now, or the job
+            # would silently train disconnected local mirrors — no owner
+            # loops, no cross-process exchange — with only the
+            # "serving is not wired" warning as a symptom
+            self._start_serving()
 
     def full_opt_leaf(self, slot_path: str, var_name: str):
         """Reconstruct one optimizer-state subtree in the var's full layout
@@ -881,8 +910,9 @@ class PSStore:
         if self._serve_groups is not None:
             # per-shard ownership: this process's local opt state is only
             # authoritative for the shards it owns; peer-owned shards'
-            # moments come off the owner's published blob (the ::si!leaf
-            # keys _local_shard_blobs ships with every value publish)
+            # moments come off the owner's opt side channel (the
+            # ::si!leaf keys published with every apply, fetched only
+            # here — never by the per-step value pulls)
             states = [self._remote_opt_state(var_name, si, st)
                       for si, st in enumerate(states)]
         # the per-shard little trees hold the same subtree under ".../v"
@@ -918,7 +948,7 @@ class PSStore:
                 continue
             if grp["owned"]:
                 return local_state
-            res = grp["service"].fetch()
+            res = grp["service"].fetch_opt()
             if res is None:
                 return local_state  # owner pre-publish
             blobs = pss.unpack_arrays(res[1])
@@ -965,7 +995,8 @@ class PSStore:
         store has one authoritative owner copy, so there is nothing to
         cross-check (and no consistent snapshot to hash under the apply
         thread)."""
-        assert not self.serving, "mirror_digest is for sync (mirror) mode"
+        if self.serving:  # not an assert: must hold under python -O too
+            raise RuntimeError("mirror_digest is for sync (mirror) mode")
         import hashlib
         h = hashlib.md5()
         for name in sorted(self._values):

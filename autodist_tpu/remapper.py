@@ -19,15 +19,19 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from autodist_tpu.model_item import _normalize_path
 from autodist_tpu.utils import logging
 
 
 class Remapper:
     def __init__(self, mesh, mesh_axis: str, seq_axis: str = None,
-                 batch_axes=None):
+                 batch_axes=None, seq_keys=None):
         self.mesh = mesh
         self.mesh_axis = mesh_axis
         self.seq_axis = seq_axis
+        # leaf names whose dim 1 is the sequence dim (strategy
+        # graph_config.seq_feed_keys); None = every rank>=2 leaf
+        self.seq_keys = frozenset(seq_keys) if seq_keys else None
         # axes the batch dim shards over (expert-parallel strategies add the
         # expert axis so every device sees distinct tokens)
         self.batch_axes = tuple(batch_axes) if batch_axes else (mesh_axis,)
@@ -47,10 +51,15 @@ class Remapper:
         from autodist_tpu.parallel.mesh import host_to_mesh
         return host_to_mesh(self.mesh, value, pspec)
 
-    def _leaf_spec(self, shape, replicas: int, what: str) -> P:
+    def _leaf_spec(self, shape, replicas: int, what: str,
+                   name: str = None) -> P:
         """PartitionSpec + divisibility validation shared by the global
         and process-local feed paths (``replicas`` is the batch-dim
-        divisor the caller needs: all replicas, or this process's)."""
+        divisor the caller needs: all replicas, or this process's).
+        With ``seq_keys`` declared, only the named leaves shard dim 1
+        over the sequence axis — a one-hot label leaf [B, C] must not
+        have its class dim sliced (or spuriously rejected) just for
+        being rank 2."""
         if len(shape) == 0:
             return P()
         if shape[0] % replicas != 0:
@@ -58,11 +67,15 @@ class Remapper:
                 "%s batch dim %d is not divisible by the %d replicas; pad "
                 "or resize the batch (TPU programs need static, even "
                 "shards)" % (what, shape[0], replicas))
-        if self.seq_axis and len(shape) >= 2:
+        seq_applies = (self.seq_axis and len(shape) >= 2
+                       and (self.seq_keys is None or name in self.seq_keys))
+        if seq_applies:
             if shape[1] % self.seq_shards != 0:
                 raise ValueError(
-                    "sequence dim %d is not divisible by the %d "
-                    "sequence shards" % (shape[1], self.seq_shards))
+                    "sequence dim %d of %r is not divisible by the %d "
+                    "sequence shards (not a sequence leaf? declare the "
+                    "token keys via SequenceParallelAR(seq_keys=[...]))"
+                    % (shape[1], name, self.seq_shards))
             return P(self.batch_axes, self.seq_axis)
         return P(self.batch_axes)
 
@@ -71,9 +84,9 @@ class Remapper:
         are already mesh-placed with the right sharding (e.g. by
         ``data.DevicePrefetcher``) pass through untouched — re-placing
         would round-trip them through the host."""
-        def place(leaf):
+        def place(path, leaf):
             spec = self._leaf_spec(np.shape(leaf), self.num_replicas,
-                                   "global")
+                                   "global", _normalize_path(path))
             if isinstance(leaf, jax.Array):
                 want = NamedSharding(self.mesh, spec)
                 if leaf.sharding.is_equivalent_to(want, leaf.ndim):
@@ -92,7 +105,7 @@ class Remapper:
                 # process-local device array: re-place via the host-global
                 # path (make_array_from_callback), which every process runs
             return self._place(np.asarray(leaf), spec)
-        return jax.tree_util.tree_map(place, batch)
+        return jax.tree_util.tree_map_with_path(place, batch)
 
     def remap_feed_local(self, local_batch) -> Any:
         """Place a PROCESS-LOCAL batch as this process's slice of the
@@ -113,16 +126,17 @@ class Remapper:
                 % (self.num_replicas, jax.process_count()))
         local_replicas = self.num_replicas // jax.process_count()
 
-        def place(leaf):
+        def place(path, leaf):
             arr = np.asarray(leaf)
             if arr.ndim == 0:
                 # scalars are replicated; every process must provide the
                 # same value (cannot be a per-process slice)
                 return self._place(arr, P())
-            spec = self._leaf_spec(arr.shape, local_replicas, "local")
+            spec = self._leaf_spec(arr.shape, local_replicas, "local",
+                                   _normalize_path(path))
             return jax.make_array_from_process_local_data(
                 NamedSharding(self.mesh, spec), arr)
-        return jax.tree_util.tree_map(place, local_batch)
+        return jax.tree_util.tree_map_with_path(place, local_batch)
 
     # ----------------------------------------------------------------- fetch
 

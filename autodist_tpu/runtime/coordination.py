@@ -148,11 +148,21 @@ class CoordinationClient:
                 "whitespace" % (name,))
         return name
 
+    def _cmd_ok(self, line: str) -> None:
+        """Side-effecting RPC that must succeed. NOT an assert: under
+        ``python -O`` asserts are stripped WITH their expressions, which
+        would silently drop heartbeats, staleness pacing, and barrier
+        waits (the RPC itself would never be sent)."""
+        resp = self._cmd(line)
+        if resp != "OK":
+            raise RuntimeError("coordination service rejected %r: %s"
+                               % (line.split(" ", 1)[0], resp))
+
     def ping(self) -> bool:
         return self._cmd("PING") == "PONG"
 
     def put(self, key: str, value: str):
-        assert self._cmd("PUT %s %s" % (self._token(key), value)) == "OK"
+        self._cmd_ok("PUT %s %s" % (self._token(key), value))
 
     def get(self, key: str) -> Optional[str]:
         resp = self._cmd("GET %s" % self._token(key))
@@ -163,12 +173,10 @@ class CoordinationClient:
 
     def barrier(self, name: str, num_workers: int):
         """Block until ``num_workers`` processes reach this barrier."""
-        assert self._cmd("BARRIER %s %d"
-                         % (self._token(name), num_workers)) == "OK"
+        self._cmd_ok("BARRIER %s %d" % (self._token(name), num_workers))
 
     def report_step(self, worker: str, step: int):
-        assert self._cmd("STEP %s %d"
-                         % (self._token(worker), step)) == "OK"
+        self._cmd_ok("STEP %s %d" % (self._token(worker), step))
 
     def min_step(self) -> int:
         return int(self._cmd("MINSTEP")[4:])
@@ -176,7 +184,7 @@ class CoordinationClient:
     def wait_staleness(self, my_step: int, staleness: int):
         """Block while my_step > min_step + staleness (the bounded-staleness
         window; with staleness=0 this is lockstep sync)."""
-        assert self._cmd("WAITMIN %d %d" % (my_step, staleness)) == "OK"
+        self._cmd_ok("WAITMIN %d %d" % (my_step, staleness))
 
     def goodbye(self, worker: str):
         """Clean deregister: a finished worker must not be counted dead by
@@ -184,7 +192,7 @@ class CoordinationClient:
         return self._cmd("GOODBYE %s" % self._token(worker))
 
     def heartbeat(self, worker: str):
-        assert self._cmd("HEARTBEAT %s" % self._token(worker)) == "OK"
+        self._cmd_ok("HEARTBEAT %s" % self._token(worker))
 
     # ---- versioned blobs + FIFO queues (the async-PS wire; payloads are
     #      raw bytes, base64'd on the line protocol)
@@ -194,7 +202,8 @@ class CoordinationClient:
         resp = self._cmd_raw("BPUTB %s %d %d"
                              % (self._token(key), version, len(payload)),
                              payload)
-        assert resp == "OK", resp
+        if resp != "OK":
+            raise RuntimeError("bput rejected: %s" % resp)
 
     def bget(self, key: str):
         """(version, payload) of the latest published blob, or None."""

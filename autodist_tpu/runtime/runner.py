@@ -30,7 +30,9 @@ class Runner:
         self._remapper = Remapper(distributed_step.mesh,
                                   distributed_step.mesh_axis,
                                   seq_axis=distributed_step.seq_axis,
-                                  batch_axes=distributed_step.batch_axes)
+                                  batch_axes=distributed_step.batch_axes,
+                                  seq_keys=getattr(distributed_step,
+                                                   "seq_feed_keys", None))
         self._tracing = tracing
         self._trace_started = False
         self.state: Optional[TrainState] = None
@@ -413,10 +415,15 @@ class Runner:
         if self.state is None:
             raise RuntimeError("Runner.evaluate before init()")
         totals, count, skipped = {}, 0, set()
+        # ONE host-PS pull for the whole eval loop: no pushes happen
+        # between eval batches, so the values cannot change — a consistent
+        # snapshot, and per-batch re-pulls would be pure PCIe waste
+        ps_vals = self._dstep._pull_ps()
         bounded = batches if steps is None else itertools.islice(batches, steps)
         for batch in bounded:
             sharded = self._remapper.remap_feed(batch)
-            metrics = self._dstep.evaluate(self.state, sharded)
+            metrics = self._dstep.evaluate(self.state, sharded,
+                                           ps_vals=ps_vals)
             host = self._remapper.remap_fetch(metrics)
             for k, v in host.items():
                 if np.ndim(v) == 0:

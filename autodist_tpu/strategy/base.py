@@ -177,6 +177,11 @@ class GraphConfig:
     # axis. Expert-parallel strategies set ['data', 'expert'] so every
     # device sees distinct tokens
     batch_axes: Optional[List[str]] = None
+    # with seq_axis set: the batch-leaf names whose dim 1 really is the
+    # sequence dim. None = every rank>=2 leaf (legacy behavior — fine
+    # when the batch is all token arrays, silently WRONG for e.g. one-hot
+    # label leaves whose dim 1 is classes; set this to the token keys)
+    seq_feed_keys: Optional[List[str]] = None
     # gradient rematerialization: None (store all activations), "full"
     # (jax.checkpoint — recompute the forward in the backward, minimum
     # HBM), or "dots" (save matmul outputs only). A graph-level transform
@@ -204,6 +209,7 @@ class GraphConfig:
     def to_dict(self):
         return {"replicas": list(self.replicas), "mesh_shape": self.mesh_shape,
                 "seq_axis": self.seq_axis, "batch_axes": self.batch_axes,
+                "seq_feed_keys": self.seq_feed_keys,
                 "remat": self.remat, "pp_microbatches": self.pp_microbatches,
                 "pp_schedule": self.pp_schedule,
                 "pp_virtual": self.pp_virtual,
@@ -215,6 +221,7 @@ class GraphConfig:
                    mesh_shape=d.get("mesh_shape"),
                    seq_axis=d.get("seq_axis"),
                    batch_axes=d.get("batch_axes"),
+                   seq_feed_keys=d.get("seq_feed_keys"),
                    remat=d.get("remat"),
                    pp_microbatches=d.get("pp_microbatches"),
                    pp_schedule=d.get("pp_schedule"),

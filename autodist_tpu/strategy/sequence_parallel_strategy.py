@@ -18,12 +18,16 @@ from autodist_tpu.strategy.base import Strategy
 class SequenceParallelAR(AllReduce):
     def __init__(self, seq_shards: int, attention: str = "ring",
                  chunk_size: int = 128, all_reduce_spec: str = "AUTO",
-                 compressor: str = "NoneCompressor"):
+                 compressor: str = "NoneCompressor", seq_keys=None):
         super().__init__(chunk_size, all_reduce_spec, compressor)
         if seq_shards < 1:
             raise ValueError("seq_shards must be >= 1")
         self.seq_shards = seq_shards
         self.attention = attention  # metadata: which attn the model should use
+        # batch-leaf names whose dim 1 is the sequence dim; None = every
+        # rank>=2 leaf (set this when the batch mixes token arrays with
+        # other rank>=2 leaves, e.g. one-hot labels)
+        self.seq_keys = list(seq_keys) if seq_keys else None
 
     def build(self, model_item, resource_spec) -> Strategy:
         strategy = super().build(model_item, resource_spec)
@@ -36,4 +40,5 @@ class SequenceParallelAR(AllReduce):
             const.SEQUENCE_AXIS: self.seq_shards,
         }
         strategy.graph_config.seq_axis = const.SEQUENCE_AXIS
+        strategy.graph_config.seq_feed_keys = self.seq_keys
         return strategy

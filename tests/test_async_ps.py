@@ -237,3 +237,74 @@ def test_per_shard_ownership_and_opt_checkpoint_wire():
     finally:
         a.close()
         b.close()
+
+
+def test_sharded_restore_activates_deferred_serving():
+    """enable_serving() before any values exist defers bring-up; when a
+    SHARDED-checkpoint restore is what first populates the store (the
+    ADT_AUTO_RESUME path, which never calls init_params), serving must
+    activate at the end of load_shard_states — or the job would silently
+    train disconnected local mirrors with no owner loops at all."""
+    infos = {"w": VarInfo(name="w", shape=(4, 2), dtype="float32")}
+    plans = {"w": PSVarPlan(var_name="w", destinations=("hostA:CPU:0",),
+                            sync=False)}
+    services = {}
+
+    def service_for_host(host):
+        return services.setdefault(host, pss.LocalPSService())
+
+    store = PSStore(dict(plans), infos, optax.sgd(0.1))
+    store.enable_serving(service_for_host, my_host="hostA")
+    assert not store.serving  # deferred: no values yet
+
+    value = np.full((4, 2), 3.0, np.float32)
+
+    def provider(name, si):
+        return value, {}
+
+    store.load_shard_states(provider)
+    assert store.serving, "restore-first bring-up never started serving"
+    # the owner loop exists and the restored values were published
+    grp = store._serve_groups["hostA"]
+    assert grp["owned"] and grp["worker"] is not None
+    res = services["hostA"].fetch()
+    assert res is not None
+    blobs = pss.unpack_arrays(res[1])
+    np.testing.assert_array_equal(blobs["w::0"], value)
+    store.close()
+
+
+def test_serving_publishes_opt_on_side_channel():
+    """Per-step value publishes carry NO optimizer leaves (the 3x-wire
+    saving); the moments ride the /opt side channel, fetched only by
+    checkpoint reconstruction. Adam, so moments exist."""
+    infos = {"w": VarInfo(name="w", shape=(4, 2), dtype="float32")}
+    plans = {"w": PSVarPlan(var_name="w", destinations=("hostA:CPU:0",),
+                            sync=False)}
+    services = {}
+
+    def service_for_host(host):
+        return services.setdefault(host, pss.LocalPSService())
+
+    init = {"w": np.ones((4, 2), np.float32)}
+    owner = PSStore(dict(plans), infos, optax.adam(0.1))
+    owner.init_params(init)
+    owner.enable_serving(service_for_host, my_host="hostA")
+    worker = PSStore(dict(plans), infos, optax.adam(0.1))
+    worker.init_params(init)
+    worker.enable_serving(service_for_host, my_host="hostB")
+    try:
+        g = {"w": np.full((4, 2), 0.5, np.float32)}
+        worker.push(g)
+        owner.drain()
+        res = services["hostA"].fetch()
+        assert res is not None
+        vals = pss.unpack_arrays(res[1])
+        assert set(vals) == {"w::0"}  # values only, no '!' opt keys
+        res_opt = services["hostA"].fetch_opt()
+        assert res_opt is not None
+        opts = pss.unpack_arrays(res_opt[1])
+        assert opts and all("!" in k for k in opts)
+    finally:
+        owner.close()
+        worker.close()

@@ -244,7 +244,8 @@ def test_two_process_async_per_shard_ownership(tmp_path):
         for host, vals in blobs.items():
             for key in vals:
                 if "!" in key:
-                    continue  # opt-state leaves ride the same blob
+                    continue  # legacy single-blob form (opt now rides
+                    # the /opt side channel)
                 name, si = key.rsplit("::", 1)
                 by_var.setdefault(name, {}).setdefault(int(si), set()).add(host)
         split = {n: owners for n, owners in by_var.items()

@@ -434,3 +434,18 @@ def test_ps_threaded_apply_bitexact_vs_single(monkeypatch):
     np.testing.assert_array_equal(l1, l4)
     for k in p1:
         np.testing.assert_array_equal(np.asarray(p1[k]), np.asarray(p4[k]))
+
+
+def test_evaluate_pulls_ps_once_for_whole_loop():
+    """Runner.evaluate pulls the host-PS values ONCE for the whole eval
+    loop — no pushes happen between eval batches, so per-batch re-pulls
+    would be pure PCIe waste (1 GB of store params x 100 batches = 100 GB
+    of transfer for unchanged values)."""
+    runner, params, batch = _build(strategy.PS(), opt=optax.sgd(0.05))
+    runner.init(params)
+    runner.run(batch)
+    runner.distributed_step.flush_ps()
+    store = runner.distributed_step.ps_store
+    before = store.stats["pulls"]
+    runner.evaluate(iter([batch] * 5))
+    assert store.stats["pulls"] - before <= 1, store.stats

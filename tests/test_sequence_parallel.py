@@ -132,3 +132,52 @@ def test_ring_attention_grads_match():
     got = f(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                rtol=2e-3, atol=2e-4)
+
+
+def test_seq_keys_exempt_non_sequence_leaves():
+    import optax
+    import autodist_tpu as adt
+    from autodist_tpu import strategy
+    adt.reset()
+    """SequenceParallelAR(seq_keys=[...]): only the declared token leaves
+    shard dim 1 over the seq axis — a rank-2 one-hot-style leaf whose
+    dim 1 is CLASSES (and not divisible by the shard count) is replicated
+    per batch row instead of being sliced or spuriously rejected."""
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(8, 5).astype(np.float32))}
+    S_SEQ, C = 16, 5  # C=5 NOT divisible by 2 seq shards
+
+    def loss_fn(p, batch):
+        # tokens [B, S] drive a trivial per-position embedding-free model;
+        # weights [B, C] (dim 1 = classes) scale the loss per example
+        feat = batch["tokens"][..., None].astype(jnp.float32) @ \
+            jnp.ones((1, 8), jnp.float32)
+        pred = feat @ p["w"]                       # [B, S, C]
+        w = jnp.mean(batch["class_weights"], axis=1)  # [B]
+        return jnp.mean(jnp.mean(pred ** 2, axis=(1, 2)) * w)
+
+    batch = {"tokens": rng.randint(0, 9, (8, S_SEQ)).astype(np.int32),
+             "class_weights": np.ones((8, C), np.float32)}
+
+    ad = adt.AutoDist(strategy_builder=strategy.SequenceParallelAR(
+        seq_shards=2, attention="ring", seq_keys=["tokens"]))
+    runner = ad.build(loss_fn, optax.sgd(0.05), params, batch)
+    runner.init(params)
+    m = runner.run(batch)
+    assert np.isfinite(m["loss"])
+    placed = runner.remapper.remap_feed(batch)
+    from jax.sharding import PartitionSpec as P
+    assert placed["tokens"].sharding.spec == P(("data",), "seq")
+    assert placed["class_weights"].sharding.spec == P(("data",))
+
+    # without the declaration, the same batch is spuriously rejected
+    adt.reset()
+    ad2 = adt.AutoDist(strategy_builder=strategy.SequenceParallelAR(
+        seq_shards=2, attention="ring"))
+    runner2 = ad2.build(loss_fn, optax.sgd(0.05), params,
+                        {"tokens": batch["tokens"],
+                         "class_weights": batch["class_weights"]})
+    runner2.init(params)
+    with pytest.raises(ValueError, match="not divisible by the 2"):
+        runner2.run(batch)
+    adt.reset()
