@@ -74,21 +74,28 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False):
     l0 = jnp.zeros((B, H, C), jnp.float32)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
-    def body(t, carry):
-        acc, m, l, k_cur, v_cur = carry
+    def block_step(t, acc, m, l, k_cur, v_cur):
         # after t forward rotations, we hold the block originally at rank - t
         src = (my_rank - t) % axis_size
         blk_mask = None
         if causal:
             k_pos = src * C + jnp.arange(C)
             blk_mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
-        acc, m, l = _block_update(q, k_cur, v_cur, acc, m, l, blk_mask, scale)
+        return _block_update(q, k_cur, v_cur, acc, m, l, blk_mask, scale)
+
+    def body(t, carry):
+        acc, m, l, k_cur, v_cur = carry
+        acc, m, l = block_step(t, acc, m, l, k_cur, v_cur)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return acc, m, l, k_nxt, v_nxt
 
-    acc, m, l, _, _ = jax.lax.fori_loop(0, axis_size, body,
-                                        (acc0, m0, l0, k, v))
+    # N-1 rotations suffice: the last block updates WITHOUT the trailing
+    # ppermute pair whose rotated result nothing reads (1/N of the op's
+    # communication on an N-way ring)
+    acc, m, l, k_last, v_last = jax.lax.fori_loop(
+        0, axis_size - 1, body, (acc0, m0, l0, k, v))
+    acc, m, l = block_step(axis_size - 1, acc, m, l, k_last, v_last)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
 
@@ -131,11 +138,20 @@ def make_attn_fn(kind: str = "ring", axis_name: str = "seq",
     """Attention implementation injectable into model layers
     (``models/layers.py`` MultiHeadAttention.attn_fn)."""
     if kind == "ring":
-        return lambda q, k, v, mask=None: ring_attention(
-            q, k, v, axis_name, causal=causal)
+        def ring_fn(q, k, v, mask=None):
+            if mask is not None:
+                # silently dropping the model's padding mask would let
+                # every token attend PAD positions with no error
+                raise ValueError(
+                    "ring attention cannot apply a dense mask (the K/V "
+                    "blocks rotate); use kind='ulysses' (full-sequence "
+                    "attention per head group honors masks) or pack "
+                    "sequences without padding")
+            return ring_attention(q, k, v, axis_name, causal=causal)
+        return ring_fn
     if kind == "ulysses":
         return lambda q, k, v, mask=None: ulysses_attention(
-            q, k, v, axis_name, causal=causal)
+            q, k, v, axis_name, causal=causal, mask=mask)
     if kind == "flash":
         # single-device fused pallas kernel (no mesh axis involved)
         from autodist_tpu.ops.flash_attention import make_flash_attn_fn

@@ -98,7 +98,12 @@ def vocab_parallel_embed(table, ids, axis_name: str = const.MODEL_AXIS,
     emb = embedding_lookup(table, jnp.clip(local_ids, 0, v_local - 1),
                            name=name)
     emb = jnp.where(ok[..., None], emb, 0)
-    return jax.lax.psum(emb, axis_name)
+    out = jax.lax.psum(emb, axis_name)
+    # an id owned by NO rank (out of range / negative) must not silently
+    # embed as zeros while the single-device path NaNs loudly on the same
+    # corrupt input — poison the row so the divergence cannot hide
+    found = jax.lax.psum(ok.astype(out.dtype), axis_name)
+    return jnp.where(found[..., None] > 0, out, jnp.nan)
 
 
 def vocab_parallel_logits(x, table):
@@ -114,7 +119,14 @@ def vocab_parallel_xent(logits, targets,
     via pmax/psum; the target logit is fetched from whichever rank owns it
     (Megatron vocab_parallel_cross_entropy). Returns nll with targets' shape.
     """
+    # out-of-range targets (e.g. a -1 ignore sentinel) CLAMP to a valid
+    # class in both branches — same contract as ops/xent.py. Without
+    # this, the sharded path's target logit was owned by no rank and the
+    # loss silently degraded to the bare lse with a garbage +softmax
+    # gradient, diverging from single-device on the same data.
     if not axis_bound(axis_name):
+        v_total = logits.shape[-1]
+        targets = jnp.clip(targets, 0, v_total - 1)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32))
         return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     # the max offset cancels analytically in softmax, so it carries no
@@ -126,6 +138,8 @@ def vocab_parallel_xent(logits, targets,
     denom = jax.lax.psum(jnp.sum(e, axis=-1), axis_name)
     rank = jax.lax.axis_index(axis_name)
     v_local = logits.shape[-1]
+    v_total = v_local * jax.lax.psum(1, axis_name)
+    targets = jnp.clip(targets, 0, v_total - 1)
     local_t = targets - rank * v_local
     ok = (local_t >= 0) & (local_t < v_local)
     picked = jnp.take_along_axis(

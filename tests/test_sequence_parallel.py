@@ -181,3 +181,57 @@ def test_seq_keys_exempt_non_sequence_leaves():
     with pytest.raises(ValueError, match="not divisible by the 2"):
         runner2.run(batch)
     adt.reset()
+
+
+def test_ring_attention_skips_dead_final_rotation():
+    """The ring issues N-1 K/V rotations, not N: the final block updates
+    without the trailing ppermute pair nothing reads (1/N of the op's
+    communication on an N-way ring)."""
+    from autodist_tpu.kernel.common import op_info
+    q, k, v = _qkv()
+    mesh = _mesh()
+    jaxpr = jax.make_jaxpr(jax.shard_map(
+        lambda a, b, c: ring_attention(a, b, c, "seq"),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3, out_specs=P(None, "seq"),
+        check_vma=False))(q, k, v)
+    perms = [0]
+
+    def walk(jp, mult=1):
+        for eqn in jp.eqns:
+            if eqn.primitive.name == "ppermute":
+                perms[0] += mult
+            m = mult
+            if eqn.primitive.name in ("while", "scan"):
+                # the fori_loop runs axis_size-1 iterations
+                m = mult * 7
+            for sub in op_info.sub_jaxprs(eqn):
+                walk(sub, m)
+    walk(jaxpr.jaxpr)
+    assert perms[0] == 2 * 7, perms  # K+V per rotation, 7 rotations on 8
+
+
+def test_ring_attn_fn_refuses_dense_mask():
+    from autodist_tpu.ops.attention import make_attn_fn
+    q, k, v = _qkv()
+    attn = make_attn_fn("ring")
+    with pytest.raises(ValueError, match="cannot apply a dense mask"):
+        attn(q, k, v, jnp.ones((1, 1, 8, 8), jnp.bool_))
+
+
+def test_ulysses_attn_fn_honors_mask():
+    """The (q, k, v, mask) slot forwards the padding mask to ulysses —
+    silently dropping it would let every token attend PAD positions."""
+    from autodist_tpu.ops.attention import make_attn_fn
+    q, k, v = _qkv()
+    valid = np.ones((B, S), np.int32)
+    valid[:, S - 16:] = 0
+    mask = jnp.asarray(valid, jnp.bool_)[:, None, None, :]
+    ref = reference_attention(q, k, v, mask)
+    mesh = _mesh()
+    out = jax.jit(jax.shard_map(
+        make_attn_fn("ulysses"),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3 + (P(),),
+        out_specs=P(None, "seq"), check_vma=False))(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out[:, :S - 16]),
+                               np.asarray(ref[:, :S - 16]),
+                               atol=2e-5, rtol=2e-5)

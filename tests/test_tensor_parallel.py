@@ -220,3 +220,48 @@ def test_tp_sp_lm_runs():
         last = runner.run(batch)["loss"]
     assert np.isfinite(first) and np.isfinite(last)
     assert last < first
+
+
+def test_vocab_parallel_oov_consistency():
+    """Out-of-range targets CLAMP identically in the sharded and unbound
+    paths of vocab_parallel_xent (previously the sharded loss silently
+    degraded to the bare lse with a garbage gradient on a -1 ignore
+    sentinel); out-of-range ids NaN-poison vocab_parallel_embed rows in
+    the sharded path instead of embedding as silent zeros."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from autodist_tpu.parallel import tensor
+    V, Dm, B = 16, 8, 4
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(B, V).astype(np.float32))
+    targets = jnp.asarray([3, -1, V + 2, 7], jnp.int32)  # two OOV
+    ref = tensor.vocab_parallel_xent(logits, targets)  # unbound (clamped)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("model",))
+    got = jax.jit(jax.shard_map(
+        lambda lg, t: tensor.vocab_parallel_xent(lg, t),
+        mesh=mesh, in_specs=(P(None, "model"), P()), out_specs=P(),
+        check_vma=False))(logits, targets)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+    # gradient parity on the OOV rows too
+    g_ref = jax.grad(lambda lg: jnp.sum(
+        tensor.vocab_parallel_xent(lg, targets)))(logits)
+    g = jax.jit(jax.shard_map(
+        jax.grad(lambda lg, t: jnp.sum(
+            tensor.vocab_parallel_xent(lg, t))),
+        mesh=mesh, in_specs=(P(None, "model"), P()),
+        out_specs=P(None, "model"), check_vma=False))(logits, targets)
+    # raw-primitive convention: the replicated (psum-broadcast) loss
+    # inflates grads by the axis size; the lowering's /N undoes this in
+    # the full stack (see test_pipeline_apply_matches_sequential)
+    np.testing.assert_allclose(np.asarray(g) / 4, np.asarray(g_ref),
+                               rtol=1e-5, atol=1e-6)
+
+    table = jnp.asarray(rng.randn(V, Dm).astype(np.float32))
+    ids = jnp.asarray([[1, 5, V + 3, 2]], jnp.int32)
+    emb = jax.jit(jax.shard_map(
+        lambda tb, i: tensor.vocab_parallel_embed(tb, i),
+        mesh=mesh, in_specs=(P("model"), P()), out_specs=P(),
+        check_vma=False))(table, ids)
+    emb = np.asarray(emb)
+    assert np.all(np.isfinite(emb[0, [0, 1, 3]]))
+    assert np.all(np.isnan(emb[0, 2]))  # poisoned, not silent zeros
