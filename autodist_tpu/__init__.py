@@ -19,6 +19,21 @@ if _ver < _MIN_JAX:
     raise RuntimeError("autodist_tpu requires jax >= %s, found %s"
                        % (".".join(map(str, _MIN_JAX)), _jax.__version__))
 
+if not hasattr(_jax, "shard_map"):
+    # graceful degradation on older JAX: releases before jax 0.6 ship
+    # shard_map under jax.experimental with ``check_vma`` spelled
+    # ``check_rep``. Alias the modern spelling so the framework (and user
+    # code written against it) runs unchanged instead of dying with
+    # AttributeError at the first step compile.
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def _shard_map_compat(f, *args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _legacy_shard_map(f, *args, **kwargs)
+
+    _jax.shard_map = _shard_map_compat
+
 from autodist_tpu import const  # noqa: E402
 from autodist_tpu import patch as _patch  # noqa: E402
 

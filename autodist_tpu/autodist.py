@@ -411,6 +411,8 @@ class AutoDist:
                 return services.setdefault(host, pss.LocalPSService())
         else:
             from autodist_tpu.runtime.coordination import CoordinationClient
+            from autodist_tpu.runtime.resilience import (
+                ResilientCoordinationClient)
             coord_host = (const.ENV.ADT_COORDINATOR_ADDR.val.split(":")[0]
                           or self._resource_spec.chief)
             port = const.ENV.ADT_COORDSVC_PORT.val
@@ -421,9 +423,14 @@ class AutoDist:
                     "async PS requires the native coordination service at "
                     "%s:%d (%s)" % (coord_host, port, e))
 
+            # resilient clients: per-RPC deadlines + reconnect/backoff +
+            # idempotency-token dedup, so a transient service blip or a
+            # dropped connection never double-applies a gradient blob nor
+            # wedges a serving thread forever (runtime/resilience.py;
+            # failure model in docs/failure_model.md)
             def service_for_host(host):
                 return pss.CoordPSService(
-                    lambda: CoordinationClient(coord_host, port),
+                    lambda: ResilientCoordinationClient(coord_host, port),
                     prefix="ps:" + host)
         dstep.ps_store.enable_serving(service_for_host, my_host)
 

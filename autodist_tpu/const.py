@@ -106,6 +106,29 @@ class ENV(Enum):
     # the coordinator when a worker's death triggers two consecutive
     # whole-job restarts; can also be set by hand to decommission a host.
     ADT_ELASTIC_EXCLUDE = ("ADT_ELASTIC_EXCLUDE", str, "")
+    # ---- control-plane resilience knobs (runtime/resilience.py, the
+    # failure model in docs/failure_model.md documents how they compose)
+    # TCP connect timeout for every CoordinationClient (seconds)
+    ADT_CONNECT_TIMEOUT_S = ("ADT_CONNECT_TIMEOUT_S", float, 5.0)
+    # how long CoordinationServer.start() waits for the service to come up
+    ADT_COORDSVC_START_TIMEOUT_S = ("ADT_COORDSVC_START_TIMEOUT_S", float, 5.0)
+    # per-RPC deadline for the resilient client (seconds; 0 = no deadline).
+    # Blocking RPCs (BARRIER / WAITMIN) are exempt — they park server-side
+    # by design and retry across drops on their idempotency token instead.
+    ADT_RPC_TIMEOUT_S = ("ADT_RPC_TIMEOUT_S", float, 30.0)
+    # retry budget: max automatic retries per RPC after a transport error
+    ADT_RPC_RETRIES = ("ADT_RPC_RETRIES", int, 5)
+    # circuit breaker: consecutive transport failures that open the
+    # circuit, and how long it stays open before a half-open probe
+    ADT_BREAKER_FAILURES = ("ADT_BREAKER_FAILURES", int, 8)
+    ADT_BREAKER_COOLDOWN_S = ("ADT_BREAKER_COOLDOWN_S", float, 5.0)
+    # async-PS owner apply loop: how long it keeps trying to reconnect
+    # through a service blip before declaring itself unhealthy (Runner
+    # then fails the job loudly instead of stalling)
+    ADT_PS_OWNER_RETRY_S = ("ADT_PS_OWNER_RETRY_S", float, 60.0)
+    # declarative fault plan for the FaultyProxy harness
+    # (runtime/faultinject.py): JSON, or @/path/to/plan.json
+    ADT_FAULT_PLAN = ("ADT_FAULT_PLAN", str, "")
     # host-PS transfer/compute overlap (parallel/ps.py PSPipeline): 1 =
     # background push + prefetched pull (bit-exact for sync PS; with
     # staleness>=1 or async serving the prefetch overlaps compute fully);

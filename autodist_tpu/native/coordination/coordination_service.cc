@@ -24,9 +24,9 @@
 //   PING                      -> PONG
 //   PUT <key> <value>         -> OK
 //   GET <key>                 -> VAL <value> | NONE
-//   INC <name>                -> VAL <n>              (atomic counter)
-//   BARRIER <name> <n>        -> OK                   (blocks until n arrive)
-//   STEP <worker> <step>      -> OK                   (report progress)
+//   INC <name> [token]        -> VAL <n>              (atomic counter)
+//   BARRIER <name> <n> [token] -> OK                  (blocks until n arrive)
+//   STEP <worker> <step> [token] -> OK                (report progress)
 //   MINSTEP                   -> VAL <min over workers>
 //   WAITMIN <step> <stale>    -> OK                   (blocks until
 //                                                      step <= minstep+stale)
@@ -48,13 +48,28 @@
 //   QLEN <q>                  -> VAL <n>
 //   SHUTDOWN                  -> OK (then exits)
 //
+// Idempotency tokens (round 6): the side-effecting commands INC, STEP,
+// BARRIER, BPUTB and QPUSHB accept an optional trailing <token> argument
+// (any whitespace-free string, client-generated, unique per LOGICAL
+// operation). The service remembers the reply it produced for each token
+// (bounded FIFO cache, kMaxTokens entries) and REPLAYS it for a repeated
+// token without re-applying the command — so a client that retries after
+// an ambiguous connection drop (request possibly applied, reply lost) can
+// never double-apply a gradient blob, double-count a barrier arrival, or
+// double-increment a counter. The dedup state lives in service memory:
+// it survives any number of connection drops but NOT a service restart —
+// consistent, since a restart also loses the counters/queues/blobs the
+// tokens guarded. Read-only and naturally idempotent commands (GET,
+// BGET*, QLEN, MINSTEP, WAITMIN, HEARTBEAT, PUT, GOODBYE) take no token:
+// re-running them is always safe.
+//
 // Binary blob framing (round 4): the b64 text forms above cost +33% wire
 // and an encode/decode pass on every gradient/value blob. The B-suffixed
 // variants carry the payload as RAW bytes, length-prefixed by the header
 // line (the control plane stays newline-delimited text):
-//   BPUTB <key> <ver> <n>\n<n raw bytes>   -> OK
+//   BPUTB <key> <ver> <n> [token]\n<n raw bytes>  -> OK
 //   BGETB <key>               -> BVALB <ver> <n>\n<n raw bytes> | NONE
-//   QPUSHB <q> <n>\n<n raw bytes>          -> OK | ERR queue full
+//   QPUSHB <q> <n> [token]\n<n raw bytes>         -> OK | ERR queue full
 //   QPOPB <q>                 -> QVALB <n>\n<n raw bytes> | NONE
 // Blobs are stored raw either way; text and binary commands interoperate
 // on the same keys/queues (text reads of binary-written blobs b64-encode
@@ -333,6 +348,37 @@ class Server {
     conn.out_off = 0;
   }
 
+  // ---- idempotency-token dedup: replies keyed by client token, bounded
+  //      FIFO eviction (kMaxTokens). Stored replies are the RAW outbuf
+  //      bytes (newline included), so replay is a verbatim append.
+  bool ReplayToken(Conn& conn, const std::string& tok) {
+    if (tok.empty()) return false;
+    auto it = token_replies_.find(tok);
+    if (it == token_replies_.end()) return false;
+    conn.outbuf += it->second;
+    return true;
+  }
+
+  void RememberToken(const std::string& tok, const std::string& raw_reply) {
+    if (tok.empty()) return;
+    if (token_replies_.emplace(tok, raw_reply).second) {
+      token_order_.push_back(tok);
+      if (token_order_.size() > kMaxTokens) {
+        token_replies_.erase(token_order_.front());
+        token_order_.pop_front();
+      }
+    }
+  }
+
+  // execute-and-remember for immediate (non-parked) tokened commands:
+  // the reply bytes the handler appends are captured as the token's
+  // replay record
+  void ReplyTokened(Conn& conn, const std::string& tok,
+                    const std::string& msg) {
+    Reply(conn, msg);
+    RememberToken(tok, msg + "\n");
+  }
+
   void Handle(Conn& conn, const std::string& line) {
     auto parts = Split(line);
     if (parts.empty()) return;
@@ -348,20 +394,41 @@ class Server {
       auto it = kv_.find(parts[1]);
       if (it == kv_.end()) Reply(conn, "NONE");
       else Reply(conn, "VAL " + it->second);
-    } else if (cmd == "INC" && parts.size() == 2) {
+    } else if (cmd == "INC" && (parts.size() == 2 || parts.size() == 3)) {
+      const std::string tok = parts.size() == 3 ? parts[2] : "";
+      if (ReplayToken(conn, tok)) return;
       long v = ++counters_[parts[1]];
-      Reply(conn, "VAL " + std::to_string(v));
-    } else if (cmd == "BARRIER" && parts.size() == 3) {
+      ReplyTokened(conn, tok, "VAL " + std::to_string(v));
+    } else if (cmd == "BARRIER" && (parts.size() == 3 || parts.size() == 4)) {
       const std::string& name = parts[1];
       long want = atol(parts[2].c_str());
-      barrier_waiters_[name].push_back(conn.fd);
+      const std::string tok = parts.size() == 4 ? parts[3] : "";
+      // a token that already fired replays OK immediately — the retried
+      // arrival must NOT wait for peers who already passed the barrier
+      if (ReplayToken(conn, tok)) return;
+      auto& waiters = barrier_waiters_[name];
+      // a retry whose ORIGINAL arrival is still parked (its dead
+      // connection not yet reaped in this poll cycle) must REPLACE it,
+      // not join it — one logical arrival, never two
+      bool replaced = false;
+      if (!tok.empty()) {
+        for (auto& w : waiters) {
+          if (w.second == tok) { w.first = conn.fd; replaced = true; break; }
+        }
+      }
+      if (!replaced) waiters.push_back({conn.fd, tok});
       if (static_cast<long>(barrier_waiters_[name].size()) >= want) {
-        for (int fd : barrier_waiters_[name]) ReplyFd(fd, "OK");
+        for (auto& [fd, wtok] : barrier_waiters_[name]) {
+          ReplyFd(fd, "OK");
+          RememberToken(wtok, "OK\n");
+        }
         barrier_waiters_.erase(name);
       }
-    } else if (cmd == "STEP" && parts.size() == 3) {
+    } else if (cmd == "STEP" && (parts.size() == 3 || parts.size() == 4)) {
+      const std::string tok = parts.size() == 4 ? parts[3] : "";
+      if (ReplayToken(conn, tok)) return;
       steps_[parts[1]] = atol(parts[2].c_str());
-      Reply(conn, "OK");
+      ReplyTokened(conn, tok, "OK");
       WakeStaleWaiters();
     } else if (cmd == "MINSTEP") {
       Reply(conn, "VAL " + std::to_string(MinStep()));
@@ -428,8 +495,9 @@ class Server {
       auto it = queues_.find(parts[1]);
       long n = (it == queues_.end()) ? 0 : static_cast<long>(it->second.size());
       Reply(conn, "VAL " + std::to_string(n));
-    } else if (cmd == "BPUTB" && parts.size() == 4) {
+    } else if (cmd == "BPUTB" && (parts.size() == 4 || parts.size() == 5)) {
       long n = 0;
+      const std::string tok = parts.size() == 5 ? parts[4] : "";
       if (!ParseLen(parts[3], &n) || n < 0) {
         // length unparseable/negative -> the payload boundary is lost
         // (atol would return 0 for "x16" and the real payload would be
@@ -441,21 +509,28 @@ class Server {
         // exactly n bytes so line parsing resumes at the next frame
         Reply(conn, "ERR bad length");
         conn.bin_discard = static_cast<size_t>(n);
+      } else if (ReplayToken(conn, tok)) {
+        // duplicate: replay the recorded reply, but the retried payload
+        // bytes are already in flight and must still be drained
+        conn.bin_discard = static_cast<size_t>(n);
       } else {
-        conn.bin_args = {cmd, parts[1], parts[2]};
+        conn.bin_args = {cmd, parts[1], parts[2], tok};
         conn.bin_need = static_cast<size_t>(n);
         if (conn.bin_need == 0) HandleBinaryPayload(conn, "");
       }
-    } else if (cmd == "QPUSHB" && parts.size() == 3) {
+    } else if (cmd == "QPUSHB" && (parts.size() == 3 || parts.size() == 4)) {
       long n = 0;
+      const std::string tok = parts.size() == 4 ? parts[3] : "";
       if (!ParseLen(parts[2], &n) || n < 0) {
         Reply(conn, "ERR bad length");
         conn.close_requested = true;
       } else if (n > kMaxBlobBytes) {
         Reply(conn, "ERR bad length");
         conn.bin_discard = static_cast<size_t>(n);
+      } else if (ReplayToken(conn, tok)) {
+        conn.bin_discard = static_cast<size_t>(n);
       } else {
-        conn.bin_args = {cmd, parts[1]};
+        conn.bin_args = {cmd, parts[1], tok};
         conn.bin_need = static_cast<size_t>(n);
         if (conn.bin_need == 0) HandleBinaryPayload(conn, "");
       }
@@ -492,14 +567,16 @@ class Server {
     if (args.empty()) return;
     if (args[0] == "BPUTB") {
       blobs_[args[1]] = {atol(args[2].c_str()), std::move(payload)};
-      Reply(conn, "OK");
+      ReplyTokened(conn, args[3], "OK");
     } else if (args[0] == "QPUSHB") {
       auto& q = queues_[args[1]];
       if (q.size() >= kMaxQueueLen) {
-        Reply(conn, "ERR queue full");
+        // remembered too: a retry of a rejected push must replay the
+        // rejection, not sneak a second copy in once the queue drains
+        ReplyTokened(conn, args[2], "ERR queue full");
       } else {
         q.push_back(std::move(payload));
-        Reply(conn, "OK");
+        ReplyTokened(conn, args[2], "OK");
       }
     }
   }
@@ -524,9 +601,15 @@ class Server {
   }
 
   void CloseConn(int fd) {
-    // drop from any barrier/staleness wait lists
-    for (auto& [name, fds] : barrier_waiters_) {
-      fds.erase(std::remove(fds.begin(), fds.end(), fd), fds.end());
+    // drop from any barrier/staleness wait lists: a parked arrival whose
+    // connection died is forgotten, so the client's tokened retry counts
+    // as the (single) arrival
+    for (auto& [name, waiters] : barrier_waiters_) {
+      waiters.erase(std::remove_if(waiters.begin(), waiters.end(),
+                                   [fd](const std::pair<int, std::string>& w) {
+                                     return w.first == fd;
+                                   }),
+                    waiters.end());
     }
     std::vector<Waiter> still;
     for (auto& w : stale_waiters_)
@@ -548,7 +631,14 @@ class Server {
   std::map<std::string, std::pair<long, std::string>> blobs_;
   std::map<std::string, std::deque<std::string>> queues_;
   std::map<std::string, long> counters_;
-  std::map<std::string, std::vector<int>> barrier_waiters_;
+  // idempotency dedup: token -> raw reply bytes, FIFO-evicted. 64k
+  // entries bound the memory; a token older than 64k subsequent tokened
+  // RPCs can no longer be retried — far beyond any client retry window.
+  static constexpr size_t kMaxTokens = 1 << 16;
+  std::map<std::string, std::string> token_replies_;
+  std::deque<std::string> token_order_;
+  std::map<std::string, std::vector<std::pair<int, std::string>>>
+      barrier_waiters_;
   std::vector<Waiter> stale_waiters_;
   std::map<std::string, long> steps_;
   std::map<std::string, double> heartbeats_;

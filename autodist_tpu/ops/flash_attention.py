@@ -45,6 +45,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# renamed TPUCompilerParams -> CompilerParams in jax 0.6; accept both so
+# the kernels compile across the supported version range
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
+
 NEG_INF = -1e30  # finite stand-in for -inf: keeps exp() NaN-free on masked rows
 _LANES = 128     # last-dim tile width; m/l scratch are lane-replicated
 
@@ -196,7 +201,7 @@ def _fwd(q, k, v, segs, causal, block_q, block_k):
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32),
                         pltpu.VMEM((bq, _LANES), jnp.float32),
                         pltpu.VMEM((bq, _LANES), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*operands)
@@ -302,7 +307,7 @@ def _bwd(causal, block_q, block_k, res, do):
     delta = jnp.einsum("bhsd,bhsd->bhs", do.astype(jnp.float32),
                        out.astype(jnp.float32))[..., None]
 
-    params = pltpu.CompilerParams(
+    params = _CompilerParams(
         dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
     q_spec_i = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0),

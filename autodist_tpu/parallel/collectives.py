@@ -193,7 +193,10 @@ def hierarchical_psum(x, ici_axes, dcn_axes):
         return jax.lax.psum(x, dcn_axes)
     n_ici = 1
     for a in ici_axes:
-        n_ici *= jax.lax.axis_size(a)
+        # axis_size only exists on newer jax; psum of the constant 1 is
+        # the classic spelling and folds to the same static size
+        n_ici *= (jax.lax.axis_size(a) if hasattr(jax.lax, "axis_size")
+                  else int(jax.lax.psum(1, a)))
     shape = x.shape
     flat = x.reshape(-1)
     L = flat.shape[0]

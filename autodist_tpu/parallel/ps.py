@@ -253,7 +253,8 @@ class PSStore:
         self._opt: Dict[str, List[Any]] = {}
         self._cpu = jax.local_devices(backend="cpu")[0]
         self.stats = {"pulls": 0, "pushes": 0, "applies": 0,
-                      "bytes_pulled": 0, "bytes_pushed": 0}
+                      "bytes_pulled": 0, "bytes_pushed": 0,
+                      "degraded_pulls": 0}
         self._serve_groups: Optional[Dict[str, dict]] = None
         self._serve_config = None
         self._my_pushes = 0
@@ -428,17 +429,41 @@ class PSStore:
                     blobs = self._local_shard_blobs(grp["pairs"])
                 else:
                     from autodist_tpu.runtime import ps_service as pss
-                    deadline = time.monotonic() + 60.0
-                    res = grp["service"].fetch()
-                    while res is None:  # owner hasn't published yet
-                        if time.monotonic() > deadline:
-                            raise TimeoutError(
-                                "async PS: owner %s never published" % host)
-                        time.sleep(0.002)
+                    res, fetch_err = None, None
+                    try:
+                        deadline = time.monotonic() + 60.0
                         res = grp["service"].fetch()
-                    _version, blob = res
-                    blobs = pss.unpack_arrays(blob)
-                    self.stats["bytes_pulled"] += len(blob)
+                        while res is None:  # owner hasn't published yet
+                            if time.monotonic() > deadline:
+                                break
+                            time.sleep(0.002)
+                            res = grp["service"].fetch()
+                    except OSError as e:
+                        fetch_err = e
+                    if fetch_err is not None:
+                        # transport failure — the degraded-serve window
+                        blobs = self._serve_stale(host, grp, fetch_err)
+                        if blobs is None:
+                            raise RuntimeError(
+                                "async PS: owner %s unreachable and the "
+                                "degraded-serve window is exhausted — "
+                                "aborting instead of training on "
+                                "unboundedly stale values (%s)"
+                                % (host, fetch_err)) from fetch_err
+                    elif res is None:
+                        # service reachable but the owner never published:
+                        # NOT a transport error — stale serving would hide
+                        # a wedged owner behind frozen parameters
+                        raise TimeoutError(
+                            "async PS: owner %s never published" % host)
+                    else:
+                        _version, blob = res
+                        blobs = pss.unpack_arrays(blob)
+                        self.stats["bytes_pulled"] += len(blob)
+                        # keep the last good fetch: the degraded-serve
+                        # fallback for a transient service blip
+                        grp["last_fetch"] = blobs
+                        grp["degraded"] = 0
                 for key, arr in blobs.items():
                     if "!" in key:
                         continue  # opt-state leaf (checkpoint wire)
@@ -447,6 +472,38 @@ class PSStore:
             out = self._assemble(shard_vals)
         self.stats["pulls"] += 1
         return out
+
+    def _degraded_bound(self) -> int:
+        """How many consecutive pulls may serve from the last fetch while
+        the owner is unreachable: the strategy's staleness bound when one
+        is declared, else the async pacing lag (``ADT_PS_MAX_LAG``) —
+        past it the values are staler than anything the strategy ever
+        promised, and the pull must fail instead."""
+        from autodist_tpu import const as _const
+        return max(self.max_staleness(), _const.ENV.ADT_PS_MAX_LAG.val)
+
+    def _serve_stale(self, host: str, grp: dict, err: OSError):
+        """Graceful degradation for a worker that cannot reach an owner:
+        serve the LAST fetched values for up to ``_degraded_bound()``
+        consecutive pulls — a service blip shorter than the window is
+        invisible to training, and the resilient client reconnects on
+        its own schedule. None = window exhausted (caller fails
+        loudly)."""
+        bound = self._degraded_bound()
+        cached = grp.get("last_fetch")
+        used = grp.get("degraded", 0)
+        if cached is None or used >= bound:
+            return None
+        grp["degraded"] = used + 1
+        self.stats["degraded_pulls"] += 1
+        # no service.reconnect() here: the resilient client reconnects
+        # internally, and dropping it would discard its circuit-breaker
+        # state — every degraded pull would re-pay the full retry budget
+        # instead of failing fast into this window
+        logging.warning(
+            "async PS: owner %s unreachable (%s); serving last-fetched "
+            "values (degraded pull %d/%d)", host, err, used + 1, bound)
+        return cached
 
     def _assemble(self, shard_vals: Dict[str, Dict[int, np.ndarray]]
                   ) -> Dict[str, np.ndarray]:
@@ -536,23 +593,48 @@ class PSStore:
                 # what kills the job if the owner is really gone.
                 from autodist_tpu import const as _const
                 max_lag = _const.ENV.ADT_PS_MAX_LAG.val
-                if max_lag > 0:
-                    deadline = time.monotonic() + 60.0
-                    stuck = False
-                    while grp["service"].pending_grads() >= max_lag:
-                        if time.monotonic() > deadline:
-                            logging.warning(
-                                "async PS: owner %s queue stuck at max lag; "
-                                "dropping this push", host)
-                            stuck = True
-                            break
-                        time.sleep(0.001)
-                    if stuck:
-                        self.stats["dropped_pushes"] = (
-                            self.stats.get("dropped_pushes", 0) + 1)
-                        continue
+                try:
+                    if max_lag > 0:
+                        deadline = time.monotonic() + 60.0
+                        stuck = False
+                        while grp["service"].pending_grads() >= max_lag:
+                            if time.monotonic() > deadline:
+                                logging.warning(
+                                    "async PS: owner %s queue stuck at max "
+                                    "lag; dropping this push", host)
+                                stuck = True
+                                break
+                            time.sleep(0.001)
+                        if stuck:
+                            self.stats["dropped_pushes"] = (
+                                self.stats.get("dropped_pushes", 0) + 1)
+                            continue
+                    grp["service"].push_grads(blob)
+                except OSError as e:
+                    # transport blip: a dropped async gradient is legal
+                    # (same semantics as backpressure drops) — but only
+                    # within the degraded window; past it the owner is
+                    # gone for real and the job must fail loudly
+                    used = grp.get("push_failures", 0) + 1
+                    bound = self._degraded_bound()
+                    if used > bound:
+                        raise RuntimeError(
+                            "async PS: pushes to owner %s failed %d "
+                            "consecutive times — aborting instead of "
+                            "silently training without gradient exchange "
+                            "(%s)" % (host, used, e)) from e
+                    grp["push_failures"] = used
+                    self.stats["dropped_pushes"] = (
+                        self.stats.get("dropped_pushes", 0) + 1)
+                    # no reconnect() kick: see _serve_stale — it would
+                    # reset the resilient client's circuit breaker
+                    logging.warning(
+                        "async PS: push to owner %s failed (%s); dropped "
+                        "this gradient (consecutive failure %d/%d)",
+                        host, e, used, bound)
+                    continue
+                grp["push_failures"] = 0
                 self.stats["bytes_pushed"] += len(blob)
-                grp["service"].push_grads(blob)
             self._my_pushes += 1
         self.stats["pushes"] += 1
 
@@ -728,6 +810,22 @@ class PSStore:
     @property
     def serving(self) -> bool:
         return self._serve_groups is not None
+
+    def owner_health_errors(self) -> List[Tuple[str, str]]:
+        """(host, error) for every owner apply loop of THIS process that
+        is dead or past its reconnect budget. Non-empty means gradients
+        pushed to those groups are never applied again — the Runner
+        checks this every step and fails the job loudly (the silent-stall
+        alternative is the one forbidden outcome)."""
+        out: List[Tuple[str, str]] = []
+        if self._serve_groups is None:
+            return out
+        for host, grp in self._serve_groups.items():
+            w = grp["worker"]
+            if w is not None and not w.healthy:
+                out.append((host, str(w.last_error or
+                                      "apply thread died unexpectedly")))
+        return out
 
     def applied_total(self) -> int:
         """Gradient blobs applied by this process's owner loops."""

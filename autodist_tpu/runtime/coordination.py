@@ -49,7 +49,11 @@ class CoordinationServer:
         self.port = port
         self._proc: Optional[subprocess.Popen] = None
 
-    def start(self, wait: float = 5.0):
+    def start(self, wait: Optional[float] = None):
+        """Launch the service and wait up to ``wait`` seconds for it to
+        answer a ping (default: ``ADT_COORDSVC_START_TIMEOUT_S``, 5s)."""
+        if wait is None:
+            wait = const.ENV.ADT_COORDSVC_START_TIMEOUT_S.val
         binary = build_binary()
         # detach stdio: the service must not hold the parent's pipes open
         # (a captured-output parent would block on EOF after the chief's
@@ -69,15 +73,32 @@ class CoordinationServer:
                         "coordination service exited with %s (port %d busy?)"
                         % (self._proc.returncode, self.port))
                 time.sleep(0.05)
-        raise TimeoutError("coordination service did not come up")
+        # don't leak a process that exists but never answered (wait() so
+        # the SIGKILLed child is reaped, not left a zombie)
+        self._proc.kill()
+        try:
+            self._proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+        self._proc = None
+        raise TimeoutError("coordination service did not come up within "
+                           "%.1fs (ADT_COORDSVC_START_TIMEOUT_S)" % wait)
 
     def stop(self):
         if self._proc and self._proc.poll() is None:
             try:
-                CoordinationClient("127.0.0.1", self.port).shutdown()
+                # finite deadline on BOTH the connect and the reply: a
+                # wedged service (accepting but not answering) must fall
+                # through to the kill below, not hang stop() forever
+                CoordinationClient("127.0.0.1", self.port,
+                                   timeout=2.0, connect_timeout=2.0).shutdown()
                 self._proc.wait(timeout=2)
             except (OSError, subprocess.TimeoutExpired):
                 self._proc.kill()
+                try:
+                    self._proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass  # unreapable (D-state); teardown must not raise
         self._proc = None
 
     def __enter__(self):
@@ -90,10 +111,21 @@ class CoordinationServer:
 class CoordinationClient:
     def __init__(self, host: str = "127.0.0.1",
                  port: int = const.DEFAULT_COORDINATOR_PORT,
-                 timeout: Optional[float] = None):
-        self._sock = socket.create_connection((host, port), timeout=5)
+                 timeout: Optional[float] = None,
+                 connect_timeout: Optional[float] = None):
+        if connect_timeout is None:
+            connect_timeout = const.ENV.ADT_CONNECT_TIMEOUT_S.val
+        self._sock = socket.create_connection((host, port),
+                                              timeout=connect_timeout)
         self._sock.settimeout(timeout)
         self._buf = b""
+
+    def set_rpc_timeout(self, timeout: Optional[float]):
+        """Per-RPC deadline for subsequent calls (None = block forever).
+        A call that exceeds it raises ``socket.timeout`` (an OSError) with
+        the connection in an indeterminate state — callers must reconnect,
+        which is exactly what the resilient wrapper does."""
+        self._sock.settimeout(timeout)
 
     def _recv_line(self) -> str:
         while b"\n" not in self._buf:
@@ -168,15 +200,32 @@ class CoordinationClient:
         resp = self._cmd("GET %s" % self._token(key))
         return None if resp == "NONE" else resp[4:]
 
-    def incr(self, name: str) -> int:
-        return int(self._cmd("INC %s" % self._token(name))[4:])
+    @staticmethod
+    def _tok_suffix(token) -> str:
+        """Optional idempotency token: a whitespace-free id the service
+        dedups replies on (see coordination_service.cc 'Idempotency
+        tokens'). A RETRY of the same logical op must reuse the token."""
+        if token is None:
+            return ""
+        if not token or any(c.isspace() for c in token):
+            raise ValueError("idempotency token %r must be non-empty with "
+                             "no whitespace" % (token,))
+        return " " + token
 
-    def barrier(self, name: str, num_workers: int):
+    def incr(self, name: str, token: Optional[str] = None) -> int:
+        return int(self._cmd("INC %s%s" % (self._token(name),
+                                           self._tok_suffix(token)))[4:])
+
+    def barrier(self, name: str, num_workers: int,
+                token: Optional[str] = None):
         """Block until ``num_workers`` processes reach this barrier."""
-        self._cmd_ok("BARRIER %s %d" % (self._token(name), num_workers))
+        self._cmd_ok("BARRIER %s %d%s" % (self._token(name), num_workers,
+                                          self._tok_suffix(token)))
 
-    def report_step(self, worker: str, step: int):
-        self._cmd_ok("STEP %s %d" % (self._token(worker), step))
+    def report_step(self, worker: str, step: int,
+                    token: Optional[str] = None):
+        self._cmd_ok("STEP %s %d%s" % (self._token(worker), step,
+                                       self._tok_suffix(token)))
 
     def min_step(self) -> int:
         return int(self._cmd("MINSTEP")[4:])
@@ -197,10 +246,12 @@ class CoordinationClient:
     # ---- versioned blobs + FIFO queues (the async-PS wire; payloads are
     #      raw bytes, base64'd on the line protocol)
 
-    def bput(self, key: str, version: int, payload: bytes):
+    def bput(self, key: str, version: int, payload: bytes,
+             token: Optional[str] = None):
         """Publish a versioned blob (binary frame — raw bytes on the wire)."""
-        resp = self._cmd_raw("BPUTB %s %d %d"
-                             % (self._token(key), version, len(payload)),
+        resp = self._cmd_raw("BPUTB %s %d %d%s"
+                             % (self._token(key), version, len(payload),
+                                self._tok_suffix(token)),
                              payload)
         if resp != "OK":
             raise RuntimeError("bput rejected: %s" % resp)
@@ -213,11 +264,13 @@ class CoordinationClient:
         _, ver, n = resp.split(" ", 2)
         return int(ver), self._recv_raw(int(n))
 
-    def qpush(self, queue: str, payload: bytes):
+    def qpush(self, queue: str, payload: bytes,
+              token: Optional[str] = None):
         """Enqueue a blob (binary frame); raises when the service's queue
         cap rejects it (dead-owner backpressure)."""
-        resp = self._cmd_raw("QPUSHB %s %d"
-                             % (self._token(queue), len(payload)), payload)
+        resp = self._cmd_raw("QPUSHB %s %d%s"
+                             % (self._token(queue), len(payload),
+                                self._tok_suffix(token)), payload)
         if resp != "OK":
             raise RuntimeError("qpush rejected: %s" % resp)
 

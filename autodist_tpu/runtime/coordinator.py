@@ -112,19 +112,50 @@ class Coordinator:
         for ``heartbeat_timeout`` seconds fails the job fast."""
         from autodist_tpu.runtime.coordination import CoordinationClient
 
+        def connect_with_backoff():
+            """(Re)establish the watchdog's client, retrying with capped
+            backoff until connected or the job stops. The one-shot client
+            this replaces meant a single service blip permanently
+            disabled heartbeat supervision — silently."""
+            delay = 0.5
+            while not self._stop_watchdog.is_set():
+                try:
+                    # finite RPC deadline: a hung (not just dead) service
+                    # must surface as a timeout, not park the watchdog
+                    return CoordinationClient(
+                        "127.0.0.1", self._coordsvc_port,
+                        timeout=max(5.0, self._heartbeat_timeout / 2))
+                except OSError as e:
+                    logging.warning(
+                        "watchdog: coordination service unreachable on "
+                        "port %d (%s) — heartbeat supervision DEGRADED; "
+                        "retrying in %.1fs", self._coordsvc_port, e, delay)
+                    if self._stop_watchdog.wait(delay):
+                        return None
+                    delay = min(delay * 2, self._heartbeat_timeout / 2)
+            return None
+
         def watch():
-            try:
-                client = CoordinationClient("127.0.0.1", self._coordsvc_port)
-            except OSError as e:
-                logging.warning("watchdog: coordination service unreachable "
-                                "on port %d (%s) — heartbeat supervision "
-                                "disabled", self._coordsvc_port, e)
+            client = connect_with_backoff()
+            if client is None:
                 return
             while not self._stop_watchdog.wait(self._heartbeat_timeout / 4):
                 try:
                     dead = client.dead_workers(self._heartbeat_timeout)
-                except OSError:
-                    return
+                except OSError as e:
+                    logging.warning(
+                        "watchdog: lost the coordination service (%s) — "
+                        "supervision degraded until reconnect", e)
+                    try:
+                        client.close()
+                    except OSError:
+                        pass
+                    client = connect_with_backoff()
+                    if client is None:
+                        return
+                    logging.info("watchdog: coordination service client "
+                                 "re-established; supervision resumed")
+                    continue
                 # elastic-aware: a worker with restart budget left may be
                 # mid-relaunch (import + trace + compile easily exceeds the
                 # heartbeat window) — skip anything inside a fresh

@@ -114,6 +114,11 @@ class PSServiceBase:
     def pending_grads(self) -> int:
         raise NotImplementedError
 
+    def reconnect(self) -> None:
+        """Drop this thread's transport so the next call re-establishes it
+        (no-op for in-process services). Called by the owner apply loop
+        after a transport error."""
+
     def close(self) -> None:
         pass
 
@@ -212,6 +217,27 @@ class CoordPSService(PSServiceBase):
     def pending_grads(self):
         return self._client().qlen(self._prefix + "/grads")
 
+    def reconnect(self):
+        """Refresh the CALLING thread's transport after a service blip.
+        A resilient client is asked to drop only its SOCKET (its circuit
+        breaker and retry accounting survive — recreating the wrapper
+        would re-pay the full retry budget on every probe); a raw client
+        is discarded so the next call builds a fresh connection."""
+        client = getattr(self._local, "client", None)
+        if client is None:
+            return
+        if hasattr(client, "reconnect"):
+            client.reconnect()
+            return
+        del self._local.client
+        with self._clients_lock:
+            if client in self._clients:
+                self._clients.remove(client)
+        try:
+            client.close()
+        except OSError:
+            pass
+
 
 class AsyncPSWorker:
     """The owner-side apply loop: drain gradient blobs, apply each through
@@ -223,7 +249,8 @@ class AsyncPSWorker:
 
     def __init__(self, service: PSServiceBase, apply_fn: Callable,
                  values_fn: Callable, poll_s: float = 0.002,
-                 opt_fn: Optional[Callable] = None):
+                 opt_fn: Optional[Callable] = None,
+                 reconnect_budget_s: Optional[float] = None):
         self._apply_fn = apply_fn
         self._values_fn = values_fn
         self._opt_fn = opt_fn
@@ -233,6 +260,16 @@ class AsyncPSWorker:
         self._pause = threading.Event()
         self._applied = 0
         self._busy = False  # a blob is popped but not yet applied
+        # transport resilience: a service blip must not kill this thread —
+        # it reconnects with backoff for up to reconnect_budget_s, then
+        # declares itself UNHEALTHY (Runner fails the job loudly; silent
+        # stall is the one forbidden outcome)
+        if reconnect_budget_s is None:
+            from autodist_tpu import const
+            reconnect_budget_s = const.ENV.ADT_PS_OWNER_RETRY_S.val
+        self._reconnect_budget_s = reconnect_budget_s
+        self._last_error: Optional[BaseException] = None
+        self._failed = False
         self._thread = threading.Thread(target=self._loop,
                                         name="adt-ps-apply", daemon=True)
 
@@ -259,7 +296,16 @@ class AsyncPSWorker:
                 self._busy = False
                 time.sleep(self._poll_s)
                 continue
-            blob = self._service.pop_grads()
+            try:
+                blob = self._service.pop_grads()
+            except OSError as e:
+                # transport error OUTSIDE the apply guard used to kill
+                # this daemon thread silently and stall training forever;
+                # now it degrades to reconnect-with-backoff
+                self._busy = False
+                if not self._recover(e, "pop_grads"):
+                    return
+                continue
             if blob is None:
                 self._busy = False
                 time.sleep(self._poll_s)
@@ -268,14 +314,76 @@ class AsyncPSWorker:
                 self._apply_fn(unpack_arrays(blob))
                 self._applied += 1
                 self._publish(self._applied)
+            except OSError as e:
+                # the gradient IS applied locally; only the republish hit
+                # the wire — reconnect and republish from the last applied
+                # version (workers meanwhile serve their last fetch).
+                # busy drops BEFORE the (potentially long) recovery:
+                # nothing is in flight, and pause()/drain() must not
+                # spuriously time out while a blip is being ridden out
+                self._busy = False
+                if not self._recover(e, "publish"):
+                    return
             except Exception as e:  # noqa: BLE001 — a poisoned blob must not kill the loop
                 logging.error("async PS apply failed: %s", e)
             finally:
                 self._busy = False
 
+    def _recover(self, err: OSError, where: str) -> bool:
+        """Reconnect after a transport error, republishing the CURRENT
+        state (version = last applied) so workers resume from where the
+        owner actually is — a restarted service starts blob-less, and
+        without the republish every pull would wait on a publish that
+        never comes. Returns False (loop exits, ``healthy`` turns False)
+        once the retry budget is exhausted."""
+        self._last_error = err
+        logging.warning("async PS owner loop: transport error in %s (%s); "
+                        "reconnecting for up to %.0fs", where, err,
+                        self._reconnect_budget_s)
+        deadline = time.monotonic() + self._reconnect_budget_s
+        delay = 0.05
+        while not self._stop.is_set():
+            if time.monotonic() > deadline:
+                self._failed = True
+                logging.error(
+                    "async PS owner loop DEAD: could not reach the "
+                    "parameter service for %.0fs (last error: %s) — "
+                    "training cannot make progress",
+                    self._reconnect_budget_s, self._last_error)
+                return False
+            time.sleep(delay)
+            delay = min(1.0, delay * 2)
+            try:
+                self._service.reconnect()
+                self._publish(self._applied)
+                logging.info("async PS owner loop: reconnected after %s "
+                             "blip; republished version %d", where,
+                             self._applied)
+                self._last_error = None
+                return True
+            except OSError as e:
+                self._last_error = e
+        return False  # stopping: not a failure
+
     @property
     def applied(self) -> int:
         return self._applied
+
+    @property
+    def healthy(self) -> bool:
+        """False once the apply loop is dead or past its reconnect budget
+        — the owner can no longer apply gradients and the job must fail
+        loudly instead of stalling."""
+        if self._failed:
+            return False
+        if (self._thread.ident is not None and not self._thread.is_alive()
+                and not self._stop.is_set()):
+            return False  # thread died unexpectedly (bug / unhandled exc)
+        return True
+
+    @property
+    def last_error(self) -> Optional[BaseException]:
+        return self._last_error
 
     def publish_now(self):
         """Republish current values out of band (checkpoint restore) —

@@ -85,16 +85,26 @@ class Runner:
 
     def _connect_coordination(self, purpose: str = "staleness pacing"):
         from autodist_tpu.runtime.coordination import CoordinationClient
+        from autodist_tpu.runtime.resilience import (
+            ResilientCoordinationClient)
         host = (const.ENV.ADT_COORDINATOR_ADDR.val.split(":")[0]
                 or "127.0.0.1")
+        port = const.ENV.ADT_COORDSVC_PORT.val
         try:
-            client = CoordinationClient(host, const.ENV.ADT_COORDSVC_PORT.val)
-            logging.info("%s active via %s", purpose, host)
-            return client
+            # one raw connect as the reachability probe (the resilient
+            # client connects lazily and would retry with backoff — too
+            # slow a way to learn the service simply is not deployed)
+            CoordinationClient(host, port).close()
         except OSError as e:
             logging.warning("coordination service unreachable (%s); "
                             "%s disabled", e, purpose)
             return None
+        # steady state rides the resilient client: per-RPC deadlines,
+        # reconnect with backoff, idempotent STEP/BARRIER retry — a
+        # service blip mid-run degrades to a retried RPC instead of
+        # killing pacing/heartbeats with the connection
+        logging.info("%s active via %s", purpose, host)
+        return ResilientCoordinationClient(host, port)
 
     @property
     def distributed_step(self):
@@ -163,6 +173,7 @@ class Runner:
             jax.profiler.start_trace(os.path.join(
                 const.DEFAULT_TRACE_DIR, time.strftime("%Y%m%d-%H%M%S")))
             self._trace_started = True
+        self._check_ps_owner_health()
         # donate only the Runner-owned state; an explicitly-passed state is a
         # caller reference that must stay valid
         new_state, metrics = self._dstep(st, sharded_batch, donate=state is None)
@@ -227,6 +238,23 @@ class Runner:
                               / self._total_step_s), 4)
                 if self._total_step_s > 0 else None)
         return out
+
+    def _check_ps_owner_health(self):
+        """Fail LOUDLY when an async-PS owner apply loop of this process
+        is dead (transport budget exhausted / thread crashed). Before
+        this check the failure mode was a silent stall: the daemon thread
+        died, queues backed up, and training "ran" forever applying
+        nothing. Checked every step — it is two attribute reads when
+        healthy."""
+        store = getattr(self._dstep, "ps_store", None)
+        if store is None or not getattr(store, "serving", False):
+            return
+        bad = store.owner_health_errors()
+        if bad:
+            raise RuntimeError(
+                "async PS owner apply loop(s) dead — training cannot "
+                "apply gradients: %s"
+                % "; ".join("%s: %s" % (h, e) for h, e in bad))
 
     def _maybe_heartbeat(self):
         """Time-based liveness beat for async multi-process jobs. A failed

@@ -48,6 +48,7 @@ def test_model_trains(name, setup, builder):
     autodist_tpu.reset()
 
 
+@pytest.mark.slow  # pallas interpret mode: ~30s on CPU; nightly runs it
 def test_lm_flash_attention_mode_matches_default():
     """attention="flash" (interpreted on CPU) must train and agree with the
     XLA path — the kernel is numerics-preserving, not an approximation."""
@@ -89,6 +90,7 @@ def test_registry():
         make_train_setup("nope")
 
 
+@pytest.mark.slow  # pallas interpret mode: ~30s on CPU; nightly runs it
 def test_bert_flash_attention_matches_xla():
     """BERT with the flash kernel (padding mask as segment ids) computes
     the same loss and grads as the XLA attention path on real-token
