@@ -1,0 +1,39 @@
+"""Pre-compile strategy verifier — static analysis with typed diagnostics.
+
+Public surface:
+
+- :func:`verify` — ``(Strategy, ModelItem, ResourceSpec) ->
+  list[Diagnostic]``: the pure plan-level pass (``rules.py``);
+- :func:`lint_lowered_text` / :func:`lint_runner` — the second pass over
+  the lowered jaxpr/StableHLO program (``lowered.py``);
+- :class:`Diagnostic` / :class:`Severity` / :class:`DiagnosticError` /
+  :class:`StrategyVerificationError` — the typed diagnostics framework
+  (``diagnostics.py``);
+- ``python -m autodist_tpu.analysis`` — the plan linter CLI (``cli.py``).
+
+Exports resolve lazily (PEP 562): ``strategy/base.py`` imports the leaf
+``analysis.partition`` module for partitioner parsing, and an eager
+``from .rules import verify`` here would close an import cycle back
+through ``strategy.base``.
+"""
+
+__all__ = ["verify", "lint_lowered_text", "lint_runner", "Diagnostic",
+           "Severity", "DiagnosticError", "StrategyVerificationError",
+           "format_table", "sort_diagnostics", "has_errors", "CODES"]
+
+_DIAG_NAMES = {"Diagnostic", "Severity", "DiagnosticError",
+               "StrategyVerificationError", "format_table",
+               "sort_diagnostics", "has_errors", "CODES"}
+
+
+def __getattr__(name):
+    if name == "verify":
+        from autodist_tpu.analysis.rules import verify
+        return verify
+    if name in ("lint_lowered_text", "lint_runner"):
+        from autodist_tpu.analysis import lowered
+        return getattr(lowered, name)
+    if name in _DIAG_NAMES:
+        from autodist_tpu.analysis import diagnostics
+        return getattr(diagnostics, name)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

@@ -1,0 +1,7 @@
+"""Entry point for ``python -m autodist_tpu.analysis``."""
+import sys
+
+from autodist_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

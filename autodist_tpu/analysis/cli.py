@@ -1,0 +1,264 @@
+"""Plan linter CLI: ``python -m autodist_tpu.analysis <example> --strategy <name>``.
+
+Builds (or loads) a Strategy for one of the bundled examples, runs the
+static verifier against the example's ModelItem and a resource spec, and
+prints the diagnostic table. Exit codes: 0 = no errors (warnings/info
+allowed), 1 = at least one ``ADT`` error, 2 = usage/build failure.
+
+Used by CI to gate every example x strategy combination, and by hand to
+answer "will this plan compile?" without compiling:
+
+    python -m autodist_tpu.analysis linear_regression --strategy PS
+    python -m autodist_tpu.analysis lm1b --strategy Parallax --json
+    python -m autodist_tpu.analysis tp_lm --strategy TensorParallel
+    python -m autodist_tpu.analysis lm1b --strategy-json plan.json
+"""
+import argparse
+import json
+import sys
+from typing import Callable, Dict, Optional, Tuple
+
+# (loss_fn, params, example_batch, mp_rules-or-None) factories. Tiny
+# configurations of the same models the example scripts train — the lint
+# needs shapes and sparsity, not realistic capacity.
+ExampleSetup = Tuple[Callable, object, object, Optional[list]]
+
+
+def _ex_linear_regression() -> ExampleSetup:
+    import jax.numpy as jnp
+
+    params = {"W": jnp.zeros(()), "b": jnp.zeros(())}
+
+    def loss_fn(p, batch):
+        pred = batch["x"] * p["W"] + p["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    batch = {"x": jnp.zeros((64,), jnp.float32),
+             "y": jnp.zeros((64,), jnp.float32)}
+    return loss_fn, params, batch, None
+
+
+def _ex_sentiment_classifier() -> ExampleSetup:
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(0)
+    params = {
+        "embedding": jax.random.normal(key, (512, 16)) * 0.05,
+        "dense": {"kernel": jax.random.normal(key, (16, 1)) * 0.1,
+                  "bias": jnp.zeros((1,))},
+    }
+
+    def loss_fn(p, batch):
+        emb = jnp.take(p["embedding"], batch["tokens"], axis=0)  # gather
+        pooled = jnp.mean(emb, axis=1)
+        logits = (pooled @ p["dense"]["kernel"] + p["dense"]["bias"])[..., 0]
+        labels = batch["label"].astype(jnp.float32)
+        return jnp.mean(jnp.maximum(logits, 0) - logits * labels
+                        + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+    batch = {"tokens": jnp.zeros((8, 16), jnp.int32),
+             "label": jnp.zeros((8,), jnp.int32)}
+    return loss_fn, params, batch, None
+
+
+def _ex_image_classifier() -> ExampleSetup:
+    from autodist_tpu.models import resnet
+    loss_fn, params, batch, _ = resnet.make_train_setup(
+        resnet.ResNetTiny, num_classes=10, image_size=32, batch_size=8)
+    return loss_fn, params, batch, None
+
+
+def _ex_lm1b() -> ExampleSetup:
+    from autodist_tpu.models import lm
+    cfg = lm.LMConfig(vocab_size=256, d_model=32, num_layers=2,
+                      num_heads=4, mlp_dim=64, max_seq_len=32)
+    loss_fn, params, batch, _ = lm.make_train_setup(cfg, seq_len=16,
+                                                    batch_size=4)
+    return loss_fn, params, batch, None
+
+
+def _ex_tp_lm() -> ExampleSetup:
+    from autodist_tpu.models import tp_lm
+    cfg = tp_lm.TPLMConfig(vocab_size=256, d_model=32, num_layers=2,
+                           num_heads=4, mlp_dim=64, max_seq_len=32)
+    loss_fn, params, batch, _ = tp_lm.make_train_setup(cfg, seq_len=16,
+                                                       batch_size=4)
+    return loss_fn, params, batch, tp_lm.tp_rules()
+
+
+def _ex_pipe_lm() -> ExampleSetup:
+    from autodist_tpu.models import pipe_lm
+    cfg = pipe_lm.TPLMConfig(vocab_size=256, d_model=32, num_layers=2,
+                             num_heads=4, mlp_dim=64, max_seq_len=32)
+    loss_fn, params, batch, _ = pipe_lm.make_train_setup(
+        cfg, seq_len=16, batch_size=4, n_microbatches=2)
+    return loss_fn, params, batch, pipe_lm.pp_rules()
+
+
+def _ex_moe_lm() -> ExampleSetup:
+    from autodist_tpu.models import moe_lm
+    cfg = moe_lm.MoEConfig(vocab_size=256, d_model=32, num_layers=2,
+                           num_heads=4, expert_dim=64, max_seq_len=32,
+                           num_experts=2)
+    loss_fn, params, batch, _ = moe_lm.make_train_setup(cfg, seq_len=16,
+                                                        batch_size=4)
+    return loss_fn, params, batch, moe_lm.ep_rules()
+
+
+EXAMPLES: Dict[str, Callable[[], ExampleSetup]] = {
+    "linear_regression": _ex_linear_regression,
+    "sentiment_classifier": _ex_sentiment_classifier,
+    "image_classifier": _ex_image_classifier,
+    "lm1b": _ex_lm1b,
+    "tp_lm": _ex_tp_lm,
+    "pipe_lm": _ex_pipe_lm,
+    "moe_lm": _ex_moe_lm,
+}
+
+
+def _builders(mp_rules):
+    """Strategy-name -> builder factory. Model-parallel builders need the
+    example's mp_rules and are only offered when the example has them."""
+    from autodist_tpu import strategy as S
+    out = {
+        "PS": lambda: S.PS(),
+        "PSLoadBalancing": lambda: S.PSLoadBalancing(),
+        "PartitionedPS": lambda: S.PartitionedPS(),
+        "UnevenPartitionedPS": lambda: S.UnevenPartitionedPS(),
+        "AllReduce": lambda: S.AllReduce(),
+        "PartitionedAR": lambda: S.PartitionedAR(),
+        "RandomAxisPartitionAR": lambda: S.RandomAxisPartitionAR(),
+        "Parallax": lambda: S.Parallax(),
+        "SequenceParallelAR": lambda: S.SequenceParallelAR(seq_shards=2),
+        "WithRemat": lambda: S.WithRemat(S.AllReduce(), policy="dots"),
+        "AutoStrategy": lambda: S.AutoStrategy(),
+    }
+    if mp_rules:
+        out["TensorParallel"] = lambda: S.TensorParallel(
+            tp_shards=2, mp_rules=mp_rules)
+        out["PipelineParallel"] = lambda: S.PipelineParallel(
+            pp_shards=2, mp_rules=mp_rules, n_microbatches=2)
+        out["ExpertParallel"] = lambda: S.ExpertParallel(
+            ep_shards=2, mp_rules=mp_rules)
+    return out
+
+
+def default_spec(num_devices: int = 4):
+    """Synthetic single-node 2x2 slice — the lint-time stand-in topology
+    (verification is static; no accelerator is touched)."""
+    from autodist_tpu.resource_spec import ResourceSpec
+    return ResourceSpec.from_dict(
+        {"nodes": [{"address": "127.0.0.1", "chief": True,
+                    "tpus": num_devices}]})
+
+
+def _report(args, label, diags, spec) -> int:
+    """Print the diagnostics (table or JSON); returns the error count."""
+    from autodist_tpu.analysis.diagnostics import (Severity, format_table,
+                                                   sort_diagnostics)
+    n_errors = sum(1 for d in diags if d.severity >= Severity.ERROR)
+    if args.json:
+        print(json.dumps({
+            "example": args.example, "strategy": label,
+            "errors": n_errors,
+            "diagnostics": [d.to_dict() for d in sort_diagnostics(diags)],
+        }, indent=1, sort_keys=True))
+    elif diags or not args.quiet:
+        print("%s x %s on %d devices:"
+              % (args.example, label, len(spec.devices)))
+        print(format_table(diags))
+    return n_errors
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m autodist_tpu.analysis",
+        description="Static pre-compile strategy verifier (plan linter). "
+                    "Exit 0 = clean, 1 = ADT errors, 2 = usage/build "
+                    "failure.")
+    p.add_argument("example", nargs="?",
+                   help="bundled example: %s" % ", ".join(sorted(EXAMPLES)))
+    p.add_argument("--strategy", default="AllReduce",
+                   help="strategy builder name (see --list)")
+    p.add_argument("--strategy-json", default=None, metavar="FILE",
+                   help="lint a serialized Strategy JSON file instead of "
+                        "building one")
+    p.add_argument("--spec", default=None, metavar="YAML",
+                   help="resource spec yaml (default: synthetic 4-chip "
+                        "single node)")
+    p.add_argument("--devices", type=int, default=4,
+                   help="device count of the synthetic spec (default 4)")
+    p.add_argument("--json", action="store_true",
+                   help="emit diagnostics as JSON instead of a table")
+    p.add_argument("--quiet", action="store_true",
+                   help="print nothing on a clean plan")
+    p.add_argument("--list", action="store_true",
+                   help="list examples and strategies, then exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list:
+        print("examples:   " + " ".join(sorted(EXAMPLES)))
+        print("strategies: " + " ".join(sorted(_builders([""]))))
+        return 0
+    if not args.example:
+        print("error: an example name is required (see --list)",
+              file=sys.stderr)
+        return 2
+    if args.example not in EXAMPLES:
+        print("error: unknown example %r (have %s)"
+              % (args.example, ", ".join(sorted(EXAMPLES))), file=sys.stderr)
+        return 2
+
+    from autodist_tpu.analysis.rules import verify
+    from autodist_tpu.model_item import ModelItem
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.strategy.base import Strategy
+
+    try:
+        loss_fn, params, batch, mp_rules = EXAMPLES[args.example]()
+        item = ModelItem(loss_fn=loss_fn, params=params,
+                         example_batch=batch).prepare()
+    except Exception as e:  # noqa: BLE001 — build failures are exit 2
+        print("error: example %r failed to build: %s: %s"
+              % (args.example, type(e).__name__, e), file=sys.stderr)
+        return 2
+
+    spec = (ResourceSpec(args.spec) if args.spec
+            else default_spec(args.devices))
+
+    if args.strategy_json:
+        from autodist_tpu.analysis.diagnostics import DiagnosticError
+        try:
+            strategy = Strategy.deserialize(path=args.strategy_json)
+        except DiagnosticError as e:
+            # a defect the DESERIALIZER itself detects (e.g. ADT301
+            # unknown synchronizer kind) is still an ADT finding, not a
+            # tooling failure — report it through the normal output path
+            _report(args, args.strategy_json, [e.diagnostic], spec)
+            return 1
+        except Exception as e:  # noqa: BLE001
+            print("error: cannot load strategy from %s: %s"
+                  % (args.strategy_json, e), file=sys.stderr)
+            return 2
+        label = args.strategy_json
+    else:
+        builders = _builders(mp_rules)
+        if args.strategy not in builders:
+            print("error: unknown strategy %r for example %r (have %s)"
+                  % (args.strategy, args.example,
+                     ", ".join(sorted(builders))), file=sys.stderr)
+            return 2
+        try:
+            strategy = builders[args.strategy]().build(item, spec)
+        except Exception as e:  # noqa: BLE001
+            print("error: builder %s failed: %s: %s"
+                  % (args.strategy, type(e).__name__, e), file=sys.stderr)
+            return 2
+        label = args.strategy
+
+    diags = verify(strategy, item, spec)
+    return 1 if _report(args, label, diags, spec) else 0

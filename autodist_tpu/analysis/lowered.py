@@ -1,0 +1,151 @@
+"""Lint the *lowered* program (jaxpr / StableHLO text).
+
+The plan-level rules (``rules.py``) prove the Strategy well-formed; this
+second pass inspects what the lowering actually emitted — via
+``Runner.lowered_text()`` (StableHLO from ``jax.jit(...).lower()``) or a
+jaxpr pretty-print — for hazards no plan-level rule can see:
+
+- ``ADT405``: an all-gather materializing the FULL value of a
+  model-parallel (``mp_axes``) parameter. ZeRO-partitioned storage
+  all-gathers by design; model-parallel compute must consume the local
+  shard, so a full-shape gather means a sharding rule failed to
+  propagate and the "parallel" run pays replicated bandwidth.
+- ``ADT406``: host transfers on the hot path (infeed/outfeed,
+  host memory-space annotations, send/recv custom calls) — each one
+  serializes the step on PCIe.
+- ``ADT407``: collectives under divergent control flow
+  (``stablehlo.if``/``case`` branches, jaxpr ``cond``): if the predicate
+  ever differs across replicas, the collective deadlocks — the
+  mis-sharded-collective hang this framework's fault harness exists to
+  catch at runtime, surfaced at lint time instead.
+
+Text-based on purpose: it works on any ``as_text()`` dump (including ones
+saved from a real TPU run) without re-lowering, and it has no opinion
+about which JAX version produced the text.
+"""
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from autodist_tpu.analysis.diagnostics import (Diagnostic, sort_diagnostics,
+                                               warning)
+
+# StableHLO / MHLO / jaxpr spellings of cross-replica collectives.
+COLLECTIVE_TOKENS = (
+    "all_gather", "all-gather",
+    "all_reduce", "all-reduce",
+    "reduce_scatter", "reduce-scatter",
+    "collective_permute", "collective-permute",
+    "all_to_all", "all-to-all",
+    "psum", "psum_scatter", "ppermute", "pgather",
+)
+
+_GATHER_TOKENS = ("all_gather", "all-gather")
+
+# substrings marking host traffic in StableHLO dumps
+_HOST_TOKENS = ("infeed", "outfeed", "send_to_host", "recv_from_host",
+                "SendToHost", "RecvFromHost", "pinned_host",
+                "annotate_device_placement", "host_compute")
+
+# result tensor type, e.g. tensor<128x512xf32>
+_TENSOR_RE = re.compile(r"tensor<([0-9]+(?:x[0-9]+)*)x[a-z][a-z0-9]*>")
+_BRANCH_TOKENS = ("stablehlo.if", "stablehlo.case", "mhlo.if", "mhlo.case",
+                  "cond[", "cond ")
+
+
+def _line_tensor_shapes(line: str) -> List[Tuple[int, ...]]:
+    return [tuple(int(x) for x in m.group(1).split("x"))
+            for m in _TENSOR_RE.finditer(line)]
+
+
+def lint_lowered_text(text: str,
+                      mp_full_shapes: Optional[Dict[str, Sequence[int]]] = None
+                      ) -> List[Diagnostic]:
+    """Scan a lowered-program dump for communication hazards.
+
+    ``mp_full_shapes`` maps model-parallel variable names to their FULL
+    (global) shapes; an all-gather whose result matches one of them is
+    flagged as ADT405. Without it the all-gather check is skipped (there
+    is no way to tell an accidental full gather from a legitimate one).
+    """
+    out: List[Diagnostic] = []
+    full_shapes = {tuple(int(d) for d in shape): name
+                   for name, shape in (mp_full_shapes or {}).items()}
+    # depth of every open if/case region, tracked by brace nesting; a
+    # branch opener whose braces land on a LATER line (jaxpr ``cond[``
+    # pretty-prints this way) is held pending until its first ``{``
+    brace_depth = 0
+    branch_starts: List[int] = []
+    pending_branch = False
+    flagged_branch = False
+    seen_host: set = set()
+    seen_gather: set = set()
+    for lineno, line in enumerate(text.splitlines(), 1):
+        lowered_line = line.strip()
+        is_branch_open = any(tok in line for tok in _BRANCH_TOKENS)
+        has_collective = any(tok in line for tok in COLLECTIVE_TOKENS)
+        in_branch = (branch_starts or pending_branch or is_branch_open)
+        if in_branch and has_collective and not flagged_branch:
+            out.append(warning(
+                "ADT407",
+                "collective inside a conditional branch (line %d: %s) — "
+                "if the predicate ever differs across replicas this "
+                "deadlocks" % (lineno, lowered_line[:80]),
+                fixit="hoist the collective out of the branch or prove "
+                      "the predicate replica-uniform"))
+            flagged_branch = True  # one finding per program is enough signal
+        if has_collective and any(tok in line for tok in _GATHER_TOKENS):
+            for shape in _line_tensor_shapes(line):
+                name = full_shapes.get(shape)
+                if name is not None and name not in seen_gather:
+                    seen_gather.add(name)
+                    out.append(warning(
+                        "ADT405",
+                        "all-gather materializes the full value of "
+                        "model-parallel variable (shape %s, line %d) — "
+                        "its compute should consume the local shard"
+                        % (list(shape), lineno),
+                        var=name,
+                        fixit="check the model's mp_rules cover every "
+                              "consumer of this variable"))
+        for tok in _HOST_TOKENS:
+            if tok in line and tok not in seen_host:
+                seen_host.add(tok)
+                out.append(warning(
+                    "ADT406",
+                    "host transfer on the hot path (%s, line %d) — each "
+                    "one serializes the step on PCIe" % (tok, lineno),
+                    fixit="keep the step device-resident; host-PS pulls "
+                          "belong in the store, not the compiled step"))
+        opens = line.count("{")
+        if (is_branch_open or pending_branch) and opens > 0:
+            branch_starts.append(brace_depth)
+            pending_branch = False
+        elif is_branch_open:
+            pending_branch = True  # braces arrive on a later line
+        brace_depth += opens - line.count("}")
+        while branch_starts and brace_depth <= branch_starts[-1]:
+            branch_starts.pop()
+    return sort_diagnostics(out)
+
+
+def mp_full_shapes_of(distributed_step) -> Dict[str, Tuple[int, ...]]:
+    """Full global shapes of the model-parallel variables of a compiled
+    ``DistributedStep`` — the ``mp_full_shapes`` input of
+    :func:`lint_lowered_text`."""
+    infos = distributed_step.model_item.var_infos
+    out: Dict[str, Tuple[int, ...]] = {}
+    for name, layout in distributed_step.layouts.items():
+        if getattr(layout, "mp_axes", ()):
+            info_ = infos.get(name)
+            if info_ is not None:
+                out[name] = tuple(info_.shape)
+    return out
+
+
+def lint_runner(runner, batch, state=None) -> List[Diagnostic]:
+    """Lower the runner's step for ``batch`` and lint the StableHLO.
+
+    The single implementation behind ``Runner.lint_lowered`` — keep the
+    two entry points from drifting."""
+    text = runner.lowered_text(batch, state)
+    return lint_lowered_text(text, mp_full_shapes_of(runner.distributed_step))

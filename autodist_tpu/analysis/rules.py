@@ -1,0 +1,625 @@
+"""Rule-based static analysis over ``(Strategy, ModelItem, ResourceSpec)``.
+
+The verifier proves a Strategy well-formed *before any tracing* — in the
+spirit of P^2's constraint checking over parallelism placements
+(arXiv:2110.10548) and TACCL's sketch validation (arXiv:2111.04867) — so
+bad plans surface as lint-time :class:`Diagnostic` lists instead of
+``ValueError`` tracebacks deep in ``kernel/partitioner.py`` or runtime
+collective deadlocks.
+
+Layout:
+
+- each ``@rule`` function inspects one aspect and yields Diagnostics;
+- :func:`verify` runs them all and returns the sorted findings;
+- the *shared* check functions (``check_partitioner_node``,
+  ``check_mp_axes_node``, ``missing_trainable_configs``) are imported by
+  the compile path (``strategy/base.py``, ``kernel/partitioner.py``) so
+  lint time and compile time execute the same code — no rule is
+  implemented twice.
+
+``model_item`` may be a full ``ModelItem`` or anything exposing
+``var_infos`` (name -> ``VarInfo``); rules must stay pure and cheap — the
+auto-strategy search calls :func:`verify` once per candidate to prune
+un-compilable plans without compiling them.
+"""
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from autodist_tpu import const
+from autodist_tpu.analysis import partition as partition_lib
+from autodist_tpu.analysis.diagnostics import (Diagnostic, DiagnosticError,
+                                               error, info, sort_diagnostics,
+                                               warning)
+
+# Axis names the framework's meshes understand (parallel/mesh.py builds
+# meshes from these; an unknown name silently materializes nothing).
+KNOWN_MESH_AXES = (const.DATA_AXIS, const.MODEL_AXIS, const.PIPELINE_AXIS,
+                   const.SEQUENCE_AXIS, const.EXPERT_AXIS)
+
+_PIPELINE_SCHEDULES = ("gpipe", "1f1b", "interleaved")
+
+
+# ------------------------------------------------------------------ context
+
+
+class Context:
+    """Everything the rules need, computed once per :func:`verify` call."""
+
+    def __init__(self, strategy, model_item, resource_spec):
+        self.strategy = strategy
+        self.spec = resource_spec
+        self.var_infos = dict(getattr(model_item, "var_infos", None)
+                              or (model_item if isinstance(model_item, dict)
+                                  else {}))
+        self.trainable = {n for n, v in self.var_infos.items()
+                          if getattr(v, "trainable", True)}
+        gc = strategy.graph_config
+        self.replicas = list(gc.replicas)
+        self.mesh_shape = dict(gc.mesh_shape or {})
+        # device universe, canonicalized like kernel/device/resolver.py
+        from autodist_tpu.resource_spec import DeviceSpec
+        self.device_names = set()
+        self.cpu_names = set()
+        if resource_spec is not None:
+            self.device_names = {d.name_string()
+                                 for d in resource_spec.devices}
+            self.cpu_names = {d.name_string()
+                              for d in resource_spec.cpu_devices}
+        self._canon = DeviceSpec.from_string
+
+    def canonical(self, name: str) -> Optional[str]:
+        """Canonical ``host:TYPE:index`` or None when unparseable."""
+        try:
+            return self._canon(name).name_string()
+        except (ValueError, KeyError, IndexError):
+            return None
+
+    def synchronizers(self, node) -> List[Tuple[str, object]]:
+        """(owning var name, synchronizer) pairs for one strategy node —
+        the node's own synchronizer, or its shards'."""
+        out = []
+        if node.synchronizer is not None:
+            out.append((node.var_name, node.synchronizer))
+        for part in node.part_configs:
+            if part.synchronizer is not None:
+                out.append((part.var_name or node.var_name,
+                            part.synchronizer))
+        return out
+
+    def mesh_axis_sizes(self) -> Dict[str, int]:
+        """mesh_shape, or the implicit data-only mesh of a DP strategy."""
+        if self.mesh_shape:
+            return dict(self.mesh_shape)
+        return {const.DATA_AXIS: max(len(self.replicas), 1)}
+
+
+# ----------------------------------------------------------- rule registry
+
+_RULES = []
+
+
+def rule(fn):
+    _RULES.append(fn)
+    return fn
+
+
+def verify(strategy, model_item, resource_spec) -> List[Diagnostic]:
+    """Run every rule; returns diagnostics sorted most-severe-first.
+
+    Pure and trace-free: safe to call per candidate in the auto-strategy
+    search, from the CLI, or from ``AutoDist(validate=...)`` before the
+    kernels ever see the plan.
+    """
+    ctx = Context(strategy, model_item, resource_spec)
+    out: List[Diagnostic] = []
+    for r in _RULES:
+        out.extend(r(ctx))
+    return sort_diagnostics(out)
+
+
+# ------------------------------------------------- shared check functions
+# (imported by strategy/base.py and kernel/partitioner.py — the compile
+# path raises DiagnosticError from the FIRST error these return)
+
+
+def missing_trainable_configs(strategy, trainable_names) -> List[str]:
+    """Trainable variables the strategy has no node for (ADT101).
+
+    The single implementation behind both the linter rule and
+    ``StrategyCompiler.compile``'s hard failure."""
+    have = {n.var_name for n in strategy.node_config}
+    return sorted(set(trainable_names) - have)
+
+
+def check_partitioner_node(node, shape) -> List[Diagnostic]:
+    """ADT2xx checks for one node's ``partitioner`` string against the
+    variable's shape (``shape`` may be None when unknown)."""
+    out: List[Diagnostic] = []
+    if not node.partitioner:
+        return out
+    try:
+        counts = partition_lib.parse_partitioner(node.partitioner,
+                                                 node.var_name)
+    except DiagnosticError as e:
+        return [e.diagnostic]
+    split_axes = partition_lib.split_axes_of(counts)
+    if len(split_axes) > 1:
+        out.append(error(
+            "ADT204",
+            "partitioner %r splits %d axes; the lowering supports exactly "
+            "one split axis" % (node.partitioner, len(split_axes)),
+            var=node.var_name,
+            fixit="keep one count > 1, e.g. %r"
+                  % ",".join(str(c) if i == split_axes[0] else "1"
+                             for i, c in enumerate(counts))))
+    if shape is not None and len(counts) != max(len(shape), 1):
+        out.append(error(
+            "ADT202",
+            "partitioner %r has %d axis counts but the variable has rank %d"
+            % (node.partitioner, len(counts), len(shape)),
+            var=node.var_name,
+            fixit="emit one count per tensor axis (scalars use a single "
+                  "count)"))
+    num_shards = partition_lib.num_shards_of(counts)
+    if node.part_configs and len(node.part_configs) != num_shards:
+        out.append(error(
+            "ADT109",
+            "partitioner %r implies %d shards but the node carries %d "
+            "part_configs" % (node.partitioner, num_shards,
+                              len(node.part_configs)),
+            var=node.var_name,
+            fixit="emit exactly one part config per shard"))
+    if (shape is not None and split_axes
+            and not any(d.code == "ADT202" for d in out)):
+        axis = split_axes[0]
+        if axis < len(shape):
+            dim = shape[axis]
+            if dim < num_shards:
+                out.append(warning(
+                    "ADT203",
+                    "split dim %d (size %d) has fewer rows than %d shards; "
+                    "the partitioner will keep the variable replicated"
+                    % (axis, dim, num_shards), var=node.var_name,
+                    fixit="drop the partitioner or split a larger axis"))
+            elif dim % num_shards != 0 and not node.shard_sizes:
+                out.append(info(
+                    "ADT209",
+                    "split dim %d (size %d) is not divisible by %d shards; "
+                    "device storage pads to the next multiple"
+                    % (axis, dim, num_shards), var=node.var_name))
+    if node.shard_sizes is not None and shape is not None and split_axes:
+        axis = split_axes[0]
+        dim = shape[axis] if axis < len(shape) else None
+        if len(node.shard_sizes) != num_shards:
+            out.append(error(
+                "ADT208",
+                "shard_sizes has %d entries for %d shards"
+                % (len(node.shard_sizes), num_shards), var=node.var_name,
+                fixit="emit one size per shard"))
+        elif dim is not None and sum(node.shard_sizes) != dim:
+            out.append(error(
+                "ADT208",
+                "shard_sizes %s sums to %d but split dim %d has size %d"
+                % (list(node.shard_sizes), sum(node.shard_sizes), axis, dim),
+                var=node.var_name,
+                fixit="make the sizes sum to the split dimension"))
+    return out
+
+
+def check_mp_axes_node(var_name: str, mp_axes: Dict[int, str], shape,
+                       mesh_axis_sizes: Dict[str, int]) -> List[Diagnostic]:
+    """ADT205/206/207 for one node's model-parallel ``mp_axes`` spec.
+
+    The same function ``kernel/partitioner.VariablePartitioner._mp_layout``
+    raises from, so the lint table and the compile error always agree."""
+    out: List[Diagnostic] = []
+    seen_axes: Dict[str, int] = {}
+    for dim, ax_name in sorted(mp_axes.items()):
+        size = mesh_axis_sizes.get(ax_name)
+        if size is None:
+            out.append(error(
+                "ADT205",
+                "mp axis %r not in mesh %s" % (ax_name, mesh_axis_sizes),
+                var=var_name,
+                fixit="add the axis to graph_config.mesh_shape or shard "
+                      "over an existing axis"))
+            continue
+        if ax_name in seen_axes:
+            out.append(error(
+                "ADT207",
+                "mesh axis %r shards both dim %d and dim %d of the same "
+                "variable" % (ax_name, seen_axes[ax_name], dim),
+                var=var_name,
+                fixit="shard each mesh axis over at most one tensor dim"))
+        seen_axes[ax_name] = dim
+        if shape is not None and (dim >= len(shape)
+                                  or shape[dim] % size != 0):
+            out.append(error(
+                "ADT206",
+                "dim %d (shape %s) not divisible by mesh axis %r size %d"
+                % (dim, tuple(shape), ax_name, size), var=var_name,
+                fixit="model-parallel storage needs exact divisibility "
+                      "(no padding): adjust the mesh axis size or the "
+                      "model dimension"))
+    return out
+
+
+def check_compressor_name(name: str, var_name: str = "") -> List[Diagnostic]:
+    """ADT305 for one compressor name (shared with the factory path)."""
+    if not name:
+        return []
+    from autodist_tpu.kernel.synchronization import compressor as comp_lib
+    try:
+        comp_lib.validate_name(name)
+    except ValueError as e:
+        return [error("ADT305", str(e), var=var_name,
+                      fixit="pick one of %s (PowerSGD takes a rank "
+                            "suffix, e.g. 'PowerSGDCompressor:2')"
+                            % sorted(comp_lib.known_names()))]
+    return []
+
+
+# ------------------------------------------------------------- ADT1xx rules
+
+
+@rule
+def _r_missing_configs(ctx: Context) -> Iterable[Diagnostic]:
+    for name in missing_trainable_configs(ctx.strategy, ctx.trainable):
+        yield error(
+            "ADT101", "trainable variable has no strategy node", var=name,
+            fixit="emit a VarConfig for every trainable variable (or mark "
+                  "it frozen via trainable_filter)")
+
+
+@rule
+def _r_unknown_and_duplicate(ctx: Context) -> Iterable[Diagnostic]:
+    seen = set()
+    for node in ctx.strategy.node_config:
+        if node.var_name in seen:
+            yield error("ADT103",
+                        "duplicate strategy node for one variable",
+                        var=node.var_name,
+                        fixit="emit exactly one VarConfig per variable")
+        seen.add(node.var_name)
+        if ctx.var_infos and node.var_name not in ctx.var_infos:
+            yield warning(
+                "ADT102",
+                "strategy node references a variable the model does not "
+                "have (the compiler will prune it)", var=node.var_name)
+
+
+@rule
+def _r_replicas(ctx: Context) -> Iterable[Diagnostic]:
+    if not ctx.replicas:
+        yield error("ADT104", "strategy has no replica devices",
+                    fixit="set graph_config.replicas to the compute "
+                          "devices of the resource spec")
+        return
+    if ctx.spec is None:
+        return
+    for name in ctx.replicas:
+        canon = ctx.canonical(name)
+        if canon is None or (canon not in ctx.device_names
+                             and canon not in ctx.cpu_names):
+            yield error(
+                "ADT105",
+                "replica device %r is not in the resource spec (has %d "
+                "devices)" % (name, len(ctx.device_names)), var="",
+                fixit="build replicas from resource_spec.devices")
+
+
+@rule
+def _r_mesh_shape(ctx: Context) -> Iterable[Diagnostic]:
+    if not ctx.mesh_shape:
+        return
+    product = 1
+    for ax, size in ctx.mesh_shape.items():
+        if ax not in KNOWN_MESH_AXES:
+            yield warning(
+                "ADT107",
+                "mesh axis %r is not one the framework materializes %s"
+                % (ax, list(KNOWN_MESH_AXES)))
+        if int(size) < 1:
+            yield error("ADT106", "mesh axis %r has size %d < 1" % (ax, size))
+            return
+        product *= int(size)
+    n = len(ctx.replicas)
+    if n and product != n:
+        yield error(
+            "ADT106",
+            "mesh shape %s multiplies out to %d devices but the strategy "
+            "has %d replicas" % (ctx.mesh_shape, product, n),
+            fixit="make the mesh axis sizes factor the replica count")
+    gc = ctx.strategy.graph_config
+    if gc.seq_axis and gc.seq_axis not in ctx.mesh_axis_sizes():
+        yield error(
+            "ADT110",
+            "seq_axis %r is not in the mesh %s"
+            % (gc.seq_axis, ctx.mesh_axis_sizes()),
+            fixit="add the sequence axis to mesh_shape")
+    for ax in (gc.batch_axes or []):
+        if ax not in ctx.mesh_axis_sizes():
+            yield error(
+                "ADT110",
+                "batch axis %r is not in the mesh %s"
+                % (ax, ctx.mesh_axis_sizes()),
+                fixit="batch_axes may only name mesh axes")
+
+
+@rule
+def _r_node_shape(ctx: Context) -> Iterable[Diagnostic]:
+    for node in ctx.strategy.node_config:
+        info_ = ctx.var_infos.get(node.var_name)
+        trainable = (node.var_name in ctx.trainable) if ctx.var_infos else True
+        if (trainable and node.synchronizer is None and not node.part_configs
+                and not node.mp_axes):
+            yield error(
+                "ADT108",
+                "trainable node carries no synchronizer, shards, or "
+                "mp_axes — the lowering cannot synchronize its gradient",
+                var=node.var_name,
+                fixit="attach an AllReduceSynchronizer or PSSynchronizer")
+        shape = tuple(info_.shape) if info_ is not None else None
+        for d in check_partitioner_node(node, shape):
+            yield d
+        if node.mp_axes:
+            for d in check_mp_axes_node(node.var_name, node.mp_axes, shape,
+                                        ctx.mesh_axis_sizes()):
+                yield d
+            if node.partitioner:
+                yield warning(
+                    "ADT207",
+                    "mp_axes and partitioner both set; mp_axes wins "
+                    "(ZeRO+MP on one variable is unsupported)",
+                    var=node.var_name,
+                    fixit="drop the partitioner on model-parallel "
+                          "variables")
+
+
+# ------------------------------------------------------------- ADT3xx rules
+
+
+def _is_ps(sync) -> bool:
+    return getattr(sync, "kind", "") == "PS"
+
+
+def _is_ar(sync) -> bool:
+    return getattr(sync, "kind", "") == "AllReduce"
+
+
+@rule
+def _r_synchronizers(ctx: Context) -> Iterable[Diagnostic]:
+    for node in ctx.strategy.node_config:
+        info_ = ctx.var_infos.get(node.var_name)
+        trainable = (node.var_name in ctx.trainable) if ctx.var_infos else True
+        for owner, sync in ctx.synchronizers(node):
+            if _is_ps(sync):
+                if not sync.reduction_destination:
+                    sev = error if trainable else warning
+                    yield sev(
+                        "ADT302",
+                        "PS reduction_destination is empty — no device "
+                        "owns this variable's update", var=owner,
+                        fixit="set it to a host device, e.g. "
+                              "'%s:CPU:0'" % (ctx.spec.chief if ctx.spec
+                                              else "<chief>"))
+                elif ctx.spec is not None:
+                    canon = ctx.canonical(sync.reduction_destination)
+                    if canon is None or (canon not in ctx.device_names
+                                         and canon not in ctx.cpu_names):
+                        yield error(
+                            "ADT303",
+                            "PS reduction_destination %r is not a device "
+                            "of the resource spec"
+                            % sync.reduction_destination, var=owner,
+                            fixit="use a node address from the spec "
+                                  "(host CPUs are valid PS destinations)")
+                if sync.staleness < 0:
+                    yield error("ADT304",
+                                "staleness %d < 0" % sync.staleness,
+                                var=owner)
+                if sync.staleness > 0 and not sync.sync:
+                    yield error(
+                        "ADT304",
+                        "staleness is a SYNC-training window; async PS "
+                        "always reads the latest published version",
+                        var=owner, fixit="drop staleness or set sync=True")
+            comp = getattr(sync, "compressor", "") or ""
+            comp_diags = check_compressor_name(comp, owner)
+            for d in comp_diags:
+                yield d
+            if comp and comp != "NoneCompressor" and not comp_diags:
+                if node.partitioner:
+                    yield warning(
+                        "ADT306",
+                        "compressor %s is ignored — partitioned variables "
+                        "sync via reduce-scatter" % comp, var=owner,
+                        fixit="drop the compressor or the partitioner")
+                elif node.mp_axes:
+                    yield warning(
+                        "ADT306",
+                        "compressor %s is ignored — model-parallel "
+                        "gradients reduce uncompressed over the "
+                        "complement axes" % comp, var=owner)
+                elif info_ is not None and getattr(info_, "sparse", False):
+                    yield warning(
+                        "ADT306",
+                        "compressor %s is ignored — sparse-wire gradients "
+                        "ship as (ids, values) pairs, already batch-sized"
+                        % comp, var=owner)
+                elif comp.split(":")[0] == "PowerSGDCompressor" and (
+                        info_ is not None and len(info_.shape) < 2):
+                    yield warning(
+                        "ADT308",
+                        "PowerSGD on a rank-%d tensor passes through "
+                        "uncompressed" % len(info_.shape), var=owner)
+                elif info_ is not None and not str(
+                        getattr(info_, "dtype", "float32")).startswith(
+                            ("float", "bfloat")):
+                    yield warning(
+                        "ADT306",
+                        "compressor %s has no effect on dtype %s — the "
+                        "reduced-precision cast only applies to float "
+                        "gradients" % (comp, info_.dtype), var=owner)
+
+
+@rule
+def _r_async_all_or_nothing(ctx: Context) -> Iterable[Diagnostic]:
+    """Mirror of ``AutoDist._validate_async``: async PS must be PURE
+    host-PS — every trainable variable on the no-proxy PS path, no
+    model-parallel mesh (collectives are lockstep)."""
+    all_syncs = []
+    for node in ctx.strategy.node_config:
+        trainable = (node.var_name in ctx.trainable) if ctx.var_infos else True
+        if not trainable:
+            continue
+        for owner, sync in ctx.synchronizers(node):
+            all_syncs.append((node, owner, sync))
+    is_async = any(_is_ps(s) and not s.sync for _, _, s in all_syncs)
+    if not is_async:
+        return
+    for node, owner, sync in all_syncs:
+        if _is_ar(sync):
+            yield error(
+                "ADT307",
+                "async PS is all-or-nothing: this variable rides "
+                "AllReduce while others are async", var=owner,
+                fixit="route every trainable variable through "
+                      "PS(sync=False)")
+        elif _is_ps(sync) and sync.sync:
+            yield error(
+                "ADT307",
+                "async PS is all-or-nothing: this variable requests "
+                "sync=True", var=owner,
+                fixit="set sync=False on every variable or none")
+        elif _is_ps(sync) and sync.local_replication:
+            yield error(
+                "ADT307",
+                "async PS cannot use proxy (local_replication) variables "
+                "— they are not host-resident", var=owner,
+                fixit="set local_replication=False for async training")
+    if ctx.mesh_shape:
+        yield error(
+            "ADT307",
+            "async PS cannot combine with model-parallel mesh axes "
+            "(collectives are lockstep); mesh %s" % ctx.mesh_shape,
+            fixit="drop mesh_shape or train synchronously")
+
+
+@rule
+def _r_sparse_dense_path(ctx: Context) -> Iterable[Diagnostic]:
+    """Sparse (embedding) variables on dense-only sync paths: their
+    gradient is batch-row-sized, and a partitioned reduce-scatter (ZeRO)
+    densifies it to the full table every step."""
+    require = bool(ctx.strategy.graph_config.require_sparse)
+    for node in ctx.strategy.node_config:
+        info_ = ctx.var_infos.get(node.var_name)
+        if info_ is None or not getattr(info_, "sparse", False):
+            continue
+        if not getattr(info_, "trainable", True):
+            continue
+        syncs = [s for _, s in ctx.synchronizers(node)]
+        dense_partitioned = node.partitioner and any(_is_ar(s) for s in syncs)
+        if dense_partitioned:
+            sev = error if require else warning
+            yield sev(
+                "ADT309",
+                "sparse (gather-indexed) variable is partitioned with "
+                "AllReduce sync — the reduce-scatter densifies its "
+                "row-sparse gradient to the full table every step",
+                var=node.var_name,
+                fixit="route embeddings to PS (Parallax) or keep them "
+                      "unpartitioned so the (ids, values) sparse wire "
+                      "engages")
+
+
+# ------------------------------------------------------------- ADT4xx rules
+
+
+@rule
+def _r_pipeline(ctx: Context) -> Iterable[Diagnostic]:
+    gc = ctx.strategy.graph_config
+    stages = int(ctx.mesh_shape.get(const.PIPELINE_AXIS, 1))
+    sched = gc.pp_schedule
+    m = int(gc.pp_microbatches or 0)
+    if sched is not None and sched not in _PIPELINE_SCHEDULES:
+        yield error(
+            "ADT402",
+            "unknown pipeline schedule %r (have %s)"
+            % (sched, list(_PIPELINE_SCHEDULES)))
+        return
+    if sched and stages <= 1:
+        yield warning(
+            "ADT402",
+            "pp_schedule=%r set but the mesh has no %r axis — the "
+            "schedule never engages" % (sched, const.PIPELINE_AXIS),
+            fixit="add the pipeline axis to mesh_shape or drop the "
+                  "schedule")
+    if stages > 1:
+        if m < 1:
+            yield warning(
+                "ADT401",
+                "%d pipeline stages with no pp_microbatches recorded — "
+                "the cost model prices a full bubble" % stages,
+                fixit="set graph_config.pp_microbatches")
+        elif m < stages:
+            bubble = (stages - 1) / (stages - 1 + m)
+            yield warning(
+                "ADT401",
+                "%d microbatches over %d stages leaves a %.0f%% fill/"
+                "drain bubble" % (m, stages, 100 * bubble),
+                var="", fixit="use at least as many microbatches as "
+                              "stages (ideally 4x)")
+        if sched == "interleaved":
+            if int(gc.pp_virtual or 0) < 2:
+                yield error(
+                    "ADT402",
+                    "interleaved schedule needs pp_virtual >= 2 (got %r)"
+                    % gc.pp_virtual)
+            if m and m % stages != 0:
+                yield error(
+                    "ADT402",
+                    "interleaved schedule needs pp_microbatches (%d) "
+                    "divisible by the stage count (%d)" % (m, stages))
+
+
+@rule
+def _r_ps_load_balance(ctx: Context) -> Iterable[Diagnostic]:
+    load: Dict[str, float] = {}
+    for node in ctx.strategy.node_config:
+        info_ = ctx.var_infos.get(node.var_name)
+        if info_ is None:
+            continue
+        syncs = [s for _, s in ctx.synchronizers(node)]
+        ps = [s for s in syncs if _is_ps(s) and s.reduction_destination]
+        for s in ps:
+            host = str(s.reduction_destination).split(":")[0]
+            load[host] = load.get(host, 0.0) + (
+                float(getattr(info_, "byte_size", 0)) / max(len(ps), 1))
+    if len(load) >= 2:
+        total = sum(load.values())
+        worst_host, worst = max(load.items(), key=lambda kv: kv[1])
+        # with k hosts a balanced plan puts 1/k of the bytes on each; one
+        # host carrying >75% of the total will bottleneck the push/pull
+        # phase no matter how many peers idle beside it
+        if total > 0 and worst / total > 0.75:
+            yield warning(
+                "ADT403",
+                "PS host %s carries %.0f%% of the parameter bytes across "
+                "%d PS hosts — it will bottleneck the push/pull phase"
+                % (worst_host, 100.0 * worst / total, len(load)),
+                fixit="use PSLoadBalancing or partition the heavy "
+                      "variables")
+
+
+@rule
+def _r_staleness_topology(ctx: Context) -> Iterable[Diagnostic]:
+    if ctx.spec is None or not ctx.spec.is_single_node():
+        return
+    stale = sorted({owner for node in ctx.strategy.node_config
+                    for owner, s in ctx.synchronizers(node)
+                    if _is_ps(s) and s.sync and s.staleness > 0})
+    if stale:
+        yield info(
+            "ADT404",
+            "staleness window configured on a single-node spec — "
+            "cross-process pacing is a no-op here (%d vars)" % len(stale))

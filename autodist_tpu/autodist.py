@@ -70,8 +70,16 @@ def reset():
 class AutoDist:
     def __init__(self, resource_spec_file: Optional[str] = None,
                  strategy_builder=None, resource_spec: Optional[ResourceSpec] = None,
-                 backend: Optional[str] = None, tracing: bool = False):
+                 backend: Optional[str] = None, tracing: bool = False,
+                 validate: str = "warn"):
+        if validate not in ("error", "warn", "off"):
+            raise ValueError("validate must be 'error', 'warn' or 'off', "
+                             "got %r" % (validate,))
         set_default_autodist(self)
+        # pre-compile strategy verification mode (analysis/rules.py):
+        # "error" raises StrategyVerificationError before any kernel sees
+        # the plan, "warn" logs the diagnostics, "off" skips the pass
+        self._validate = validate
         const.makedirs()
         # Worker processes join the JAX distributed runtime from the env the
         # Coordinator set — must happen before any device query.
@@ -170,6 +178,27 @@ class AutoDist:
 
     # ------------------------------------------------------------- build path
 
+    def _verify_strategy(self, strategy: Strategy, item: ModelItem):
+        """Static verification BEFORE kernel transformation
+        (``analysis/rules.py``): whole failure classes — malformed
+        partitioners, dangling PS destinations, sync/compressor
+        mismatches — surface here as typed diagnostics instead of
+        ``ValueError``s deep in the lowering (or collective deadlocks at
+        runtime)."""
+        if self._validate == "off":
+            return
+        from autodist_tpu.analysis import verify
+        from autodist_tpu.analysis.diagnostics import (
+            Severity, StrategyVerificationError)
+        diags = verify(strategy, item, self._resource_spec)
+        errors = [d for d in diags if d.severity >= Severity.ERROR]
+        for d in diags:
+            log = (logging.warning if d.severity >= Severity.WARNING
+                   else logging.debug)
+            log("strategy verifier: %s", d.format())
+        if errors and self._validate == "error":
+            raise StrategyVerificationError(errors)
+
     def _build_or_load_strategy(self, model_item: ModelItem) -> Strategy:
         """Chief builds+serializes; workers load by id
         (reference ``autodist.py:100-109``).
@@ -266,6 +295,7 @@ class AutoDist:
                          trainable_filter=trainable_filter,
                          mp_rules=mp_rules, mp_meta=mp_meta).prepare()
         strategy = self._build_or_load_strategy(item)
+        self._verify_strategy(strategy, item)
         compiled = StrategyCompiler(item, self._resource_spec).compile(strategy)
         logging.info("compiled %r", compiled)
         logging.debug("compiled strategy:\n%s", compiled)
@@ -355,6 +385,7 @@ class AutoDist:
         item = ModelItem(step_fn=step_fn, params=state,
                          example_batch=example_batch).prepare()
         strategy = self._build_or_load_strategy(item)
+        self._verify_strategy(strategy, item)
         compiled = StrategyCompiler(item, self._resource_spec).compile(strategy)
         logging.info("compiled %r (step_fn mode)", compiled)
         if self._validate_async(compiled, item):

@@ -132,19 +132,21 @@ class VariablePartitioner(Kernel):
     def _mp_layout(self, node, info) -> VarLayout:
         """Model-parallel storage layout from a VarConfig.mp_axes spec.
         Requires exact divisibility (no padding: the consuming compute is
-        written against the local shard shape)."""
+        written against the local shard shape). Validation runs through
+        the SAME rule functions the plan linter reports as ADT205/206/207
+        (``analysis/rules.py``), so compile-time raises exactly what lint
+        time would have listed."""
+        from autodist_tpu.analysis.diagnostics import DiagnosticError, Severity
+        from autodist_tpu.analysis.rules import check_mp_axes_node
+        bad = [d for d in check_mp_axes_node(node.var_name, node.mp_axes,
+                                             tuple(info.shape),
+                                             self._mesh_axis_sizes)
+               if d.severity >= Severity.ERROR]
+        if bad:
+            raise DiagnosticError(bad[0])
         mp = []
         for dim, ax_name in sorted(node.mp_axes.items()):
             size = self._mesh_axis_sizes.get(ax_name)
-            if size is None:
-                raise ValueError("var %s: mp axis %r not in mesh %s"
-                                 % (node.var_name, ax_name,
-                                    self._mesh_axis_sizes))
-            if dim >= len(info.shape) or info.shape[dim] % size != 0:
-                raise ValueError(
-                    "var %s: dim %d (shape %s) not divisible by mesh axis "
-                    "%r size %d" % (node.var_name, dim, tuple(info.shape),
-                                    ax_name, size))
             if size > 1:
                 mp.append((dim, ax_name))
         if node.partitioner is not None:

@@ -223,14 +223,29 @@ def parse_name(name: str) -> "tuple[str, Optional[int]]":
     return base, rank
 
 
+def known_names() -> "tuple[str, ...]":
+    """Every serializable compressor name (aliases included)."""
+    return tuple(sorted(_REGISTRY))
+
+
+def validate_name(name: str) -> "tuple[str, Optional[int]]":
+    """Full validation of a serializable compressor name: format (via
+    :func:`parse_name`) AND registry membership. The single check behind
+    both the factory below and the linter's ADT305 rule
+    (``analysis/rules.py``) — compile time and lint time cannot drift."""
+    base, rank = parse_name(name)
+    if base not in _REGISTRY:
+        raise ValueError("unknown compressor %r (have %s)"
+                         % (name, sorted(_REGISTRY)))
+    return base, rank
+
+
 def create(name: Optional[str], var_name: str = "") -> Compressor:
     """Factory by class name (reference ``Compressor.create``). PowerSGD's
     rank rides in the serializable name: ``"PowerSGDCompressor:4"``."""
     if not name:
         return NoneCompressor(var_name)
-    base, rank = parse_name(name)
-    if base not in _REGISTRY:
-        raise ValueError("unknown compressor %r (have %s)" % (name, sorted(_REGISTRY)))
+    base, rank = validate_name(name)
     cls = _REGISTRY[base]
     if rank is not None:
         return cls(var_name, rank=rank)

@@ -209,6 +209,21 @@ class Runner:
                 del self._recent_step_s[:len(self._recent_step_s) // 2]
         return (new_state, host_metrics) if state is not None else host_metrics
 
+    def lowered_text(self, batch, state: Optional[TrainState] = None) -> str:
+        """StableHLO text of the compiled step for ``batch`` — the input
+        of the post-lowering lint pass (``analysis/lowered.py``). Pure
+        lowering: no step runs, host-PS values enter as avals."""
+        st = state if state is not None else self.state
+        if st is None:
+            raise RuntimeError("Runner.lowered_text before init()")
+        return self._dstep.lowered_text(st, self._remapper.remap_feed(batch))
+
+    def lint_lowered(self, batch, state: Optional[TrainState] = None):
+        """Run the lowered-program communication checks (ADT405-407) on
+        this runner's compiled step; returns the Diagnostic list."""
+        from autodist_tpu.analysis import lowered as lowered_lib
+        return lowered_lib.lint_runner(self, batch, state)
+
     def step_stats(self) -> dict:
         """Wall-time statistics over this runner's steps (the throughput
         companion to the reference's examples/sec hooks,

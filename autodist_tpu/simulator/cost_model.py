@@ -154,6 +154,14 @@ class CostModel:
             calibration = Calibration.load(calibration)
         self.calibration = calibration
 
+    def verify(self, strategy: Strategy):
+        """Static diagnostics for a candidate (``analysis/rules.py``):
+        the cheap validity gate the simulator applies BEFORE estimating —
+        pricing an un-compilable plan would just hand the auto-strategy
+        search a winner that explodes at lowering time."""
+        from autodist_tpu.analysis import verify as _verify
+        return _verify(strategy, self._item, self._spec)
+
     def _guess_chip(self) -> str:
         kind = str(self._spec.slice_info.get("type", "")).lower()
         for k in ("v5p", "v5e", "v4"):

@@ -93,8 +93,35 @@ class Simulator:
         warning rather than failing the build. Lossy-compression
         candidates carry an accuracy-risk premium in the sort key (see
         ``_risk_premium``) so they win only when the wire saving is
-        decisive, not on microsecond ties."""
-        results = [self.simulate(s, label) for label, s in candidates]
+        decisive, not on microsecond ties.
+
+        Before any pricing, each candidate runs the static verifier
+        (``CostModel.verify`` -> ``analysis/rules.py``); candidates with
+        error-severity diagnostics are skipped with a logged reason —
+        there is no point ranking a plan that cannot compile. If EVERY
+        candidate fails verification the unverified ranking is returned
+        (with a warning) so a caller always gets an ordering."""
+        from autodist_tpu.analysis.diagnostics import Severity
+        kept = []
+        for label, s in candidates:
+            errs = [d for d in self._cost_model.verify(s)
+                    if d.severity >= Severity.ERROR]
+            if errs:
+                logging.info(
+                    "simulator: skipping un-compilable candidate %s: %s",
+                    label or s.id,
+                    "; ".join(d.format() for d in errs[:3])
+                    + ("; +%d more" % (len(errs) - 3) if len(errs) > 3
+                       else ""))
+                continue
+            kept.append((label, s))
+        if candidates and not kept:
+            logging.warning(
+                "simulator: every candidate failed static verification; "
+                "ranking them unverified — expect the build to fail with "
+                "the same diagnostics")
+            kept = list(candidates)
+        results = [self.simulate(s, label) for label, s in kept]
         results.sort(key=lambda r: (not r.breakdown.feasible,
                                     r.step_time_s * _risk_premium(r.strategy)))
         if results and not results[0].breakdown.feasible:

@@ -25,6 +25,8 @@ from abc import ABC, abstractmethod
 from typing import Dict, List, Optional, Union
 
 from autodist_tpu import const
+from autodist_tpu.analysis import partition as partition_lib
+from autodist_tpu.analysis.diagnostics import DiagnosticError, error
 from autodist_tpu.utils import logging
 
 
@@ -82,14 +84,34 @@ class AllReduceSynchronizer:
 Synchronizer = Union[PSSynchronizer, AllReduceSynchronizer]
 
 
-def synchronizer_from_dict(d: dict) -> Synchronizer:
+SYNCHRONIZER_KINDS = ("PS", "AllReduce")
+
+
+def synchronizer_from_dict(d: dict, var_name: str = "") -> Synchronizer:
+    """Deserialize one synchronizer config.
+
+    ``var_name`` names the owning strategy node in every failure message
+    (a serialized plan has hundreds of nodes — "unknown kind" without the
+    variable is unactionable). Raises :class:`DiagnosticError`
+    (``ADT301``, a ``ValueError``) on an unknown kind or invalid fields.
+    """
     d = dict(d)
-    kind = d.pop("kind")
-    if kind == "PS":
-        return PSSynchronizer(**d)
-    if kind == "AllReduce":
-        return AllReduceSynchronizer(**d)
-    raise ValueError("unknown synchronizer kind: %s" % kind)
+    kind = d.pop("kind", None)
+    ctor = {"PS": PSSynchronizer, "AllReduce": AllReduceSynchronizer}.get(kind)
+    if ctor is None:
+        raise DiagnosticError(error(
+            "ADT301",
+            "unknown synchronizer kind %r (allowed kinds: %s)"
+            % (kind, ", ".join(SYNCHRONIZER_KINDS)), var=var_name,
+            fixit="serialize synchronizers through "
+                  "PSSynchronizer/AllReduceSynchronizer.to_dict()"))
+    try:
+        return ctor(**d)
+    except TypeError as e:
+        raise DiagnosticError(error(
+            "ADT301",
+            "invalid %s synchronizer fields %s (%s)"
+            % (kind, sorted(d), e), var=var_name))
 
 
 # ------------------------------------------------------------------- nodes
@@ -120,23 +142,20 @@ class VarConfig:
 
     @property
     def partition_axis(self) -> Optional[int]:
+        """First split axis; raises ``DiagnosticError`` (ADT201, a clean
+        ``ValueError``) on a malformed partitioner like ``"4,"`` or
+        ``"a,1"`` — the same diagnostic the linter reports."""
         if not self.partitioner:
             return None
-        counts = [int(x) for x in self.partitioner.split(",")]
-        for ax, c in enumerate(counts):
-            if c > 1:
-                return ax
-        return None
+        return partition_lib.partition_axis_of(
+            partition_lib.parse_partitioner(self.partitioner, self.var_name))
 
     @property
     def num_shards(self) -> int:
         if not self.partitioner:
             return 1
-        counts = [int(x) for x in self.partitioner.split(",")]
-        n = 1
-        for c in counts:
-            n *= c
-        return n
+        return partition_lib.num_shards_of(
+            partition_lib.parse_partitioner(self.partitioner, self.var_name))
 
     def to_dict(self):
         return {
@@ -153,7 +172,9 @@ class VarConfig:
     def from_dict(cls, d: dict) -> "VarConfig":
         return cls(
             var_name=d["var_name"],
-            synchronizer=synchronizer_from_dict(d["synchronizer"]) if d.get("synchronizer") else None,
+            synchronizer=(synchronizer_from_dict(d["synchronizer"],
+                                                 var_name=d["var_name"])
+                          if d.get("synchronizer") else None),
             partitioner=d.get("partitioner"),
             part_configs=[cls.from_dict(p) for p in d.get("part_configs", [])],
             shard_sizes=d.get("shard_sizes"),
@@ -334,7 +355,14 @@ class StrategyCompiler:
             pruned.append(node)
         strategy.node_config = pruned
         strategy.graph_config.replicas = [resolver.resolve(r) for r in strategy.graph_config.replicas]
-        missing = trainable - {n.var_name for n in pruned}
+        # same rule the linter reports as ADT101 (analysis/rules.py) — the
+        # compile path raises where lint time merely lists
+        from autodist_tpu.analysis import rules as rules_lib
+        missing = rules_lib.missing_trainable_configs(strategy, trainable)
         if missing:
-            raise ValueError("strategy has no config for trainable vars: %s" % sorted(missing))
+            raise DiagnosticError(error(
+                "ADT101",
+                "strategy has no config for trainable vars: %s" % missing,
+                var=missing[0],
+                fixit="emit a VarConfig for every trainable variable"))
         return strategy

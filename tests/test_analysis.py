@@ -1,0 +1,585 @@
+"""Pre-compile strategy verifier: plan rules, mutation coverage, CLI.
+
+Three layers of coverage, matching the analyzer's design:
+
+1. every bundled strategy builder's emitted Strategy on the ``models/``
+   zoo lints CLEAN (no error-severity diagnostics) on a 2x2 mesh spec;
+2. mutation tests: each rule fires with its expected ``ADT`` code on a
+   deliberately-broken plan, both through :func:`verify` and through the
+   linter CLI (``--strategy-json`` -> nonzero exit);
+3. the compile paths (``VarConfig``, ``StrategyCompiler``,
+   ``VariablePartitioner``, ``synchronizer_from_dict``) raise
+   ``DiagnosticError`` carrying the SAME codes — no rule implemented
+   twice.
+"""
+import copy
+
+import jax.numpy as jnp
+import pytest
+
+from autodist_tpu import const
+from autodist_tpu.analysis import cli
+from autodist_tpu.analysis.diagnostics import (Severity,
+                                               StrategyVerificationError)
+from autodist_tpu.analysis.lowered import lint_lowered_text
+from autodist_tpu.analysis.rules import verify
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.strategy.base import (AllReduceSynchronizer, PSSynchronizer,
+                                        StrategyCompiler, VarConfig,
+                                        synchronizer_from_dict)
+
+
+def spec_2x2() -> ResourceSpec:
+    """Single node, 4 chips — the 2x2 lint-time mesh."""
+    return ResourceSpec.from_dict(
+        {"nodes": [{"address": "127.0.0.1", "chief": True, "tpus": 4}]})
+
+
+def errors(diags):
+    return [d for d in diags if d.severity >= Severity.ERROR]
+
+
+def codes(diags):
+    return {d.code for d in diags}
+
+
+@pytest.fixture(scope="module")
+def emb_item() -> ModelItem:
+    """Embedding + dense head (one sparse var) — the mutation target."""
+    loss_fn, params, batch, _ = cli.EXAMPLES["sentiment_classifier"]()
+    return ModelItem(loss_fn=loss_fn, params=params,
+                     example_batch=batch).prepare()
+
+
+# ------------------------------------------------- 1. builders lint clean
+
+
+DP_BUILDERS = ["PS", "PSLoadBalancing", "PartitionedPS",
+               "UnevenPartitionedPS", "AllReduce", "PartitionedAR",
+               "RandomAxisPartitionAR", "Parallax", "SequenceParallelAR",
+               "WithRemat"]
+
+
+@pytest.fixture(scope="module")
+def zoo_items():
+    """ModelItems for a zoo cross-section: dense scalar model, embedding
+    model, transformer LM, CNN."""
+    out = {}
+    for name in ("linear_regression", "sentiment_classifier", "lm1b",
+                 "image_classifier"):
+        loss_fn, params, batch, _ = cli.EXAMPLES[name]()
+        out[name] = ModelItem(loss_fn=loss_fn, params=params,
+                              example_batch=batch).prepare()
+    return out
+
+
+@pytest.mark.parametrize("builder_name", DP_BUILDERS)
+def test_dp_builders_lint_clean(builder_name, zoo_items):
+    spec = spec_2x2()
+    builders = cli._builders(None)
+    for model_name, item in zoo_items.items():
+        strategy = builders[builder_name]().build(item, spec)
+        diags = verify(strategy, item, spec)
+        assert not errors(diags), (
+            "%s on %s should lint clean, got: %s"
+            % (builder_name, model_name,
+               [d.format() for d in errors(diags)]))
+
+
+@pytest.mark.parametrize("example,builder_name", [
+    ("tp_lm", "TensorParallel"),
+    ("pipe_lm", "PipelineParallel"),
+    ("moe_lm", "ExpertParallel"),
+])
+def test_mp_builders_lint_clean(example, builder_name):
+    spec = spec_2x2()
+    loss_fn, params, batch, mp_rules = cli.EXAMPLES[example]()
+    item = ModelItem(loss_fn=loss_fn, params=params,
+                     example_batch=batch).prepare()
+    strategy = cli._builders(mp_rules)[builder_name]().build(item, spec)
+    diags = verify(strategy, item, spec)
+    assert not errors(diags), [d.format() for d in errors(diags)]
+
+
+# ----------------------------------------------------- 2. mutation tests
+
+
+class DictItem:
+    """Minimal model-item stand-in: a var_infos dict is all the builders
+    and the verifier need."""
+
+    def __init__(self, infos):
+        self.var_infos = dict(infos)
+
+    @property
+    def trainable_var_names(self):
+        return [n for n, v in self.var_infos.items() if v.trainable]
+
+
+def clean_strategy(item, spec=None):
+    from autodist_tpu.strategy import AllReduce
+    if isinstance(item, dict):
+        item = DictItem(item)
+    return AllReduce().build(item, spec or spec_2x2())
+
+
+def _mutations(item):
+    """(name, mutate(strategy), expected code). Every plan starts from
+    the lint-clean AllReduce build of the embedding model."""
+    emb_dim0 = item.var_infos["embedding"].shape[0]
+
+    def m_drop_node(s):
+        s.node_config = [n for n in s.node_config
+                         if n.var_name != "embedding"]
+
+    def m_duplicate(s):
+        s.node_config.append(copy.deepcopy(s.node_config[0]))
+
+    def m_no_replicas(s):
+        s.graph_config.replicas = []
+
+    def m_bogus_replica(s):
+        s.graph_config.replicas[0] = "10.9.9.9:TPU:0"
+
+    def m_mesh_mismatch(s):
+        s.graph_config.mesh_shape = {const.DATA_AXIS: 3,
+                                     const.MODEL_AXIS: 2}
+
+    def m_no_sync(s):
+        s.find("embedding").synchronizer = None
+
+    def m_partitioner_dangling(s):
+        s.find("embedding").partitioner = "4,"
+
+    def m_partitioner_alpha(s):
+        s.find("embedding").partitioner = "a,1"
+
+    def m_partitioner_rank(s):
+        s.find("embedding").partitioner = "2,1,1"
+
+    def m_partitioner_multi_axis(s):
+        s.find("embedding").partitioner = "2,2"
+
+    def m_shard_sizes(s):
+        node = s.find("embedding")
+        node.partitioner = "2,1"
+        node.shard_sizes = [1, 2]  # sums to 3, dim is emb_dim0
+
+    def m_ps_empty_dest(s):
+        s.find("embedding").synchronizer = PSSynchronizer()
+
+    def m_ps_bad_dest(s):
+        s.find("embedding").synchronizer = PSSynchronizer(
+            reduction_destination="10.9.9.9:CPU:0")
+
+    def m_stale_async(s):
+        s.find("embedding").synchronizer = PSSynchronizer(
+            reduction_destination="127.0.0.1:CPU:0", sync=False,
+            staleness=2)
+
+    def m_bad_compressor(s):
+        s.find("embedding").synchronizer = AllReduceSynchronizer(
+            compressor="GzipCompressor")
+
+    def m_mixed_async(s):
+        s.find("embedding").synchronizer = PSSynchronizer(
+            reduction_destination="127.0.0.1:CPU:0", sync=False)
+        # the other vars stay AllReduce -> not all-or-nothing
+
+    def m_mp_unknown_axis(s):
+        s.find("embedding").synchronizer = None
+        s.find("embedding").mp_axes = {0: const.MODEL_AXIS}  # no mesh
+
+    def m_mp_indivisible(s):
+        s.graph_config.mesh_shape = {const.DATA_AXIS: 2,
+                                     const.MODEL_AXIS: 2}
+        node = s.find("dense/bias")  # shape (1,): 1 % 2 != 0
+        node.synchronizer = None
+        node.mp_axes = {0: const.MODEL_AXIS}
+
+    def m_mp_duplicate_axis(s):
+        s.graph_config.mesh_shape = {const.DATA_AXIS: 2,
+                                     const.MODEL_AXIS: 2}
+        node = s.find("embedding")
+        node.synchronizer = None
+        node.mp_axes = {0: const.MODEL_AXIS, 1: const.MODEL_AXIS}
+
+    def m_interleaved(s):
+        s.graph_config.mesh_shape = {const.PIPELINE_AXIS: 2,
+                                     const.DATA_AXIS: 2}
+        s.graph_config.pp_schedule = "interleaved"
+        s.graph_config.pp_microbatches = 3  # 3 % 2 != 0
+        s.graph_config.pp_virtual = 2
+
+    def m_sparse_dense(s):
+        node = s.find("embedding")
+        node.partitioner = "2,1"
+        node.synchronizer = None
+        node.part_configs = [
+            VarConfig("embedding/part_%d" % i, AllReduceSynchronizer())
+            for i in range(2)]
+        s.graph_config.require_sparse = True
+
+    assert emb_dim0 != 3  # m_shard_sizes relies on a wrong sum
+    return [
+        ("drop_node", m_drop_node, "ADT101"),
+        ("duplicate_node", m_duplicate, "ADT103"),
+        ("no_replicas", m_no_replicas, "ADT104"),
+        ("bogus_replica", m_bogus_replica, "ADT105"),
+        ("mesh_mismatch", m_mesh_mismatch, "ADT106"),
+        ("no_synchronizer", m_no_sync, "ADT108"),
+        ("partitioner_dangling", m_partitioner_dangling, "ADT201"),
+        ("partitioner_alpha", m_partitioner_alpha, "ADT201"),
+        ("partitioner_rank", m_partitioner_rank, "ADT202"),
+        ("partitioner_multi_axis", m_partitioner_multi_axis, "ADT204"),
+        ("shard_sizes", m_shard_sizes, "ADT208"),
+        ("ps_empty_dest", m_ps_empty_dest, "ADT302"),
+        ("ps_bad_dest", m_ps_bad_dest, "ADT303"),
+        ("stale_async", m_stale_async, "ADT304"),
+        ("bad_compressor", m_bad_compressor, "ADT305"),
+        ("mixed_async", m_mixed_async, "ADT307"),
+        ("mp_unknown_axis", m_mp_unknown_axis, "ADT205"),
+        ("mp_indivisible", m_mp_indivisible, "ADT206"),
+        ("mp_duplicate_axis", m_mp_duplicate_axis, "ADT207"),
+        ("interleaved_microbatches", m_interleaved, "ADT402"),
+        ("sparse_dense_wire", m_sparse_dense, "ADT309"),
+    ]
+
+
+def test_mutation_names_unique(emb_item):
+    muts = _mutations(emb_item)
+    names = [m[0] for m in muts]
+    assert len(set(names)) == len(names) and len(muts) >= 8
+
+
+def test_clean_baseline_has_no_errors(emb_item):
+    assert not errors(verify(clean_strategy(emb_item), emb_item, spec_2x2()))
+
+
+def test_each_mutation_fires_expected_code(emb_item):
+    spec = spec_2x2()
+    for name, mutate, code in _mutations(emb_item):
+        s = clean_strategy(emb_item, spec)
+        mutate(s)
+        diags = verify(s, emb_item, spec)
+        assert code in codes(errors(diags)), (
+            "mutation %r should raise %s, got %s"
+            % (name, code, [d.format() for d in diags]))
+
+
+def test_cli_rejects_each_mutation(emb_item, tmp_path, capsys):
+    """>= 8 mutation-broken plans through the REAL CLI entry point:
+    nonzero exit and the expected ADT code in the table."""
+    spec = spec_2x2()
+    ran = 0
+    for name, mutate, code in _mutations(emb_item):
+        s = clean_strategy(emb_item, spec)
+        mutate(s)
+        try:
+            path = s.serialize(str(tmp_path / name))
+        except ValueError:
+            continue  # mutations the serializer itself rejects
+        rc = cli.main(["sentiment_classifier", "--strategy-json", path])
+        out = capsys.readouterr().out
+        assert rc == 1, "CLI should exit 1 for mutation %r" % name
+        assert code in out, (name, code, out)
+        ran += 1
+    assert ran >= 8
+
+
+def test_warning_rules_fire(emb_item):
+    """Hazard rules that warn rather than error: pipeline bubble (401),
+    PS load skew (403), no-op staleness window (404), compressor on a
+    non-float dtype (306), undersized split dim (203)."""
+    from autodist_tpu.model_item import VarInfo
+    spec = spec_2x2()
+
+    s = clean_strategy(emb_item, spec)
+    s.graph_config.mesh_shape = {const.PIPELINE_AXIS: 2, const.DATA_AXIS: 2}
+    s.graph_config.pp_schedule = "gpipe"
+    s.graph_config.pp_microbatches = 1
+    diags = verify(s, emb_item, spec)
+    assert "ADT401" in codes(diags) and not errors(diags)
+
+    two_node = ResourceSpec.from_dict({"nodes": [
+        {"address": "10.0.0.1", "chief": True, "tpus": 2},
+        {"address": "10.0.0.2", "tpus": 2}]})
+    infos = {"big": VarInfo("big", (4096, 64), "float32"),
+             "small": VarInfo("small", (4,), "float32")}
+    skewed = clean_strategy(infos, two_node)
+    skewed.find("big").synchronizer = PSSynchronizer(
+        reduction_destination="10.0.0.1:CPU:0")
+    skewed.find("small").synchronizer = PSSynchronizer(
+        reduction_destination="10.0.0.2:CPU:0")
+    assert "ADT403" in codes(verify(skewed, infos, two_node))
+
+    s = clean_strategy(emb_item, spec)
+    s.find("embedding").synchronizer = PSSynchronizer(
+        reduction_destination="127.0.0.1:CPU:0", sync=True, staleness=2)
+    assert "ADT404" in codes(verify(s, emb_item, spec))
+
+    int_infos = {"steps": VarInfo("steps", (8, 8), "int32")}
+    s = clean_strategy(int_infos, spec)
+    s.find("steps").synchronizer = AllReduceSynchronizer(
+        compressor="BF16Compressor")
+    diags = verify(s, int_infos, spec)
+    assert "ADT306" in codes(diags) and not errors(diags)
+
+    tiny = {"t": VarInfo("t", (2, 8), "float32")}
+    s = clean_strategy(tiny, spec)
+    s.find("t").partitioner = "4,1"
+    assert "ADT203" in codes(verify(s, tiny, spec))
+
+
+# ------------------------------------------------------------ 3. CLI exit
+
+
+def test_cli_clean_combo_exits_zero(capsys):
+    rc = cli.main(["linear_regression", "--strategy", "PS"])
+    assert rc == 0
+    assert "plan is clean" in capsys.readouterr().out
+
+
+def test_cli_json_output(capsys):
+    rc = cli.main(["linear_regression", "--strategy", "AllReduce", "--json"])
+    assert rc == 0
+    import json
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["errors"] == 0 and doc["strategy"] == "AllReduce"
+
+
+def test_cli_usage_errors():
+    assert cli.main([]) == 2
+    assert cli.main(["nope", "--strategy", "PS"]) == 2
+    assert cli.main(["linear_regression", "--strategy", "Bogus"]) == 2
+    assert cli.main(["linear_regression", "--strategy", "TensorParallel"]) == 2
+
+
+@pytest.mark.slow
+def test_cli_subprocess_exit_codes(tmp_path, emb_item):
+    """The module entry point itself: exit 0 on a clean combo, 1 on a
+    broken plan."""
+    import os
+    import subprocess
+    import sys
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run(
+        [sys.executable, "-m", "autodist_tpu.analysis", "linear_regression",
+         "--strategy", "PS"], env=env, capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    s = clean_strategy(emb_item)
+    s.find("embedding").partitioner = "4,"
+    path = s.serialize(str(tmp_path / "broken"))
+    r = subprocess.run(
+        [sys.executable, "-m", "autodist_tpu.analysis",
+         "sentiment_classifier", "--strategy-json", path],
+        env=env, capture_output=True, text=True)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "ADT201" in r.stdout
+
+
+# ---------------------------------------- 4. shared rules on compile path
+
+
+def test_varconfig_malformed_partitioner_raises_adt201():
+    for bad in ("4,", "a,1", "0,2", ","):
+        node = VarConfig("w", partitioner=bad)
+        with pytest.raises(ValueError) as ei:
+            node.num_shards
+        assert getattr(ei.value, "code", None) == "ADT201", bad
+        with pytest.raises(ValueError):
+            node.partition_axis
+
+
+def test_synchronizer_from_dict_names_kinds_and_var():
+    with pytest.raises(ValueError) as ei:
+        synchronizer_from_dict({"kind": "Gossip"}, var_name="dense/kernel")
+    msg = str(ei.value)
+    assert "Gossip" in msg and "dense/kernel" in msg
+    assert "PS" in msg and "AllReduce" in msg
+    assert getattr(ei.value, "code", None) == "ADT301"
+    # invalid fields for a known kind also name the variable
+    with pytest.raises(ValueError) as ei:
+        synchronizer_from_dict({"kind": "PS", "bogus_field": 1},
+                               var_name="emb")
+    assert "emb" in str(ei.value)
+
+
+def test_ps_synchronizer_empty_default_is_flagged(emb_item):
+    """PSSynchronizer() defaults to an empty reduction_destination; the
+    verifier must flag it (ADT302) rather than silently accepting."""
+    assert PSSynchronizer().reduction_destination == ""
+    s = clean_strategy(emb_item)
+    s.find("embedding").synchronizer = PSSynchronizer()
+    assert "ADT302" in codes(errors(verify(s, emb_item, spec_2x2())))
+
+
+def test_strategy_compiler_raises_adt101(emb_item):
+    s = clean_strategy(emb_item)
+    s.node_config = s.node_config[1:]
+    with pytest.raises(ValueError) as ei:
+        StrategyCompiler(emb_item, spec_2x2()).compile(s)
+    assert getattr(ei.value, "code", None) == "ADT101"
+
+
+def test_partitioner_kernel_raises_same_code_as_lint(emb_item):
+    """VariablePartitioner._mp_layout and the ADT206 rule are the same
+    function — the compile error carries the lint code."""
+    from autodist_tpu.kernel.partitioner import VariablePartitioner
+    s = clean_strategy(emb_item)
+    node = s.find("dense/bias")
+    node.synchronizer = None
+    node.mp_axes = {0: const.MODEL_AXIS}
+    s.graph_config.mesh_shape = {const.DATA_AXIS: 2, const.MODEL_AXIS: 2}
+    with pytest.raises(ValueError) as ei:
+        VariablePartitioner.apply(
+            s, emb_item.var_infos, 2,
+            mesh_axis_sizes={const.DATA_AXIS: 2, const.MODEL_AXIS: 2})
+    assert getattr(ei.value, "code", None) == "ADT206"
+    assert "ADT206" in codes(verify(s, emb_item, spec_2x2()))
+
+
+# ------------------------------------------------------- 5. simulator gate
+
+
+def test_simulator_skips_unverifiable_candidates(emb_item):
+    from autodist_tpu.simulator.simulator import Simulator
+    spec = spec_2x2()
+    good = clean_strategy(emb_item, spec)
+    broken = clean_strategy(emb_item, spec)
+    broken.find("embedding").synchronizer = AllReduceSynchronizer(
+        compressor="GzipCompressor")
+    sim = Simulator(emb_item, spec)
+    ranking = sim.rank([("good", good), ("broken", broken)])
+    assert [r.label for r in ranking] == ["good"]
+    # all-broken: ranking still returns (unverified, with a warning)
+    ranking = sim.rank([("broken", broken)])
+    assert [r.label for r in ranking] == ["broken"]
+
+
+def test_autostrategy_still_picks_under_verification(emb_item):
+    from autodist_tpu.strategy import AutoStrategy
+    s = AutoStrategy().build(emb_item, spec_2x2())
+    assert not errors(verify(s, emb_item, spec_2x2()))
+
+
+# ------------------------------------------------------ 6. lowered pass
+
+
+def test_lowered_flags_full_gather_of_mp_param():
+    text = """
+  func.func @main(%arg0: tensor<4x16xf32>) -> tensor<8x16xf32> {
+    %0 = "stablehlo.all_gather"(%arg0) : (tensor<4x16xf32>) -> tensor<8x16xf32>
+    return %0 : tensor<8x16xf32>
+  }
+"""
+    diags = lint_lowered_text(text, mp_full_shapes={"wq": (8, 16)})
+    assert "ADT405" in codes(diags)
+    # without a matching full shape: no finding
+    assert "ADT405" not in codes(
+        lint_lowered_text(text, mp_full_shapes={"wq": (32, 16)}))
+
+
+def test_lowered_flags_host_transfer():
+    text = 'x = "stablehlo.custom_call"() {call_target_name = "SendToHost"}'
+    assert "ADT406" in codes(lint_lowered_text(text))
+    assert "ADT406" not in codes(lint_lowered_text("stablehlo.add"))
+
+
+def test_lowered_flags_collective_in_branch():
+    text = """
+  %1 = "stablehlo.if"(%pred) ({
+    %2 = "stablehlo.all_reduce"(%arg0) : (tensor<4xf32>) -> tensor<4xf32>
+    stablehlo.return %2 : tensor<4xf32>
+  }, {
+    stablehlo.return %arg0 : tensor<4xf32>
+  }) : (tensor<i1>) -> tensor<4xf32>
+"""
+    assert "ADT407" in codes(lint_lowered_text(text))
+    flat = '%2 = "stablehlo.all_reduce"(%arg0) : (tensor<4xf32>) -> tensor<4xf32>'
+    assert "ADT407" not in codes(lint_lowered_text(flat))
+
+
+def test_lowered_flags_collective_in_jaxpr_cond():
+    """jaxpr dumps spell conditionals `cond[branches=(...)]` — with the
+    braces on the same line or later lines — and must flag ADT407 too."""
+    one_line = "e:f32[4] = cond[branches=({ lambda ; a:f32[4]. let " \
+               "b:f32[4] = psum[axes=('data',)] a in (b,) })] c d"
+    assert "ADT407" in codes(lint_lowered_text(one_line))
+    multi_line = """
+e:f32[4] = cond[
+  branches=(
+    { lambda ; a:f32[4]. let
+        b:f32[4] = psum[axes=('data',)] a
+      in (b,) }
+  )
+] c d
+"""
+    assert "ADT407" in codes(lint_lowered_text(multi_line))
+    assert "ADT407" not in codes(
+        lint_lowered_text("b:f32[4] = psum[axes=('data',)] a"))
+
+
+def test_cli_strategy_json_deserialize_defect_exits_one(tmp_path, capsys):
+    """A plan whose defect surfaces at DESERIALIZE time (unknown
+    synchronizer kind) is still an ADT finding: exit 1 with ADT301 in
+    the table, not the exit-2 tooling-failure path."""
+    import json as json_lib
+    doc = {"id": "x", "graph_config": {"replicas": ["127.0.0.1:TPU:0"]},
+           "node_config": [{"var_name": "w",
+                            "synchronizer": {"kind": "Gossip"}}]}
+    path = tmp_path / "gossip.json"
+    path.write_text(json_lib.dumps(doc))
+    rc = cli.main(["sentiment_classifier", "--strategy-json", str(path)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "ADT301" in out
+
+
+def test_runner_lint_lowered_end_to_end():
+    """Real build: Runner.lowered_text + lint on the 8-device CPU mesh."""
+    import numpy as np
+    import optax
+    import autodist_tpu
+    from autodist_tpu import strategy as S
+
+    params = {"w": jnp.zeros((8, 4)), "b": jnp.zeros((4,))}
+    loss_fn = lambda p, b: jnp.mean((b["x"] @ p["w"] + p["b"]) ** 2)  # noqa: E731
+    batch = {"x": np.zeros((16, 8), np.float32)}
+    ad = autodist_tpu.AutoDist(strategy_builder=S.AllReduce(),
+                               validate="error")
+    runner = ad.build(loss_fn, optax.sgd(0.1), params, batch)
+    runner.init(params)
+    text = runner.lowered_text(batch)
+    assert "stablehlo" in text or "func" in text
+    diags = runner.lint_lowered(batch)
+    assert not [d for d in diags if d.code == "ADT405"]
+
+
+# --------------------------------------------- 7. AutoDist validate modes
+
+
+def test_autodist_validate_error_raises(emb_item):
+    import autodist_tpu
+    from autodist_tpu.strategy.base import StrategyBuilder
+
+    class Broken(StrategyBuilder):
+        def build(self, model_item, resource_spec):
+            from autodist_tpu.strategy import AllReduce
+            s = AllReduce().build(model_item, resource_spec)
+            s.node_config[0].synchronizer = AllReduceSynchronizer(
+                compressor="GzipCompressor")
+            return s
+
+    loss_fn, params, batch, _ = cli.EXAMPLES["linear_regression"]()
+    ad = autodist_tpu.AutoDist(strategy_builder=Broken(), validate="error")
+    import optax
+    with pytest.raises(StrategyVerificationError) as ei:
+        ad.build(loss_fn, optax.sgd(0.1), params, batch)
+    assert any(d.code == "ADT305" for d in ei.value.diagnostics)
+
+
+def test_autodist_validate_rejects_bad_mode():
+    import autodist_tpu
+    with pytest.raises(ValueError):
+        autodist_tpu.AutoDist(validate="loud")
