@@ -183,4 +183,5 @@ CODES = {
     "ADT405": "lowered program all-gathers a model-parallel parameter",
     "ADT406": "lowered program transfers to host on the hot path",
     "ADT407": "collective under divergent control flow",
+    "ADT408": "host transfer inside a while/scan body (per-iteration cost)",
 }

@@ -18,6 +18,11 @@ jaxpr pretty-print — for hazards no plan-level rule can see:
   ever differs across replicas, the collective deadlocks — the
   mis-sharded-collective hang this framework's fault harness exists to
   catch at runtime, surfaced at lint time instead.
+- ``ADT408``: a host transfer inside a loop body (``stablehlo.while``,
+  jaxpr ``scan``/``while``) — in the fused multi-step program
+  (``Runner.lowered_text(..., fuse_steps=k)``) the loop body IS the
+  microstep, so one such transfer serializes every microstep on PCIe and
+  undoes exactly the k× host-round-trip saving fusion exists for.
 
 Text-based on purpose: it works on any ``as_text()`` dump (including ones
 saved from a real TPU run) without re-lowering, and it has no opinion
@@ -50,6 +55,9 @@ _HOST_TOKENS = ("infeed", "outfeed", "send_to_host", "recv_from_host",
 _TENSOR_RE = re.compile(r"tensor<([0-9]+(?:x[0-9]+)*)x[a-z][a-z0-9]*>")
 _BRANCH_TOKENS = ("stablehlo.if", "stablehlo.case", "mhlo.if", "mhlo.case",
                   "cond[", "cond ")
+# loop-region openers: StableHLO/MHLO while ops, jaxpr scan/while_loop
+# pretty-prints — the fused multi-step engine's microstep body lives here
+_LOOP_TOKENS = ("stablehlo.while", "mhlo.while", "scan[", "while[")
 
 
 def _line_tensor_shapes(line: str) -> List[Tuple[int, ...]]:
@@ -70,20 +78,26 @@ def lint_lowered_text(text: str,
     out: List[Diagnostic] = []
     full_shapes = {tuple(int(d) for d in shape): name
                    for name, shape in (mp_full_shapes or {}).items()}
-    # depth of every open if/case region, tracked by brace nesting; a
-    # branch opener whose braces land on a LATER line (jaxpr ``cond[``
-    # pretty-prints this way) is held pending until its first ``{``
+    # depth of every open if/case (and while/scan) region, tracked by
+    # brace nesting; an opener whose braces land on a LATER line (jaxpr
+    # ``cond[``/``scan[`` pretty-print this way) is held pending until
+    # its first ``{``
     brace_depth = 0
     branch_starts: List[int] = []
+    loop_starts: List[int] = []
     pending_branch = False
+    pending_loop = False
     flagged_branch = False
     seen_host: set = set()
+    seen_loop_host: set = set()
     seen_gather: set = set()
     for lineno, line in enumerate(text.splitlines(), 1):
         lowered_line = line.strip()
         is_branch_open = any(tok in line for tok in _BRANCH_TOKENS)
+        is_loop_open = any(tok in line for tok in _LOOP_TOKENS)
         has_collective = any(tok in line for tok in COLLECTIVE_TOKENS)
         in_branch = (branch_starts or pending_branch or is_branch_open)
+        in_loop = (loop_starts or pending_loop or is_loop_open)
         if in_branch and has_collective and not flagged_branch:
             out.append(warning(
                 "ADT407",
@@ -108,7 +122,28 @@ def lint_lowered_text(text: str,
                         fixit="check the model's mp_rules cover every "
                               "consumer of this variable"))
         for tok in _HOST_TOKENS:
-            if tok in line and tok not in seen_host:
+            if tok not in line:
+                continue
+            if in_loop:
+                # inside a while/scan body the transfer repeats PER
+                # ITERATION — the more specific ADT408 supersedes ADT406
+                # here (docs/linting.md). In the fused multi-step program
+                # the loop body IS the microstep, so this is the exact
+                # per-step host round-trip fusion exists to remove.
+                if tok not in seen_loop_host:
+                    seen_loop_host.add(tok)
+                    out.append(warning(
+                        "ADT408",
+                        "host transfer inside a while/scan body (%s, line "
+                        "%d) — it repeats every iteration; in a fused "
+                        "multi-step program that is a per-microstep PCIe "
+                        "round-trip, undoing the superstep fusion"
+                        % (tok, lineno),
+                        fixit="hoist the transfer out of the loop; in the "
+                              "fused engine, pull PS values once per "
+                              "superstep (the fused carry), never per "
+                              "microstep"))
+            elif tok not in seen_host:
                 seen_host.add(tok)
                 out.append(warning(
                     "ADT406",
@@ -117,14 +152,23 @@ def lint_lowered_text(text: str,
                     fixit="keep the step device-resident; host-PS pulls "
                           "belong in the store, not the compiled step"))
         opens = line.count("{")
-        if (is_branch_open or pending_branch) and opens > 0:
-            branch_starts.append(brace_depth)
-            pending_branch = False
-        elif is_branch_open:
-            pending_branch = True  # braces arrive on a later line
+        if opens > 0:
+            if is_branch_open or pending_branch:
+                branch_starts.append(brace_depth)
+                pending_branch = False
+            if is_loop_open or pending_loop:
+                loop_starts.append(brace_depth)
+                pending_loop = False
+        else:
+            if is_branch_open:
+                pending_branch = True  # braces arrive on a later line
+            if is_loop_open:
+                pending_loop = True
         brace_depth += opens - line.count("}")
         while branch_starts and brace_depth <= branch_starts[-1]:
             branch_starts.pop()
+        while loop_starts and brace_depth <= loop_starts[-1]:
+            loop_starts.pop()
     return sort_diagnostics(out)
 
 
@@ -142,10 +186,14 @@ def mp_full_shapes_of(distributed_step) -> Dict[str, Tuple[int, ...]]:
     return out
 
 
-def lint_runner(runner, batch, state=None) -> List[Diagnostic]:
+def lint_runner(runner, batch, state=None,
+                fuse_steps: int = 1) -> List[Diagnostic]:
     """Lower the runner's step for ``batch`` and lint the StableHLO.
 
     The single implementation behind ``Runner.lint_lowered`` — keep the
-    two entry points from drifting."""
-    text = runner.lowered_text(batch, state)
+    two entry points from drifting. ``fuse_steps=k > 1`` lints the fused
+    k-microstep scan program instead: its scan body is the microstep, so
+    ADT408 findings there mean a per-microstep host round-trip survived
+    the fusion."""
+    text = runner.lowered_text(batch, state, fuse_steps=fuse_steps)
     return lint_lowered_text(text, mp_full_shapes_of(runner.distributed_step))

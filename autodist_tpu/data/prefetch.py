@@ -10,6 +10,34 @@ a transfer queue, no threads needed; the native loader's worker threads
 import collections
 from typing import Callable, Iterable, Iterator
 
+import numpy as np
+
+
+def stack_batches(group):
+    """Stack a list of same-structure batches into one ``[k, ...]`` feed
+    (the fused engine's input shape). Device-resident leaves stack on
+    device (``jnp.stack`` — no host round-trip); host leaves via
+    ``np.stack``. The ONE stacking rule, shared by
+    :class:`DevicePrefetcher`'s stack mode and ``Runner.fit``'s grouping
+    path."""
+    import jax
+
+    def stack(*ls):
+        if isinstance(ls[0], jax.Array):
+            if not all(getattr(l, "is_fully_addressable", True)
+                       for l in ls):
+                # a multi-process global array cannot be re-stacked
+                # process-locally; jnp.stack's raw error would not say
+                # what to do about it
+                raise ValueError(
+                    "cannot stack multi-process global arrays into a "
+                    "fused [k, ...] feed — feed host numpy batches, or "
+                    "pre-stack with DevicePrefetcher(stack=k) so the "
+                    "placement happens once, already stacked")
+            return jax.numpy.stack(ls)
+        return np.stack([np.asarray(l) for l in ls])
+    return jax.tree_util.tree_map(stack, *group)
+
 
 class DevicePrefetcher:
     """Wraps a host-batch iterator; yields device-resident (mesh-sharded)
@@ -21,11 +49,32 @@ class DevicePrefetcher:
         pf = DevicePrefetcher(dataset, runner, depth=2)
         for batch in pf:                      # already on the mesh
             metrics = runner.run(batch)       # remap_feed is a no-op here
+
+    ``stack=k`` (> 1) is the fused-engine feed mode: k consecutive host
+    batches are stacked into ONE ``[k, ...]`` feed and placed via
+    ``remapper.remap_feed_stack`` — the whole superstep's data lands in a
+    single transfer, issued behind the previous superstep's compute:
+
+        pf = DevicePrefetcher(dataset, runner, depth=2, stack=4)
+        runner.fit(pf, fuse_steps=4, metrics_every=8)
+
+    (``fit`` recognizes a matching ``stack_k`` and consumes the items
+    whole instead of re-grouping.) A trailing group smaller than k is
+    dropped with a warning — a smaller stack would force a recompile of
+    the fused program.
     """
 
-    def __init__(self, iterable: Iterable, runner_or_place, depth: int = 2):
+    def __init__(self, iterable: Iterable, runner_or_place, depth: int = 2,
+                 stack: int = 1):
+        if stack < 1:
+            raise ValueError("stack must be >= 1")
+        self.stack_k = stack
         if callable(runner_or_place):
+            # custom placement callable: in stack mode it receives the
+            # already-stacked [k, ...] host batch
             self._place: Callable = runner_or_place
+        elif stack > 1:
+            self._place = runner_or_place.remapper.remap_feed_stack
         else:
             self._place = runner_or_place.remapper.remap_feed
         if depth < 1:
@@ -35,10 +84,32 @@ class DevicePrefetcher:
         self._queue = collections.deque()
         self._exhausted = False
 
+    def _next_host_item(self):
+        """One queue item's host batch: a plain batch, or a [k, ...]
+        stacked group in stack mode. Raises StopIteration when done."""
+        if self.stack_k == 1:
+            return next(self._it)
+        group = []
+        for _ in range(self.stack_k):
+            try:
+                group.append(next(self._it))
+            except StopIteration:
+                break
+        if not group:
+            raise StopIteration
+        if len(group) < self.stack_k:
+            from autodist_tpu.utils import logging
+            logging.warning(
+                "DevicePrefetcher(stack=%d): dropping trailing group of "
+                "%d batch(es) — a short stack would recompile the fused "
+                "program", self.stack_k, len(group))
+            raise StopIteration
+        return stack_batches(group)
+
     def _fill(self):
         while not self._exhausted and len(self._queue) < self._depth:
             try:
-                host_batch = next(self._it)
+                host_batch = self._next_host_item()
             except StopIteration:
                 self._exhausted = True
                 return

@@ -53,7 +53,8 @@ class DistributedStep:
                  sync_state_init: Callable, metadata: Optional[dict] = None,
                  step_fn_nodonate: Optional[Callable] = None,
                  eval_fn: Optional[Callable] = None,
-                 ps_store=None, holed_params_template=None):
+                 ps_store=None, holed_params_template=None,
+                 fused_builder: Optional[Callable] = None):
         self.mesh = mesh
         self.mesh_axis = mesh_axis
         self.all_axes = tuple(mesh.axis_names)
@@ -76,6 +77,21 @@ class DistributedStep:
         self._holed_template = (holed_params_template
                                 if holed_params_template is not None
                                 else model_item.params)
+        # fused multi-step engine: ``fused_builder(donate)`` returns a
+        # jitted program scanning k microsteps over a stacked [k, ...]
+        # batch (k is implicit in the input shape; XLA specializes per k)
+        self._fused_builder = fused_builder
+        self._fused_jits: Dict[bool, Callable] = {}
+        # device-resident PS carry for the fused engine: full values +
+        # per-var little-tree optimizer states, written back to the host
+        # store only at sync points (flush_ps) instead of every step
+        self._fused_ps_vals = None
+        self._fused_ps_opt = None
+        self._fused_ps_dirty = False
+        # jitted-dispatch counter: one per __call__ / run_multi — the
+        # honest "host round-trips per training job" number bench and the
+        # fused-parity tests assert on
+        self.dispatches = 0
 
     # ---------------------------------------------------------- ps data path
 
@@ -94,15 +110,23 @@ class DistributedStep:
                     self.ps_store, self.mesh, stale_ok)
         return self._ps_pipe_obj
 
-    def _pull_ps(self) -> dict:
+    def pull_ps(self) -> dict:
         """Host -> device transfer of the current PS values (the per-step
-        parameter read from the PS; empty when no var is host-resident)."""
+        parameter read from the PS; empty when no var is host-resident).
+        Public: eval loops pull once and reuse the snapshot across batches
+        (``Runner.evaluate``). A dirty fused-superstep carry is written
+        back to the store first, so the pull always reflects every
+        microstep that ran."""
         if self.ps_store is None:
             return {}
+        self._flush_fused_ps()
         if self._ps_pipe is not None:
             return self._ps_pipe.values()
         from autodist_tpu.parallel.mesh import tree_to_mesh
         return tree_to_mesh(self.mesh, self.ps_store.pull(), P())
+
+    # back-compat spelling (promoted to the public name above)
+    _pull_ps = pull_ps
 
     def _push_ps(self, ps_grads: dict) -> None:
         """Device -> host transfer of the reduced PS gradients + host-side
@@ -122,36 +146,173 @@ class DistributedStep:
         return getattr(self, "_ps_pipe_obj", None)
 
     def flush_ps(self) -> None:
-        """Wait for any in-flight pipelined push — every store read
-        (checkpoint, gather, mirror digest) must see all submitted
-        gradients applied."""
-        if self.ps_store is not None and self._ps_pipe_existing is not None:
+        """Wait for any in-flight pipelined push AND write back the fused
+        engine's device-resident PS carry — every store read (checkpoint,
+        gather, mirror digest) must see all submitted gradients applied."""
+        if self.ps_store is None:
+            return
+        if self._ps_pipe_existing is not None:
             self._ps_pipe_existing.flush()
+        self._flush_fused_ps()
 
     def invalidate_ps(self) -> None:
-        """Flush and discard the pipeline's staged values — call whenever
-        the store's contents are replaced out of band (restore/re-init)."""
-        if self.ps_store is not None and self._ps_pipe_existing is not None:
+        """Flush and discard the pipeline's staged values and the fused
+        carry — call whenever the store's contents are replaced out of
+        band (restore/re-init). The carry is DROPPED, not written back:
+        out-of-band replacement means the store, not the carry, is now
+        authoritative."""
+        if self.ps_store is None:
+            return
+        self._fused_ps_vals = self._fused_ps_opt = None
+        self._fused_ps_dirty = False
+        if self._ps_pipe_existing is not None:
             self._ps_pipe_existing.invalidate()
 
+    # ------------------------------------------------- fused multi-step
+
+    def _ensure_fused_ps_carry(self):
+        """Device-resident (values, opt-states) carry for the fused
+        engine. First superstep (or first after a flush): land in-flight
+        per-step pushes, then pull full values and per-var little-tree
+        optimizer states from the store — ONE H2D transfer per fused run
+        sequence instead of one per step."""
+        if self.ps_store is None:
+            return {}, {}
+        if self._fused_ps_vals is None:
+            self.flush_ps()
+            from autodist_tpu.parallel.mesh import tree_to_mesh
+            self._fused_ps_vals = tree_to_mesh(
+                self.mesh, self.ps_store.pull(), P())
+            self._fused_ps_opt = tree_to_mesh(
+                self.mesh,
+                {n: self.ps_store.full_little_opt(n)
+                 for n in self.ps_store.var_names}, P())
+        return self._fused_ps_vals, self._fused_ps_opt
+
+    def _flush_fused_ps(self) -> None:
+        """Write the fused carry back to the host store (values + per-shard
+        optimizer states) and drop it — the store is authoritative again.
+        The per-step pipeline's staged pull predates the writeback, so it
+        is invalidated too."""
+        if not self._fused_ps_dirty:
+            return
+        vals, opt = self._fused_ps_vals, self._fused_ps_opt
+        self._fused_ps_vals = self._fused_ps_opt = None
+        self._fused_ps_dirty = False
+        self.ps_store.absorb_device_state(jax.device_get(vals),
+                                          jax.device_get(opt))
+        if self._ps_pipe_existing is not None:
+            self._ps_pipe_existing.invalidate()
+
+    def _fused_fn(self, donate: bool = True) -> Callable:
+        if self._fused_builder is None:
+            raise NotImplementedError(
+                "this DistributedStep was built without a fused-scan "
+                "lowering path")
+        if self.ps_store is not None and (
+                self.ps_store.serving or self.ps_store.any_async()
+                or self.ps_store.max_staleness() > 0):
+            raise ValueError(
+                "fused multi-step requires synchronous host-PS: async "
+                "serving / staleness>0 let peers' applies land BETWEEN "
+                "microsteps, which a scan compiled around a superstep-"
+                "start snapshot cannot observe. Run per-step, or use "
+                "sync=True staleness=0 PS (or an AllReduce strategy).")
+        if (self.ps_store is not None and not self._fused_jits
+                and any(p.partitioned for p in self.ps_store.plans.values())):
+            # the host store applies the optimizer PER SHARD; the fused
+            # device emulation applies it per FULL variable. Identical for
+            # elementwise transforms (sgd/adam/...), but a shard-shape-
+            # sensitive transform (per-tree norm clipping) would diverge —
+            # say so once instead of silently changing numerics.
+            logging.warning(
+                "fused multi-step with a PARTITIONED host-PS store: the "
+                "device emulation applies the optimizer per full variable "
+                "while the per-step host path applies it per shard — "
+                "identical for elementwise optimizers, but norm-based "
+                "transforms (e.g. clip_by_global_norm) may differ from "
+                "the per-step loop; verify parity for your optimizer")
+        if donate not in self._fused_jits:
+            self._fused_jits[donate] = self._fused_builder(donate)
+        return self._fused_jits[donate]
+
+    def multi_step(self, k: int, donate: bool = True) -> Callable:
+        """The fused k-microstep program: ONE donated jitted dispatch
+        running ``k`` steps under ``lax.scan`` over a stacked ``[k, ...]``
+        batch. Gradient collectives, PS pull/push (device-emulated against
+        the superstep-start snapshot, exact for sync PS), and optimizer
+        applies all stay inside the program; metrics come back stacked
+        ``[k, ...]`` once per superstep.
+
+        Returns ``fused(state, ps_vals, ps_opt, stacked_batch) ->
+        (new_state, new_ps_vals, new_ps_opt, stacked_metrics)``. Most
+        callers want :meth:`run_multi`, which also manages the PS carry."""
+        if k < 1:
+            raise ValueError("multi_step needs k >= 1, got %d" % k)
+        fn = self._fused_fn(donate)
+
+        def fused(state, ps_vals, ps_opt, stacked_batch):
+            lead = {int(np.shape(l)[0])
+                    for l in jax.tree_util.tree_leaves(stacked_batch)}
+            if lead and lead != {k}:
+                raise ValueError(
+                    "multi_step(k=%d) fed a stacked batch with leading "
+                    "dim(s) %s" % (k, sorted(lead)))
+            return fn(state, ps_vals, ps_opt, stacked_batch)
+        return fused
+
+    def run_multi(self, state: TrainState, stacked_batch,
+                  donate: bool = True):
+        """Run one superstep (k = the stacked batch's leading dim) and
+        manage the PS carry: pull once before the first superstep, keep
+        values/opt device-resident across supersteps, write back only at
+        ``flush_ps`` sync points. Returns ``(new_state, stacked_metrics)``
+        with metrics still device-resident — the caller decides when to
+        pay the readback."""
+        fn = self._fused_fn(donate)  # validates BEFORE any carry pull
+        lead = {int(np.shape(l)[0])
+                for l in jax.tree_util.tree_leaves(stacked_batch)}
+        if len(lead) > 1:
+            # catch ragged hand-built stacks here (the main execution
+            # path), not only in the multi_step() accessor — lax.scan's
+            # own shape error would be cryptic
+            raise ValueError(
+                "stacked batch has mismatched leading (microstep) dims %s"
+                % sorted(lead))
+        ps_vals, ps_opt = self._ensure_fused_ps_carry()
+        new_state, new_vals, new_opt, metrics = fn(
+            state, ps_vals, ps_opt, stacked_batch)
+        if self.ps_store is not None:
+            self._fused_ps_vals, self._fused_ps_opt = new_vals, new_opt
+            self._fused_ps_dirty = True
+        self.dispatches += 1
+        return new_state, metrics
+
     def close_ps(self) -> None:
-        """Flush the pipeline and shut its executors down (Runner.close);
-        a fresh pipeline is lazily created if stepping resumes."""
-        if self.ps_store is not None and self._ps_pipe_existing is not None:
+        """Flush the pipeline, land the fused carry, and shut the
+        executors down (Runner.close); a fresh pipeline is lazily created
+        if stepping resumes. The carry writeback matters here for the
+        same reason the pipeline flush does: a close right after fused
+        supersteps must not silently discard their PS updates."""
+        if self.ps_store is None:
+            return
+        if self._ps_pipe_existing is not None:
             self._ps_pipe_existing.close()
             # ``del`` (not ``= None``): the lazy property only constructs a
             # pipeline when the attribute is *missing*, so assigning None
             # would pin the serial path forever after a close.
             del self._ps_pipe_obj
+        self._flush_fused_ps()
 
     def __call__(self, state: TrainState, batch, donate: bool = True):
         """Run one step. ``donate=True`` (default) consumes ``state``'s
         buffers — callers holding their own reference to the input state must
         pass ``donate=False``."""
         fn = self._step_fn if donate else self._step_fn_nodonate
-        ps_vals = self._pull_ps()
+        ps_vals = self.pull_ps()
         new_state, ps_grads, metrics = fn(state, ps_vals, batch)
         self._push_ps(ps_grads)
+        self.dispatches += 1
         return new_state, metrics
 
     def evaluate(self, state: TrainState, batch, ps_vals=None):
@@ -163,7 +324,7 @@ class DistributedStep:
         params x 100 batches is 100 GB of transfer for unchanged
         values)."""
         if ps_vals is None:
-            ps_vals = self._pull_ps()
+            ps_vals = self.pull_ps()
         if self._eval_fn is None:
             _, _, metrics = self._step_fn_nodonate(state, ps_vals, batch)
             return metrics
@@ -180,16 +341,37 @@ class DistributedStep:
         except Exception as e:  # noqa: BLE001 — diagnostics must not break runs
             logging.warning("snapshot_lowered failed: %s", e)
 
-    def lowered_text(self, state: TrainState, batch) -> str:
+    def _ps_avals(self, with_opt: bool = False):
+        """(value avals, little-tree optimizer-state avals) for the
+        host-resident PS vars — lowering inputs that must not cost a real
+        pull. The opt avals (one ``optimizer.init`` trace per var) are
+        only materialized when asked for — the per-step lowering path
+        never consumes them."""
+        if self.ps_store is None:
+            return {}, {}
+        infos = self.model_item.var_infos
+        ps_avals = {n: jax.ShapeDtypeStruct(tuple(infos[n].shape),
+                                            np.dtype(infos[n].dtype))
+                    for n in self.ps_store.var_names}
+        opt_avals = {}
+        if with_opt:
+            opt_avals = {n: jax.eval_shape(
+                lambda a: self.model_item.optimizer.init({"v": a}), aval)
+                for n, aval in ps_avals.items()}
+        return ps_avals, opt_avals
+
+    def lowered_text(self, state: TrainState, batch, fuse_steps: int = 1) -> str:
         """StableHLO text of the compiled train step (used by snapshots and
         by tests asserting on the program's collective structure). PS values
-        enter as avals — lowering must not cost a real pull."""
-        ps_avals = {}
-        if self.ps_store is not None:
-            infos = self.model_item.var_infos
-            ps_avals = {n: jax.ShapeDtypeStruct(tuple(infos[n].shape),
-                                                np.dtype(infos[n].dtype))
-                        for n in self.ps_store.var_names}
+        enter as avals — lowering must not cost a real pull. With
+        ``fuse_steps=k > 1``, lowers the fused k-microstep scan program
+        instead; ``batch`` must then be the stacked ``[k, ...]`` feed (real
+        arrays or avals)."""
+        if fuse_steps > 1:
+            ps_avals, opt_avals = self._ps_avals(with_opt=True)
+            return self._fused_fn(donate=False).lower(
+                state, ps_avals, opt_avals, batch).as_text()
+        ps_avals, _ = self._ps_avals()
         return self._step_fn_nodonate.lower(state, ps_avals, batch).as_text()
 
     # ------------------------------------------------------------- state mgmt
@@ -506,6 +688,28 @@ class GraphTransformer:
         step_fn_nodonate = (jax.jit(_step, in_shardings=in_sh,
                                     out_shardings=out_sh)
                             if self._donate else step_fn)
+
+        def stacked(spec_tree):
+            # prepend an unsharded k (microstep) dim to every leaf spec
+            return jax.tree_util.tree_map(
+                lambda s: NamedSharding(self._mesh, P(None, *s)), spec_tree,
+                is_leaf=lambda x: isinstance(x, P))
+
+        def fused_builder(donate: bool):
+            def _multi(state: TrainState, ps_vals, ps_opt, batches):
+                del ps_vals, ps_opt  # no host-PS on the opaque path
+
+                def body(st, batch):
+                    new_st, _, metrics = _step(st, {}, batch)
+                    return new_st, metrics
+                st, stacked_metrics = jax.lax.scan(body, state, batches)
+                return st, {}, {}, stacked_metrics
+            return jax.jit(
+                _multi,
+                in_shardings=(state_sh, {}, {}, stacked(batch_specs)),
+                out_shardings=(state_sh, {}, {}, stacked(metric_specs)),
+                donate_argnums=(0,) if donate else ())
+
         logging.info("GraphTransformer: lowered opaque step_fn over %d "
                      "replicas (%d state leaves, %d partitioned)",
                      self.num_replicas, len(layouts),
@@ -516,7 +720,8 @@ class GraphTransformer:
             layout_tree=layout_tree, strategy=self._strategy,
             model_item=item, mesh_axis=self._axis,
             sync_state_init=lambda: {}, metadata={}, eval_fn=None,
-            ps_store=None, holed_params_template=item.params)
+            ps_store=None, holed_params_template=item.params,
+            fused_builder=fused_builder)
 
     # ---------------------------------------------------------------- main
 
@@ -995,6 +1200,65 @@ class GraphTransformer:
             in_specs=(state_specs, ps_specs, batch_specs),
             out_specs=metric_specs, check_vma=False))
 
+        # ----- fused multi-step lowering (DistributedStep.multi_step):
+        # k microsteps under lax.scan over a stacked [k, ...] batch in ONE
+        # jitted dispatch. Host-PS updates are device-emulated inside the
+        # scan against the superstep-start snapshot: the SAME per-variable
+        # little-tree optimizer apply the store runs on host
+        # (``PSStore._apply_impl``), so sync-PS numerics match the
+        # per-step loop exactly — the carry writes back at flush_ps sync
+        # points instead of paying a D2H round-trip per microstep.
+        ps_opt_aval = {
+            n: jax.eval_shape(
+                lambda a: optimizer.init({"v": a}),
+                jax.ShapeDtypeStruct(tuple(var_infos[n].shape),
+                                     np.dtype(var_infos[n].dtype)))
+            for n in sorted(ps_names)}
+        ps_opt_specs = jax.tree_util.tree_map(lambda _: P(), ps_opt_aval)
+        stacked_batch_specs = jax.tree_util.tree_map(
+            lambda s: P(None, *s), batch_specs,
+            is_leaf=lambda x: isinstance(x, P))
+
+        def _ps_apply_device(vals, opts, ps_grads):
+            new_vals, new_opts = {}, {}
+            for n in sorted(vals):
+                g = ps_grads[n]
+                if isinstance(g, tuple):
+                    # sparse (ids, values) pair: densify exactly as the
+                    # host store does before its apply (np.add.at there,
+                    # scatter-add here — same sum)
+                    info = var_infos[n]
+                    g = embedding_lib.scatter_add_dense(
+                        g[0], g[1], int(info.shape[0]),
+                        tuple(info.shape[1:]))
+                updates, nopt = optimizer.update(
+                    {"v": g}, opts[n], {"v": vals[n]})
+                new_vals[n] = optax.apply_updates({"v": vals[n]}, updates)["v"]
+                new_opts[n] = nopt
+            return new_vals, new_opts
+
+        def local_multi(state: TrainState, ps_vals, ps_opt, batches):
+            def body(carry, batch):
+                st, vals, opts = carry
+                new_st, ps_grads, metrics = local_step(st, vals, batch)
+                if ps_names:
+                    vals, opts = _ps_apply_device(vals, opts, ps_grads)
+                return (new_st, vals, opts), metrics
+            (st, vals, opts), stacked_metrics = jax.lax.scan(
+                body, (state, ps_vals, ps_opt), batches)
+            return st, vals, opts, stacked_metrics
+
+        def fused_builder(donate: bool):
+            sharded_multi = jax.shard_map(
+                local_multi, mesh=self._mesh,
+                in_specs=(state_specs, ps_specs, ps_opt_specs,
+                          stacked_batch_specs),
+                out_specs=(state_specs, ps_specs, ps_opt_specs,
+                           metric_specs),
+                check_vma=False)
+            return jax.jit(sharded_multi,
+                           donate_argnums=(0, 1, 2) if donate else ())
+
         ps_syncs = [s for s in syncs.values()
                     if s.__class__.__name__ == "PSSynchronizer"]
         metadata = {
@@ -1024,4 +1288,5 @@ class GraphTransformer:
             layouts=layouts, layout_tree=layout_tree, strategy=self._strategy,
             model_item=item, mesh_axis=axis, sync_state_init=sync_state_init,
             metadata=metadata, eval_fn=eval_fn, ps_store=ps_store,
-            holed_params_template=holed_params)
+            holed_params_template=holed_params,
+            fused_builder=fused_builder)

@@ -997,6 +997,61 @@ class PSStore:
             # "serving is not wired" warning as a symptom
             self._start_serving()
 
+    def full_little_opt(self, name: str):
+        """One variable's optimizer state as a FULL-variable little tree
+        (the ``optimizer.init({'v': full_value})`` structure) assembled
+        from the per-shard states: var-shaped leaves concatenate along the
+        plan axis, shared (count-like) leaves come from shard 0. This is
+        the fused engine's device carry — the inverse direction of
+        :meth:`absorb_device_state`."""
+        plan = self.plans[name]
+        with self._lock:  # atomic snapshot vs the apply thread's swap
+            states = list(self._opt[name])
+        if not plan.partitioned:
+            return jax.tree_util.tree_map(np.asarray, states[0])
+        shard_dims = plan.shard_sizes
+
+        def merge(*leaves):
+            arrs = [np.asarray(l) for l in leaves]
+            if (arrs[0].ndim > plan.axis
+                    and tuple(a.shape[plan.axis] for a in arrs) == shard_dims):
+                return np.concatenate(arrs, axis=plan.axis)
+            return arrs[0]
+        return jax.tree_util.tree_map(merge, *states)
+
+    def absorb_device_state(self, values: Dict[str, Any],
+                            opt_states: Dict[str, Any]) -> None:
+        """Take ownership of post-superstep state computed ON DEVICE by the
+        fused multi-step engine: full values split by true shard ranges,
+        full little-tree optimizer states sliced per shard (var-shaped
+        leaves along the plan axis; shared leaves copied whole — the same
+        slicing rule as :meth:`load_opt_from_full`). One writeback replaces
+        k per-microstep pushes; the wire accounting reflects that."""
+        with jax.default_device(self._cpu):
+            for name, full in values.items():
+                plan = self.plans[name]
+                info = self._var_infos[name]
+                full = np.asarray(jax.device_get(full))
+                new_vals = self._split(plan, full)
+                self.stats["bytes_pushed"] += full.nbytes
+                new_opts = []
+                for si in range(len(plan.shard_ranges())):
+                    def slice_leaf(leaf, _si=si):
+                        a = np.asarray(jax.device_get(leaf))
+                        if (plan.partitioned and a.ndim > plan.axis
+                                and a.shape[plan.axis]
+                                == info.shape[plan.axis]):
+                            a = self._shard_slice(plan, _si, a)
+                        return jnp.asarray(a)
+                    new_opts.append(jax.tree_util.tree_map(
+                        slice_leaf, opt_states[name]))
+                with self._lock:
+                    self._values[name] = new_vals
+                    self._opt[name] = new_opts
+                self.stats["applies"] += 1
+        if values:
+            self.stats["pushes"] += 1
+
     def full_opt_leaf(self, slot_path: str, var_name: str):
         """Reconstruct one optimizer-state subtree in the var's full layout
         (for original-layout checkpoints): concat var-sliced leaves across

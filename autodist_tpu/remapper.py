@@ -79,6 +79,29 @@ class Remapper:
             return P(self.batch_axes, self.seq_axis)
         return P(self.batch_axes)
 
+    def _place_leaf(self, leaf, spec: P):
+        """Place one leaf with ``spec``, passing through leaves already
+        mesh-placed with an equivalent sharding — re-placing would
+        round-trip them through the host."""
+        if isinstance(leaf, jax.Array):
+            want = NamedSharding(self.mesh, spec)
+            if leaf.sharding.is_equivalent_to(want, leaf.ndim):
+                return leaf
+            if self._fully_addressable:
+                return jax.device_put(leaf, want)
+            if not leaf.is_fully_addressable:
+                # a multi-process global array with the WRONG sharding
+                # cannot be read back host-side (np.asarray raises on
+                # non-addressable shards) — tell the caller what to do
+                raise ValueError(
+                    "feed %s is a multi-process global array with "
+                    "sharding %s (want %s); feed host numpy arrays, or "
+                    "pre-place with Remapper.remap_feed's target "
+                    "sharding" % (np.shape(leaf), leaf.sharding, want))
+            # process-local device array: re-place via the host-global
+            # path (make_array_from_callback), which every process runs
+        return self._place(np.asarray(leaf), spec)
+
     def remap_feed(self, batch) -> Any:
         """Split the global batch across replicas along dim 0. Leaves that
         are already mesh-placed with the right sharding (e.g. by
@@ -87,25 +110,26 @@ class Remapper:
         def place(path, leaf):
             spec = self._leaf_spec(np.shape(leaf), self.num_replicas,
                                    "global", _normalize_path(path))
-            if isinstance(leaf, jax.Array):
-                want = NamedSharding(self.mesh, spec)
-                if leaf.sharding.is_equivalent_to(want, leaf.ndim):
-                    return leaf
-                if self._fully_addressable:
-                    return jax.device_put(leaf, want)
-                if not leaf.is_fully_addressable:
-                    # a multi-process global array with the WRONG sharding
-                    # cannot be read back host-side (np.asarray raises on
-                    # non-addressable shards) — tell the caller what to do
-                    raise ValueError(
-                        "feed %s is a multi-process global array with "
-                        "sharding %s (want %s); feed host numpy arrays, or "
-                        "pre-place with Remapper.remap_feed's target "
-                        "sharding" % (np.shape(leaf), leaf.sharding, want))
-                # process-local device array: re-place via the host-global
-                # path (make_array_from_callback), which every process runs
-            return self._place(np.asarray(leaf), spec)
+            return self._place_leaf(leaf, spec)
         return jax.tree_util.tree_map_with_path(place, batch)
+
+    def remap_feed_stack(self, stacked_batch) -> Any:
+        """Place a STACKED ``[k, ...]`` batch for the fused multi-step
+        engine: dim 0 is the microstep (scan) dim, kept unsharded; the
+        ORIGINAL leaf layout — batch split over the data axes, sequence
+        dim over the sequence axis — applies from dim 1 on. One transfer
+        feeds k microsteps. Pre-placed leaves (``DevicePrefetcher``'s
+        stack mode) pass through untouched."""
+        def place(path, leaf):
+            shape = np.shape(leaf)
+            if len(shape) == 0:
+                raise ValueError(
+                    "stacked feed %r is a scalar — every leaf needs the "
+                    "leading [k] microstep dim" % _normalize_path(path))
+            inner = self._leaf_spec(shape[1:], self.num_replicas,
+                                    "stacked global", _normalize_path(path))
+            return self._place_leaf(leaf, P(None, *inner))
+        return jax.tree_util.tree_map_with_path(place, stacked_batch)
 
     def remap_feed_local(self, local_batch) -> Any:
         """Place a PROCESS-LOCAL batch as this process's slice of the

@@ -15,11 +15,68 @@ import time
 from typing import Any, Optional
 
 import jax
+import numpy as np
 
 from autodist_tpu import const
 from autodist_tpu.remapper import Remapper
 from autodist_tpu.train_state import TrainState
 from autodist_tpu.utils import logging
+
+
+class MetricsHandle:
+    """Device-resident step metrics from ``Runner.run(sync=False)`` or
+    ``Runner.run_superstep``: the dispatch returned immediately, and the
+    device→host readback is deferred until :meth:`result` (or any
+    mapping-style access — ``handle["loss"]`` — which forces it). This is
+    what lets the steady-state loop stay free of per-step host
+    round-trips: handles accumulate device-side and one readback
+    materializes many steps' metrics at a ``metrics_every`` boundary."""
+
+    __slots__ = ("_device", "_remapper", "_host", "microsteps")
+
+    def __init__(self, device_metrics, remapper, microsteps: int = 1):
+        self._device = device_metrics
+        self._remapper = remapper
+        self._host = None
+        self.microsteps = microsteps
+
+    @property
+    def materialized(self) -> bool:
+        return self._host is not None
+
+    def result(self):
+        """Host metrics (forces the device→host copy on first call).
+        Superstep handles return stacked ``[k, ...]`` leaves."""
+        if self._host is None:
+            self._host = self._remapper.remap_fetch(self._device)
+            self._device = None  # free the device buffers
+        return self._host
+
+    def unstack(self) -> list:
+        """Per-microstep host metrics — ``microsteps`` dicts of unstacked
+        leaves (a length-1 list for plain-step handles)."""
+        host = self.result()
+        if self.microsteps == 1:
+            return [host]
+        return [jax.tree_util.tree_map(lambda a, _i=i: np.asarray(a)[_i],
+                                       host)
+                for i in range(self.microsteps)]
+
+    def __getitem__(self, key):
+        return self.result()[key]
+
+    def __iter__(self):
+        return iter(self.result())
+
+    def keys(self):
+        return self.result().keys()
+
+    def items(self):
+        return self.result().items()
+
+    def __repr__(self):
+        state = "materialized" if self.materialized else "device-resident"
+        return "MetricsHandle(microsteps=%d, %s)" % (self.microsteps, state)
 
 
 class Runner:
@@ -36,7 +93,13 @@ class Runner:
         self._tracing = tracing
         self._trace_started = False
         self.state: Optional[TrainState] = None
+        # _step_count counts MICROSTEPS (optimizer applies) — the unit the
+        # staleness-pacing and mirror-check protocols are defined over; a
+        # fused superstep advances it by k. _superstep_count counts jitted
+        # dispatches (run/run_superstep calls) — the unit wall-time
+        # samples are taken in.
         self._step_count = 0
+        self._superstep_count = 0
         # wall time of every run() call (first element includes compile);
         # bounded so week-long jobs don't grow a list forever — the first
         # step and a sliding window of recent steps carry all the signal
@@ -161,25 +224,27 @@ class Runner:
         # a quarter of the watchdog's window: three missable beats
         return max(0.25, const.ENV.ADT_HEARTBEAT_TIMEOUT_S.val / 4.0)
 
-    def run(self, batch, state: Optional[TrainState] = None) -> Any:
-        """One training step on a host-global batch; returns host metrics."""
-        t_begin = time.perf_counter()
-        st = state if state is not None else self.state
-        if st is None:
-            raise RuntimeError("Runner.run before init()")
-        sharded_batch = self._remapper.remap_feed(batch)
+    def _start_trace_if_due(self):
         if self._tracing and not self._trace_started:
             os.makedirs(const.DEFAULT_TRACE_DIR, exist_ok=True)
             jax.profiler.start_trace(os.path.join(
                 const.DEFAULT_TRACE_DIR, time.strftime("%Y%m%d-%H%M%S")))
             self._trace_started = True
-        self._check_ps_owner_health()
-        # donate only the Runner-owned state; an explicitly-passed state is a
-        # caller reference that must stay valid
-        new_state, metrics = self._dstep(st, sharded_batch, donate=state is None)
-        if state is None:
-            self.state = new_state
-        self._step_count += 1
+
+    def _stop_trace_if_due(self, metrics):
+        if self._tracing and self._trace_started:
+            jax.block_until_ready(metrics)
+            jax.profiler.stop_trace()
+            self._trace_started = False
+            self._tracing = False  # trace only the first step, like FULL_TRACE runs
+
+    def _after_dispatch(self, microsteps: int):
+        """Shared post-dispatch control plane: step accounting, liveness
+        heartbeat, cross-process staleness pacing and mirror checks — all
+        counted in MICROSTEPS, so a fused superstep advances the pacing
+        protocol by its true k optimizer applies."""
+        self._step_count += microsteps
+        self._superstep_count += 1
         self._maybe_heartbeat()
         if self._coord is not None:
             # bounded staleness across processes (the reference's size-s
@@ -191,14 +256,8 @@ class Runner:
             self._coord.heartbeat(worker)
             self._coord.wait_staleness(self._step_count, self._staleness)
         self._maybe_check_mirrors()
-        if self._tracing and self._trace_started:
-            jax.block_until_ready(metrics)
-            jax.profiler.stop_trace()
-            self._trace_started = False
-            self._tracing = False  # trace only the first step, like FULL_TRACE runs
-        host_metrics = self._remapper.remap_fetch(metrics)
-        # remap_fetch pulled the metrics to host, so the step's device work
-        # is complete: this wall time is an honest per-step duration
+
+    def _record_step_time(self, t_begin: float):
         elapsed = time.perf_counter() - t_begin
         self._total_step_s += elapsed
         if self._first_step_s is None:
@@ -207,22 +266,96 @@ class Runner:
             self._recent_step_s.append(elapsed)
             if len(self._recent_step_s) > self._RECENT_WINDOW:
                 del self._recent_step_s[:len(self._recent_step_s) // 2]
-        return (new_state, host_metrics) if state is not None else host_metrics
 
-    def lowered_text(self, batch, state: Optional[TrainState] = None) -> str:
+    def run(self, batch, state: Optional[TrainState] = None,
+            sync: bool = True) -> Any:
+        """One training step on a host-global batch. ``sync=True``
+        (default) returns host metrics, paying one device→host readback
+        per step. ``sync=False`` returns a :class:`MetricsHandle` —
+        device-resident, materialized lazily — so the steady-state loop
+        never re-enters the host between steps; wall-time samples then
+        measure dispatch-to-dispatch, not execution (the next forced
+        readback re-syncs the clock)."""
+        t_begin = time.perf_counter()
+        st = state if state is not None else self.state
+        if st is None:
+            raise RuntimeError("Runner.run before init()")
+        sharded_batch = self._remapper.remap_feed(batch)
+        self._start_trace_if_due()
+        self._check_ps_owner_health()
+        # donate only the Runner-owned state; an explicitly-passed state is a
+        # caller reference that must stay valid
+        new_state, metrics = self._dstep(st, sharded_batch, donate=state is None)
+        if state is None:
+            self.state = new_state
+        self._after_dispatch(1)
+        self._stop_trace_if_due(metrics)
+        handle = MetricsHandle(metrics, self._remapper, microsteps=1)
+        if sync:
+            # result() pulls the metrics to host, so the step's device work
+            # is complete: this wall time is an honest per-step duration
+            host_metrics = handle.result()
+            self._record_step_time(t_begin)
+            return ((new_state, host_metrics) if state is not None
+                    else host_metrics)
+        self._record_step_time(t_begin)
+        return (new_state, handle) if state is not None else handle
+
+    def run_superstep(self, stacked_batch, sync: bool = False):
+        """One FUSED superstep: k microsteps (k = the stacked feed's
+        leading dim) in a single donated jitted dispatch
+        (``DistributedStep.multi_step``) — gradient collectives, PS
+        updates and optimizer applies all stay on device; metrics come
+        back stacked ``[k, ...]`` as a lazily-materialized
+        :class:`MetricsHandle` (``sync=True`` forces the readback before
+        returning). Heartbeats and staleness pacing advance by the true
+        k microsteps."""
+        t_begin = time.perf_counter()
+        if self.state is None:
+            raise RuntimeError("Runner.run_superstep before init()")
+        placed = self._remapper.remap_feed_stack(stacked_batch)
+        leaves = jax.tree_util.tree_leaves(placed)
+        k = int(np.shape(leaves[0])[0]) if leaves else 1
+        self._start_trace_if_due()
+        self._check_ps_owner_health()
+        new_state, metrics = self._dstep.run_multi(self.state, placed)
+        self.state = new_state
+        self._after_dispatch(k)
+        self._stop_trace_if_due(metrics)
+        handle = MetricsHandle(metrics, self._remapper, microsteps=k)
+        if sync:
+            handle.result()
+        self._record_step_time(t_begin)
+        return handle.result() if sync else handle
+
+    def lowered_text(self, batch, state: Optional[TrainState] = None,
+                     fuse_steps: int = 1) -> str:
         """StableHLO text of the compiled step for ``batch`` — the input
         of the post-lowering lint pass (``analysis/lowered.py``). Pure
-        lowering: no step runs, host-PS values enter as avals."""
+        lowering: no step runs, host-PS values enter as avals. With
+        ``fuse_steps=k > 1``, lowers the fused k-microstep scan program
+        (the stacked feed is synthesized as avals from ``batch``)."""
         st = state if state is not None else self.state
         if st is None:
             raise RuntimeError("Runner.lowered_text before init()")
-        return self._dstep.lowered_text(st, self._remapper.remap_feed(batch))
+        placed = self._remapper.remap_feed(batch)
+        if fuse_steps > 1:
+            stacked = jax.tree_util.tree_map(
+                lambda l: jax.ShapeDtypeStruct(
+                    (fuse_steps,) + tuple(np.shape(l)), l.dtype), placed)
+            return self._dstep.lowered_text(st, stacked,
+                                            fuse_steps=fuse_steps)
+        return self._dstep.lowered_text(st, placed)
 
-    def lint_lowered(self, batch, state: Optional[TrainState] = None):
-        """Run the lowered-program communication checks (ADT405-407) on
-        this runner's compiled step; returns the Diagnostic list."""
+    def lint_lowered(self, batch, state: Optional[TrainState] = None,
+                     fuse_steps: int = 1):
+        """Run the lowered-program communication checks (ADT405-408) on
+        this runner's compiled step; returns the Diagnostic list. With
+        ``fuse_steps=k``, lints the fused scan program — ADT408 flags
+        per-microstep host transfers inside the scan body."""
         from autodist_tpu.analysis import lowered as lowered_lib
-        return lowered_lib.lint_runner(self, batch, state)
+        return lowered_lib.lint_runner(self, batch, state,
+                                       fuse_steps=fuse_steps)
 
     def step_stats(self) -> dict:
         """Wall-time statistics over this runner's steps (the throughput
@@ -232,10 +365,21 @@ class Runner:
         post-compile regime over a recent window; ``goodput`` is the
         fraction of total stepping wall time the job would have needed at
         steady median speed — compile time, host stalls, and throttle
-        windows all show up as lost goodput."""
+        windows all show up as lost goodput.
+
+        Fused accounting: wall-time samples are PER DISPATCH, so both
+        counts are reported — ``supersteps`` (dispatches: what the timing
+        samples and goodput are defined over) and ``microsteps``
+        (optimizer applies: what examples/s math must multiply by the
+        batch size; ×k under ``fit(fuse_steps=k)``). ``steps`` ==
+        ``microsteps`` for backward compatibility (identical without
+        fusion). Reading the stats never forces a device sync — under
+        ``sync=False`` stepping the samples measure dispatch-to-dispatch
+        time, re-synced at every metrics readback boundary."""
         import statistics
-        n = self._step_count
-        out = {"steps": n, "total_s": round(self._total_step_s, 6),
+        micro, sup = self._step_count, self._superstep_count
+        out = {"steps": micro, "supersteps": sup, "microsteps": micro,
+               "total_s": round(self._total_step_s, 6),
                "first_step_s": (round(self._first_step_s, 6)
                                 if self._first_step_s is not None else None)}
         recent = self._recent_step_s
@@ -249,7 +393,10 @@ class Runner:
                 steady_median_s=round(statistics.median(recent), 6),
                 steady_p10_s=round(qs[0], 6),
                 steady_p90_s=round(qs[-1], 6),
-                goodput=round(min(1.0, statistics.median(recent) * n
+                # goodput is over DISPATCHES: recent samples are
+                # per-dispatch durations, so the ideal-time numerator is
+                # median x dispatch count, never median x microsteps
+                goodput=round(min(1.0, statistics.median(recent) * sup
                               / self._total_step_s), 4)
                 if self._total_step_s > 0 else None)
         return out
@@ -414,7 +561,7 @@ class Runner:
 
     def fit(self, batches, steps: Optional[int] = None,
             callbacks: Optional[list] = None, save_every: int = 0,
-            saver=None) -> list:
+            saver=None, fuse_steps: int = 1, metrics_every: int = 1) -> list:
         """Train over an iterable of host batches (the reference's Keras
         ``model.fit`` path, which its patch routed into the distributed
         session — reference ``patch.py:96-197``). ``steps`` bounds infinite
@@ -424,11 +571,45 @@ class Runner:
         once at the end) through ``saver`` — default an async
         :class:`~autodist_tpu.checkpoint.saver.Saver` on ``ADT_CKPT_DIR``,
         which is exactly what sync-elastic recovery resumes from. Returns
-        per-step metrics."""
+        per-step metrics.
+
+        ``fuse_steps=k > 1`` drives the FUSED engine: k consecutive
+        batches are stacked into one ``[k, ...]`` feed (or taken
+        pre-stacked from a ``DevicePrefetcher(..., stack=k)``) and run as
+        one donated jitted superstep — no host re-entry between the k
+        optimizer applies. ``metrics_every=n`` pays the device→host
+        metrics readback only every n supersteps; between boundaries
+        ZERO device→host copies happen. History entries stay
+        per-microstep (one dict per batch), so examples/s math and parity
+        with the per-step loop are unchanged; callbacks also fire
+        per-microstep but only AT readback boundaries (their values are
+        exact, their timing is deferred — a monitor that must run every
+        step needs ``fuse_steps=1, metrics_every=1``). ``save_every``
+        rounds UP to the next superstep boundary (a checkpoint cannot
+        split a fused program). When ``fit`` does the stacking (a plain
+        host-batch iterable), a trailing group smaller than k falls back
+        to per-step execution, so any batch count is trained exactly;
+        PRE-stacked sources cannot be split — ``DevicePrefetcher(stack=k)``
+        drops a short tail (with a warning) and a ``steps`` bound that is
+        not a multiple of k stops at the last whole superstep."""
+        src_k = getattr(batches, "stack_k", 1)
+        if src_k != 1 and src_k != max(1, fuse_steps):
+            # a stacked source feeding the wrong k would not fail loudly:
+            # remap would split the [k] scan dim over replicas (or re-stack
+            # an already-stacked feed) and broadcast-tolerant models would
+            # silently train on mis-shaped data
+            raise ValueError(
+                "fit(fuse_steps=%d) fed a source pre-stacked with stack=%d"
+                " — the stacks must match (DevicePrefetcher(stack=k) pairs"
+                " with fit(fuse_steps=k))" % (fuse_steps, src_k))
         if save_every > 0 and saver is None:
             from autodist_tpu.checkpoint.saver import Saver
             saver = Saver(directory=const.ENV.ADT_CKPT_DIR.val,
                           async_save=True)
+        if fuse_steps > 1 or metrics_every > 1:
+            return self._fit_pipelined(batches, steps, callbacks, save_every,
+                                       saver, max(1, fuse_steps),
+                                       max(1, metrics_every))
         history = []
         bounded = batches if steps is None else itertools.islice(batches, steps)
         try:
@@ -448,6 +629,95 @@ class Runner:
                 saver.wait()
         return history
 
+    def _fit_pipelined(self, batches, steps, callbacks, save_every, saver,
+                       k: int, metrics_every: int) -> list:
+        """The fused / async steady-state driver behind
+        ``fit(fuse_steps=k, metrics_every=n)``: supersteps dispatch with
+        ``sync=False`` and their :class:`MetricsHandle`\\ s accumulate
+        device-side; one readback per n supersteps (and one at the end)
+        materializes them into the per-microstep history."""
+        history: list = []
+        pending: list = []  # un-materialized MetricsHandles, in step order
+
+        def materialize():
+            # pop each handle BEFORE firing its callbacks: a callback that
+            # raises must not leave the handle queued, or the finally-path
+            # materialize would re-run its side effects (double
+            # checkpoint/log writes) on the way out
+            while pending:
+                handle = pending.pop(0)
+                for m in handle.unstack():
+                    idx = len(history)
+                    history.append(m)
+                    for cb in (callbacks or ()):
+                        cb(idx, m)
+
+        # a DevicePrefetcher in matching stack mode yields pre-stacked,
+        # pre-placed [k, ...] feeds — consume them whole; any other source
+        # yields plain batches that are grouped and stacked here
+        pre_stacked = k > 1 and getattr(batches, "stack_k", 1) == k
+        it = iter(batches)
+        micro_done, last_save, supersteps = 0, 0, 0
+        try:
+            while steps is None or micro_done < steps:
+                if pre_stacked:
+                    if steps is not None and micro_done + k > steps:
+                        logging.warning(
+                            "fit: steps=%d is not a multiple of "
+                            "fuse_steps=%d on a pre-stacked source; "
+                            "stopping at %d microsteps", steps, k, micro_done)
+                        break
+                    try:
+                        stacked = next(it)
+                    except StopIteration:
+                        break
+                    handles = [self.run_superstep(stacked, sync=False)]
+                else:
+                    group = []
+                    while len(group) < k and (steps is None
+                                              or micro_done + len(group)
+                                              < steps):
+                        try:
+                            group.append(next(it))
+                        except StopIteration:
+                            break
+                    if not group:
+                        break
+                    if len(group) == k and k > 1:
+                        from autodist_tpu.data.prefetch import stack_batches
+                        handles = [self.run_superstep(stack_batches(group),
+                                                      sync=False)]
+                    else:
+                        # trailing partial group: per-step, still async
+                        handles = [self.run(b, sync=False) for b in group]
+                pending.extend(handles)
+                micro_done += sum(h.microsteps for h in handles)
+                supersteps += 1
+                if supersteps % metrics_every == 0:
+                    materialize()
+                if save_every > 0 and micro_done - last_save >= save_every:
+                    # superstep-boundary rounding: the save covers every
+                    # microstep dispatched so far (saver reads through
+                    # flush_ps, which lands the fused PS carry)
+                    saver.save(self)
+                    last_save = micro_done
+            materialize()
+            if save_every > 0 and micro_done > last_save:
+                saver.save(self)  # final partial window
+        finally:
+            # NO materialize here: on an exception path the history is
+            # lost with the raise, and firing user callbacks after one of
+            # them (or the step) aborted would run side effects the
+            # caller believes cancelled. Un-materialized handles just
+            # drop their device buffers.
+            del pending[:]
+            # land the fused PS carry: after fit() returns, the host store
+            # is authoritative again for checkpoints/eval/inspection
+            self._dstep.flush_ps()
+            if saver is not None:
+                saver.wait()
+        return history
+
     def evaluate(self, batches, steps: Optional[int] = None) -> dict:
         """Mean of the SCALAR metrics over an iterable of host batches,
         without updating parameters (the reference's ``model.evaluate``).
@@ -460,8 +730,10 @@ class Runner:
         totals, count, skipped = {}, 0, set()
         # ONE host-PS pull for the whole eval loop: no pushes happen
         # between eval batches, so the values cannot change — a consistent
-        # snapshot, and per-batch re-pulls would be pure PCIe waste
-        ps_vals = self._dstep._pull_ps()
+        # snapshot, and per-batch re-pulls would be pure PCIe waste.
+        # pull_ps is the public snapshot API; it also lands a dirty fused
+        # superstep carry first, so eval-mid-fit sees every microstep.
+        ps_vals = self._dstep.pull_ps()
         bounded = batches if steps is None else itertools.islice(batches, steps)
         for batch in bounded:
             sharded = self._remapper.remap_feed(batch)
@@ -493,9 +765,11 @@ class WrappedSession:
         return self._runner.run(batch)
 
     def fit(self, batches, steps=None, callbacks=None, save_every=0,
-            saver=None):
+            saver=None, fuse_steps=1, metrics_every=1):
         return self._runner.fit(batches, steps=steps, callbacks=callbacks,
-                                save_every=save_every, saver=saver)
+                                save_every=save_every, saver=saver,
+                                fuse_steps=fuse_steps,
+                                metrics_every=metrics_every)
 
     def evaluate(self, batches, steps=None):
         return self._runner.evaluate(batches, steps=steps)

@@ -230,6 +230,8 @@ def bench_model(label, pairs=8, iters=4, deadline=None, batch_size=None):
             rb = _phase_rate(run_baseline, iters)
         ratios.append(rf / rb)
         fw_rates.append(rf)
+    fused_extra = _maybe_fused_phases(runner, state_box, sharded, run_fw,
+                                      iters)
     adt.reset()
     best_rate = max(fw_rates)  # steady-state (least-throttled) phase
     # flops is the GLOBAL per-step count; aggregate peak scales with the
@@ -240,7 +242,7 @@ def bench_model(label, pairs=8, iters=4, deadline=None, batch_size=None):
     # throttled shared chip, median is the can't-be-cherry-picked floor
     mfu_median = (flops * statistics.median(fw_rates) / agg_peak
                   if flops else 0.0)
-    return {
+    out = {
         "examples_per_sec": round(statistics.median(fw_rates) * batch_size, 2),
         "vs_baseline": round(statistics.median(ratios), 4),
         "mfu": round(mfu, 4),
@@ -249,6 +251,119 @@ def bench_model(label, pairs=8, iters=4, deadline=None, batch_size=None):
         "batch_size": batch_size,
         "pairs": len(ratios),
     }
+    out.update(fused_extra)
+    return out
+
+
+def _maybe_fused_phases(runner, state_box, sharded, run_fw, iters):
+    """Opt-in (ADT_BENCH_FUSED=k) paired fused-vs-per-step phases for the
+    artifact rounds: the fused engine runs k microsteps per dispatch over
+    a [k, ...] stack of the SAME batch, so the ratio isolates the per-step
+    host round-trip the fusion removes. Best-effort — a failure here is
+    recorded, never fatal to the model's main result."""
+    fuse_k = int(os.environ.get("ADT_BENCH_FUSED", "0") or 0)
+    if fuse_k <= 1:
+        return {}
+    import jax
+    try:
+        import numpy as np
+        host = jax.tree_util.tree_map(
+            lambda v: np.stack([np.asarray(jax.device_get(v))] * fuse_k),
+            sharded)
+        stacked = runner.remapper.remap_feed_stack(host)
+
+        def run_fw_fused():
+            st, m = runner.distributed_step.run_multi(state_box[0], stacked)
+            state_box[0] = st
+            return m["loss"][-1]
+
+        _sync(run_fw_fused())  # compile + one superstep
+        fused_iters = max(1, iters // fuse_k)
+        ratios = []
+        for j in range(4):
+            if j % 2 == 0:
+                rp = _phase_rate(run_fw, iters)
+                rf = _phase_rate(run_fw_fused, fused_iters)
+            else:
+                rf = _phase_rate(run_fw_fused, fused_iters)
+                rp = _phase_rate(run_fw, iters)
+            # rf counts SUPERSTEPS; x k converts to microsteps/s
+            ratios.append(rf * fuse_k / rp)
+        return {"fuse_steps": fuse_k,
+                "fused_vs_per_step": round(statistics.median(ratios), 4)}
+    except Exception as e:  # noqa: BLE001 — opt-in extra, never fatal
+        print("  fused phases failed: %s" % e, file=sys.stderr, flush=True)
+        return {"fuse_steps": fuse_k,
+                "fused_error": "%s: %s" % (type(e).__name__, str(e)[:160])}
+
+
+def smoke_main(fused: bool = False):
+    """CI leg (``bench.py --smoke [--fused]``): a tiny MLP through the
+    full stack on CPU — seconds, not minutes. With ``--fused`` it also
+    compiles the fused multi-step engine (``fit(fuse_steps=4,
+    metrics_every=2)``), asserts parity with the per-step loop AND the
+    k× dispatch reduction, and reports the paired fused-vs-per-step
+    throughput ratio — so the scan-fused lowering path compiles (and
+    stays numerically honest) on every PR."""
+    import jax
+    jax.config.update("jax_platforms",
+                      os.environ.get("ADT_BENCH_PLATFORM") or "cpu")
+    import numpy as np
+    import optax
+    import autodist_tpu as adt
+    from autodist_tpu import strategy
+
+    rng = np.random.RandomState(0)
+    params = {"w1": rng.randn(16, 32).astype(np.float32) * 0.1,
+              "b1": np.zeros((32,), np.float32),
+              "w2": rng.randn(32, 4).astype(np.float32) * 0.1}
+
+    def loss_fn(p, b):
+        import jax.numpy as jnp
+        h = jnp.tanh(b["x"] @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - b["y"]) ** 2)
+
+    batches = [{"x": rng.randn(32, 16).astype(np.float32),
+                "y": rng.randn(32, 4).astype(np.float32)}
+               for _ in range(16)]
+
+    def build():
+        adt.reset()
+        ad = adt.AutoDist(strategy_builder=strategy.AllReduce())
+        runner = ad.build(loss_fn, optax.adam(1e-2), params, batches[0])
+        runner.init(params)
+        return runner
+
+    t0 = time.perf_counter()
+    r1 = build()
+    h1 = r1.fit(list(batches))
+    per_step_s = time.perf_counter() - t0
+    result = {"metric": "smoke", "per_step_loop_s": round(per_step_s, 3),
+              "steps": len(h1), "final_loss": round(float(h1[-1]["loss"]), 6)}
+    if fused:
+        k = 4
+        t0 = time.perf_counter()
+        r2 = build()
+        h2 = r2.fit(list(batches), fuse_steps=k, metrics_every=2)
+        result["fused_loop_s"] = round(time.perf_counter() - t0, 3)
+        d1, d2 = (r1.distributed_step.dispatches,
+                  r2.distributed_step.dispatches)
+        assert d2 == d1 // k, "dispatches %d != %d/%d" % (d2, d1, k)
+        np.testing.assert_allclose([m["loss"] for m in h1],
+                                   [m["loss"] for m in h2],
+                                   rtol=1e-5, atol=1e-6)
+        # steady-state paired ratio (post-compile): per-step vs fused
+        def loop_plain():
+            r1.fit(list(batches))
+        def loop_fused():
+            r2.fit(list(batches), fuse_steps=k, metrics_every=4)
+        t0 = time.perf_counter(); loop_plain(); tp = time.perf_counter() - t0
+        t0 = time.perf_counter(); loop_fused(); tf = time.perf_counter() - t0
+        result.update(fuse_steps=k, dispatches=[d1, d2],
+                      fused_vs_per_step=round(tp / max(tf, 1e-9), 4),
+                      stats=r2.step_stats())
+    adt.reset()
+    print(RESULT_TAG + json.dumps(result), flush=True)
 
 
 def probe_main():
@@ -481,5 +596,7 @@ if __name__ == "__main__":
         child_main(sys.argv[2])
     elif len(sys.argv) >= 2 and sys.argv[1] == "--probe":
         probe_main()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--smoke":
+        smoke_main(fused="--fused" in sys.argv[2:])
     else:
         main()

@@ -79,12 +79,15 @@ def test_step_stats_goodput():
     ad = adt.AutoDist(strategy_builder=strategy.AllReduce())
     runner = ad.build(loss, optax.sgd(0.1), params, batch)
     runner.init(params)
-    assert runner.step_stats() == {"steps": 0, "total_s": 0.0,
+    assert runner.step_stats() == {"steps": 0, "supersteps": 0,
+                                   "microsteps": 0, "total_s": 0.0,
                                    "first_step_s": None}
     for _ in range(12):
         runner.run(batch)
     stats = runner.step_stats()
     assert stats["steps"] == 12
+    # without fusion the two units coincide
+    assert stats["supersteps"] == stats["microsteps"] == 12
     # compile dominates the first step; steady steps are far faster
     assert stats["first_step_s"] > 5 * stats["steady_median_s"]
     assert stats["steady_p10_s"] <= stats["steady_median_s"] <= stats["steady_p90_s"]
@@ -104,6 +107,7 @@ def test_step_stats_small_sample_percentiles_stay_in_range():
     from autodist_tpu.runtime.runner import Runner
     r = Runner.__new__(Runner)
     r._step_count = 3
+    r._superstep_count = 3
     r._first_step_s = 1.0
     r._recent_step_s = [0.001, 0.005]
     r._total_step_s = 1.006

@@ -1,0 +1,305 @@
+"""Fused multi-step engine + async metrics pipeline.
+
+The perf tentpole's correctness contract: ``fit(fuse_steps=k)`` runs k
+microsteps per jitted dispatch under ``lax.scan`` and must be allclose —
+params, optimizer state AND per-step metrics — to the per-step loop, for
+both the AllReduce and the host-PS families (whose pull/push hooks are
+device-emulated inside the scan). The dispatch counter proves the k×
+reduction in host round-trips, and the ``sync=False`` handle path proves
+the steady-state loop issues zero device→host copies between
+``metrics_every`` boundaries.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import autodist_tpu
+from autodist_tpu import strategy as S
+from autodist_tpu.data import DevicePrefetcher
+from autodist_tpu.remapper import Remapper
+from autodist_tpu.runtime.runner import MetricsHandle
+
+
+def _make_problem(seed=0, n_batches=8):
+    rng = np.random.RandomState(seed)
+    params = {"w": jnp.asarray(rng.randn(4, 2).astype(np.float32)),
+              "b": jnp.zeros((2,), jnp.float32),
+              "emb": jnp.asarray(rng.randn(16, 4).astype(np.float32))}
+
+    def loss_fn(p, batch):
+        feat = jnp.take(p["emb"], batch["ids"], axis=0)
+        pred = feat @ p["w"] + p["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    batches = [{"ids": rng.randint(0, 16, size=(16,)).astype(np.int32),
+                "y": rng.randn(16, 2).astype(np.float32)}
+               for _ in range(n_batches)]
+    return params, loss_fn, batches
+
+
+def _build(make_builder, params, loss_fn, batch, opt=None):
+    autodist_tpu.reset()
+    ad = autodist_tpu.AutoDist(strategy_builder=make_builder())
+    runner = ad.build(loss_fn, opt or optax.adam(0.1), params, batch)
+    runner.init(params)
+    return runner
+
+
+# the acceptance matrix: a PS strategy (host-resident store, pull/push
+# emulated inside the scan), an AllReduce strategy (pure device
+# collectives), and a partitioned host store (uneven shard writeback)
+PARITY_BUILDERS = [
+    ("PS", lambda: S.PS()),
+    ("AllReduce", lambda: S.AllReduce()),
+    ("UnevenPartitionedPS", lambda: S.UnevenPartitionedPS()),
+]
+
+
+@pytest.mark.parametrize("name,make_builder", PARITY_BUILDERS,
+                         ids=[b[0] for b in PARITY_BUILDERS])
+def test_fused_parity_and_dispatch_count(name, make_builder):
+    """fit(fuse_steps=4) over 8 batches == 8 per-step runs (params, opt
+    state, metrics), with 4x fewer jitted dispatches."""
+    params, loss_fn, batches = _make_problem()
+
+    runner_a = _build(make_builder, params, loss_fn, batches[0])
+    hist_a = runner_a.fit(iter(batches))
+    params_a = runner_a.gather_params()
+    opt_a = runner_a.distributed_step.gather_opt_state(runner_a.state)
+    dispatches_a = runner_a.distributed_step.dispatches
+
+    runner_b = _build(make_builder, params, loss_fn, batches[0])
+    hist_b = runner_b.fit(iter(batches), fuse_steps=4, metrics_every=2)
+    params_b = runner_b.gather_params()
+    opt_b = runner_b.distributed_step.gather_opt_state(runner_b.state)
+    dispatches_b = runner_b.distributed_step.dispatches
+
+    # k x fewer host dispatches is the whole point
+    assert dispatches_a == len(batches)
+    assert dispatches_b == len(batches) // 4
+
+    assert len(hist_a) == len(hist_b) == len(batches)
+    np.testing.assert_allclose([m["loss"] for m in hist_a],
+                               [m["loss"] for m in hist_b],
+                               rtol=1e-5, atol=1e-6)
+    for key in params_a:
+        np.testing.assert_allclose(
+            np.asarray(params_a[key]), np.asarray(params_b[key]),
+            rtol=1e-5, atol=1e-6, err_msg="var %s" % key)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        opt_a, opt_b)
+    autodist_tpu.reset()
+
+
+def test_async_handles_zero_readbacks_between_boundaries(monkeypatch):
+    """sync=False stepping issues NO device→host metric copies until the
+    handle is materialized; fit(metrics_every=n) therefore reads back only
+    at boundaries. Counted at the single funnel every readback goes
+    through (Remapper.remap_fetch)."""
+    params, loss_fn, batches = _make_problem()
+    runner = _build(lambda: S.AllReduce(), params, loss_fn, batches[0])
+
+    fetches = []
+    real_fetch = Remapper.remap_fetch
+    monkeypatch.setattr(Remapper, "remap_fetch",
+                        lambda self, fetched: fetches.append(1)
+                        or real_fetch(self, fetched))
+
+    # direct handle path: two supersteps, zero fetches until result()
+    stack = jax.tree_util.tree_map(lambda *ls: np.stack(ls), *batches[:4])
+    h1 = runner.run_superstep(stack, sync=False)
+    h2 = runner.run_superstep(stack, sync=False)
+    assert isinstance(h1, MetricsHandle) and not h1.materialized
+    assert fetches == []
+    host = h1.result()
+    assert len(fetches) == 1 and np.shape(host["loss"]) == (4,)
+    assert h1.result() is host  # second access is free
+    h2.result()
+    assert len(fetches) == 2
+
+    # fit boundary accounting: 4 supersteps of k=2, readback every 2 —
+    # the per-superstep fetch count stays 0 between boundaries
+    del fetches[:]
+    boundary_counts = []
+    orig_superstep = type(runner).run_superstep
+
+    def spying_superstep(self, *a, **kw):
+        out = orig_superstep(self, *a, **kw)
+        boundary_counts.append(len(fetches))
+        return out
+    monkeypatch.setattr(type(runner), "run_superstep", spying_superstep)
+    hist = runner.fit(iter(batches), fuse_steps=2, metrics_every=2)
+    assert len(hist) == 8
+    # after supersteps 1 and 3: no readback yet; materialization happens
+    # AFTER supersteps 2 and 4, so the counts recorded at dispatch time
+    # are [0, 0, 2, 2] — never a fetch between boundaries
+    assert boundary_counts == [0, 0, 2, 2]
+    assert len(fetches) == 4  # one per superstep handle, paid in bursts
+    autodist_tpu.reset()
+
+
+def test_step_stats_superstep_microstep_accounting():
+    """step_stats must report BOTH counters: supersteps (dispatches — the
+    unit of the wall-time samples and goodput) and microsteps (optimizer
+    applies — the unit examples/s math multiplies by batch size)."""
+    params, loss_fn, batches = _make_problem(n_batches=10)
+    runner = _build(lambda: S.AllReduce(), params, loss_fn, batches[0])
+    assert runner.step_stats() == {
+        "steps": 0, "supersteps": 0, "microsteps": 0,
+        "total_s": 0.0, "first_step_s": None}
+    # 10 batches at k=4: two fused supersteps + a trailing per-step pair
+    hist = runner.fit(iter(batches), fuse_steps=4)
+    assert len(hist) == 10
+    stats = runner.step_stats()
+    assert stats["microsteps"] == 10
+    assert stats["steps"] == 10  # back-compat alias of microsteps
+    assert stats["supersteps"] == 4  # 2 fused dispatches + 2 per-step
+    # goodput is defined over dispatches: ideal time uses the superstep
+    # median x superstep count, so it can never exceed 1 even though each
+    # dispatch covers k microsteps
+    assert 0.0 < stats["goodput"] <= 1.0
+    # plain run() advances both counters by one
+    runner.run(batches[0])
+    stats = runner.step_stats()
+    assert (stats["supersteps"], stats["microsteps"]) == (5, 11)
+    autodist_tpu.reset()
+
+
+def test_fused_refuses_async_and_stale_host_ps():
+    """A scan compiled around a superstep-start PS snapshot cannot observe
+    peers' applies between microsteps — staleness/async host-PS must be
+    refused loudly, not silently mis-trained."""
+    params, loss_fn, batches = _make_problem()
+    runner = _build(lambda: S.PS(staleness=2), params, loss_fn, batches[0])
+    stack = jax.tree_util.tree_map(lambda *ls: np.stack(ls), *batches[:2])
+    with pytest.raises(ValueError, match="fused multi-step"):
+        runner.run_superstep(stack)
+    with pytest.raises(ValueError, match="fused multi-step"):
+        runner.distributed_step.multi_step(2)
+    autodist_tpu.reset()
+
+
+def test_fused_lowering_and_adt408_lint():
+    """The fused program lowers to ONE scan (while) with no host traffic
+    in its body — Runner.lowered_text(fuse_steps=k) + the ADT408 rule."""
+    params, loss_fn, batches = _make_problem()
+    runner = _build(lambda: S.PS(), params, loss_fn, batches[0])
+    text = runner.lowered_text(batches[0], fuse_steps=4)
+    assert "stablehlo.while" in text  # the k-microstep scan
+    codes = [d.code for d in runner.lint_lowered(batches[0], fuse_steps=4)]
+    assert "ADT408" not in codes and "ADT406" not in codes
+    autodist_tpu.reset()
+
+
+def test_adt408_fires_on_host_transfer_inside_scan_body():
+    """Synthetic text: the same host token is ADT406 at top level but
+    ADT408 inside a while/scan body (per-microstep cost)."""
+    from autodist_tpu.analysis.lowered import lint_lowered_text
+
+    def codes(text):
+        return {d.code for d in lint_lowered_text(text)}
+
+    inside = """
+    %0 = stablehlo.while(%arg = %init) : tensor<4xf32>
+     cond {
+      stablehlo.compare ...
+     } do {
+      %1 = "stablehlo.custom_call"(%x) {call_target_name = "SendToHost"}
+     }
+    """
+    assert "ADT408" in codes(inside)
+    assert "ADT406" not in codes(inside)
+
+    outside = '%1 = "stablehlo.custom_call"(%x) {call_target_name = "SendToHost"}'
+    assert codes(outside) == {"ADT406"}
+
+    jaxpr_style = """
+    c:f32[8] = scan[
+      jaxpr={ lambda ; a:f32[] b:f32[].
+        d:f32[] = outfeed a b
+      }
+    ] x y
+    """
+    assert "ADT408" in codes(jaxpr_style)
+
+
+def test_prefetcher_stack_mode_shapes_and_tail_drop():
+    """DevicePrefetcher(stack=k) yields [k, ...] stacked feeds and drops a
+    trailing short group (a short stack would recompile the fused
+    program)."""
+    batches = [{"x": np.full((4, 2), i, np.float32)} for i in range(10)]
+    pf = DevicePrefetcher(iter(batches), lambda b: b, depth=2, stack=4)
+    assert pf.stack_k == 4
+    items = list(pf)
+    assert len(items) == 2  # 10 batches -> 2 full stacks, tail of 2 dropped
+    assert items[0]["x"].shape == (4, 4, 2)
+    np.testing.assert_array_equal(items[1]["x"][0], batches[4]["x"])
+
+
+def test_close_flushes_fused_ps_carry():
+    """Runner.close() right after fused supersteps must land the carry in
+    the host store — a close must never silently discard PS updates."""
+    params, loss_fn, batches = _make_problem()
+    runner = _build(lambda: S.PS(), params, loss_fn, batches[0])
+    store = runner.distributed_step.ps_store
+    before = {k: v.copy() for k, v in store.pull().items()}
+    stack = jax.tree_util.tree_map(lambda *ls: np.stack(ls), *batches[:4])
+    runner.run_superstep(stack, sync=False)
+    runner.close()
+    after = store.pull()
+    changed = any(not np.allclose(before[k], after[k]) for k in before)
+    assert changed, "close() dropped the fused PS carry"
+    autodist_tpu.reset()
+
+
+def test_fit_rejects_mismatched_prestacked_source():
+    """A pre-stacked source whose stack doesn't match fuse_steps would
+    silently train on mis-shaped data — must be refused loudly."""
+    params, loss_fn, batches = _make_problem()
+    runner = _build(lambda: S.AllReduce(), params, loss_fn, batches[0])
+    pf = DevicePrefetcher(iter(batches), runner, stack=4)
+    with pytest.raises(ValueError, match="pre-stacked"):
+        runner.fit(pf)  # default fuse_steps=1
+    with pytest.raises(ValueError, match="pre-stacked"):
+        runner.fit(pf, fuse_steps=2)
+    autodist_tpu.reset()
+
+
+def test_fused_step_fn_mode_parity():
+    """The opaque step_fn capture mode gets the same scan fusion."""
+    rng = np.random.RandomState(3)
+    params = {"w": jnp.asarray(rng.randn(4, 2).astype(np.float32))}
+    opt = optax.sgd(0.1)
+
+    def step_fn(p, batch):
+        def loss(q):
+            return jnp.mean((batch["x"] @ q["w"] - batch["y"]) ** 2)
+        l, g = jax.value_and_grad(loss)(p)
+        updates, _ = opt.update(g, opt.init(p), p)
+        return optax.apply_updates(p, updates), {"loss": l}
+
+    batches = [{"x": rng.randn(8, 4).astype(np.float32),
+                "y": rng.randn(8, 2).astype(np.float32)} for _ in range(8)]
+
+    def train(fuse):
+        autodist_tpu.reset()
+        ad = autodist_tpu.AutoDist(strategy_builder=S.AllReduce())
+        runner = ad.build_step(step_fn, params, batches[0])
+        runner.init(params)
+        hist = runner.fit(iter(batches), fuse_steps=fuse)
+        return hist, runner.gather_params(), runner.distributed_step.dispatches
+
+    hist_a, params_a, d_a = train(1)
+    hist_b, params_b, d_b = train(4)
+    assert (d_a, d_b) == (8, 2)
+    np.testing.assert_allclose([m["loss"] for m in hist_a],
+                               [m["loss"] for m in hist_b],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(params_a["w"]),
+                               np.asarray(params_b["w"]),
+                               rtol=1e-5, atol=1e-6)
+    autodist_tpu.reset()
