@@ -6,6 +6,12 @@ Public surface:
   list[Diagnostic]``: the pure plan-level pass (``rules.py``);
 - :func:`lint_lowered_text` / :func:`lint_runner` — the second pass over
   the lowered jaxpr/StableHLO program (``lowered.py``);
+- :func:`parse_hlo_text` / :func:`collective_schedule` /
+  :func:`compare_schedules` — the structured lowered-program parser and
+  the cross-program collective-schedule checks, ADT510/511 (``hlo.py``);
+- :func:`estimate_from_text` / :func:`plan_memory_report` /
+  :func:`budget_diagnostics` — the static peak-HBM analyzers, ADT501-503
+  (``memory.py``);
 - :class:`Diagnostic` / :class:`Severity` / :class:`DiagnosticError` /
   :class:`StrategyVerificationError` — the typed diagnostics framework
   (``diagnostics.py``);
@@ -19,11 +25,20 @@ through ``strategy.base``.
 
 __all__ = ["verify", "lint_lowered_text", "lint_runner", "Diagnostic",
            "Severity", "DiagnosticError", "StrategyVerificationError",
-           "format_table", "sort_diagnostics", "has_errors", "CODES"]
+           "format_table", "sort_diagnostics", "has_errors", "CODES",
+           "parse_hlo_text", "collective_schedule", "compare_schedules",
+           "CollectiveSchedule", "estimate_from_text", "MemoryEstimate",
+           "plan_memory_report", "budget_diagnostics",
+           "donation_diagnostics"]
 
 _DIAG_NAMES = {"Diagnostic", "Severity", "DiagnosticError",
                "StrategyVerificationError", "format_table",
                "sort_diagnostics", "has_errors", "CODES"}
+_HLO_NAMES = {"parse_hlo_text", "collective_schedule", "compare_schedules",
+              "CollectiveSchedule"}
+_MEMORY_NAMES = {"estimate_from_text", "MemoryEstimate",
+                 "plan_memory_report", "budget_diagnostics",
+                 "donation_diagnostics"}
 
 
 def __getattr__(name):
@@ -33,6 +48,12 @@ def __getattr__(name):
     if name in ("lint_lowered_text", "lint_runner"):
         from autodist_tpu.analysis import lowered
         return getattr(lowered, name)
+    if name in _HLO_NAMES:
+        from autodist_tpu.analysis import hlo
+        return getattr(hlo, name)
+    if name in _MEMORY_NAMES:
+        from autodist_tpu.analysis import memory
+        return getattr(memory, name)
     if name in _DIAG_NAMES:
         from autodist_tpu.analysis import diagnostics
         return getattr(diagnostics, name)

@@ -6,12 +6,27 @@ prints the diagnostic table. Exit codes: 0 = no errors (warnings/info
 allowed), 1 = at least one ``ADT`` error, 2 = usage/build failure.
 
 Used by CI to gate every example x strategy combination, and by hand to
-answer "will this plan compile?" without compiling:
+answer "will this plan compile?" — and now "will it FIT?" — without
+compiling:
 
     python -m autodist_tpu.analysis linear_regression --strategy PS
-    python -m autodist_tpu.analysis lm1b --strategy Parallax --json
+    python -m autodist_tpu.analysis lm1b --strategy Parallax --format json
     python -m autodist_tpu.analysis tp_lm --strategy TensorParallel
     python -m autodist_tpu.analysis lm1b --strategy-json plan.json
+
+``--hbm-budget <GiB>`` adds the plan-level memory gate (ADT501 projected
+OOM / ADT502 budget pressure) with NO trace of the lowered program —
+``--fuse-steps k`` prices the fused engine's device-resident PS carry on
+top:
+
+    python -m autodist_tpu.analysis lm1b --strategy PS --hbm-budget 16
+    python -m autodist_tpu.analysis lm1b --strategy PS --hbm-budget 16 --fuse-steps 8
+
+``--programs`` lints saved lowered-program dumps instead (per-program
+memory/donation/communication findings, plus the cross-program
+collective-schedule checks ADT510/511 against the FIRST file):
+
+    python -m autodist_tpu.analysis --programs train.hlo eval.hlo fused.hlo --hbm-budget 16
 """
 import argparse
 import json
@@ -153,20 +168,30 @@ def default_spec(num_devices: int = 4):
                     "tpus": num_devices}]})
 
 
-def _report(args, label, diags, spec) -> int:
+def _report(args, label, diags, spec, memory: Optional[dict] = None) -> int:
     """Print the diagnostics (table or JSON); returns the error count."""
     from autodist_tpu.analysis.diagnostics import (Severity, format_table,
                                                    sort_diagnostics)
     n_errors = sum(1 for d in diags if d.severity >= Severity.ERROR)
-    if args.json:
-        print(json.dumps({
+    if args.format == "json":
+        doc = {
             "example": args.example, "strategy": label,
             "errors": n_errors,
             "diagnostics": [d.to_dict() for d in sort_diagnostics(diags)],
-        }, indent=1, sort_keys=True))
+        }
+        if memory is not None:
+            doc["memory"] = {k: v for k, v in memory.items()
+                             if k != "diagnostics"}
+        print(json.dumps(doc, indent=1, sort_keys=True))
     elif diags or not args.quiet:
         print("%s x %s on %d devices:"
               % (args.example, label, len(spec.devices)))
+        if memory is not None:
+            print("memory: peak %.3f GiB of %.3f GiB budget (%.0f%%%s)"
+                  % (memory["peak_hbm_gib"], memory["budget_gib"],
+                     100.0 * (memory["utilization"] or 0.0),
+                     ", fuse_steps=%d" % memory["fuse_steps"]
+                     if memory.get("fuse_steps", 1) > 1 else ""))
         print(format_table(diags))
     return n_errors
 
@@ -189,8 +214,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "single node)")
     p.add_argument("--devices", type=int, default=4,
                    help="device count of the synthetic spec (default 4)")
+    p.add_argument("--format", choices=("table", "json"), default="table",
+                   help="output format; json emits one machine-readable "
+                        "document (code/severity/var/message/fixit per "
+                        "finding) for CI and external tooling")
     p.add_argument("--json", action="store_true",
-                   help="emit diagnostics as JSON instead of a table")
+                   help="alias for --format json")
+    p.add_argument("--hbm-budget", type=float, default=None, metavar="GIB",
+                   help="per-device HBM budget in GiB: run the plan-level "
+                        "memory gate (ADT501 projected OOM, ADT502 budget "
+                        "pressure) with no compile attempt")
+    p.add_argument("--fuse-steps", type=int, default=1, metavar="K",
+                   help="price the fused multi-step engine's device-"
+                        "resident PS carry into the memory gate (and the "
+                        "donation check in --programs mode)")
+    p.add_argument("--programs", nargs="+", metavar="FILE", default=None,
+                   help="lint saved lowered-program dumps (StableHLO "
+                        "as_text) instead of building a plan: per-program "
+                        "memory + communication findings, plus cross-"
+                        "program collective-schedule checks (ADT510/511) "
+                        "against the FIRST file")
     p.add_argument("--quiet", action="store_true",
                    help="print nothing on a clean plan")
     p.add_argument("--list", action="store_true",
@@ -198,12 +241,79 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _programs_mode(args) -> int:
+    """Lint lowered-program text dumps: memory/donation/communication per
+    program, cross-program schedule consistency vs the first (reference)
+    program. Exit 1 on any ADT error."""
+    import os
+    from autodist_tpu.analysis import hlo as hlo_lib
+    from autodist_tpu.analysis import memory as memory_lib
+    from autodist_tpu.analysis.diagnostics import (Severity, format_table,
+                                                   sort_diagnostics)
+    from autodist_tpu.analysis.lowered import lint_lowered_text
+    budget = (args.hbm_budget * memory_lib.GIB
+              if args.hbm_budget is not None else None)
+    per_program = []
+    for path in args.programs:
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError as e:
+            print("error: cannot read %s: %s" % (path, e), file=sys.stderr)
+            return 2
+        label = os.path.basename(path)
+        prog = hlo_lib.parse_hlo_text(text)
+        est = memory_lib.estimate_from_text(prog)
+        sched = hlo_lib.collective_schedule(prog)
+        diags = list(lint_lowered_text(text))
+        diags += memory_lib.donation_diagnostics(
+            prog, fuse_steps=args.fuse_steps)
+        if budget is not None:
+            diags += memory_lib.budget_diagnostics(
+                est.peak_hbm_bytes, budget, source="lowered-program")
+        per_program.append((label, est, sched, diags))
+    ref_label, _, ref_sched, _ = per_program[0]
+    cross = []
+    for label, _, sched, _ in per_program[1:]:
+        cross += hlo_lib.compare_schedules(ref_sched, sched,
+                                           ref_label, label)
+    all_diags = [d for (_, _, _, ds) in per_program for d in ds] + cross
+    n_errors = sum(1 for d in all_diags if d.severity >= Severity.ERROR)
+    if args.format == "json":
+        print(json.dumps({
+            "programs": [{
+                "program": label,
+                "memory": est.to_dict(),
+                "collectives": len(sched),
+                "diagnostics": [d.to_dict()
+                                for d in sort_diagnostics(diags)],
+            } for label, est, sched, diags in per_program],
+            "schedule_check": {
+                "reference": ref_label,
+                "diagnostics": [d.to_dict()
+                                for d in sort_diagnostics(cross)],
+            },
+            "errors": n_errors,
+        }, indent=1, sort_keys=True))
+    elif all_diags or not args.quiet:
+        for label, est, sched, diags in per_program:
+            print("%s: peak %.4f GiB, %d collective(s)"
+                  % (label, est.peak_hbm_bytes / memory_lib.GIB,
+                     len(sched)))
+        print(format_table(all_diags))
+    return 1 if n_errors else 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.json:
+        args.format = "json"
     if args.list:
         print("examples:   " + " ".join(sorted(EXAMPLES)))
         print("strategies: " + " ".join(sorted(_builders([""]))))
         return 0
+    if args.programs:
+        return _programs_mode(args)
     if not args.example:
         print("error: an example name is required (see --list)",
               file=sys.stderr)
@@ -260,5 +370,13 @@ def main(argv=None) -> int:
             return 2
         label = args.strategy
 
-    diags = verify(strategy, item, spec)
-    return 1 if _report(args, label, diags, spec) else 0
+    diags = list(verify(strategy, item, spec))
+    memory = None
+    if args.hbm_budget is not None:
+        from autodist_tpu.analysis import memory as memory_lib
+        memory = memory_lib.plan_memory_report(
+            strategy, item, spec,
+            budget_bytes=args.hbm_budget * memory_lib.GIB,
+            fuse_steps=args.fuse_steps)
+        diags += memory["diagnostics"]
+    return 1 if _report(args, label, diags, spec, memory) else 0

@@ -12,7 +12,9 @@ can key on them:
   sizes, model-parallel ``mp_axes``);
 - ``ADT3xx`` — synchronizer/compressor configuration;
 - ``ADT4xx`` — runtime hazards (warnings by default: pipeline bubbles,
-  PS hot spots, lowered-program smells).
+  PS hot spots, lowered-program smells);
+- ``ADT5xx`` — memory footprint and collective schedule (projected OOM,
+  budget pressure, cross-program schedule deadlocks).
 
 The compile path raises :class:`DiagnosticError` — a ``ValueError``
 carrying the same :class:`Diagnostic` the linter would report — so lint
@@ -184,4 +186,11 @@ CODES = {
     "ADT406": "lowered program transfers to host on the hot path",
     "ADT407": "collective under divergent control flow",
     "ADT408": "host transfer inside a while/scan body (per-iteration cost)",
+    # ADT5xx — memory footprint & collective schedule (analysis/hlo.py,
+    # analysis/memory.py)
+    "ADT501": "projected per-device OOM: peak HBM exceeds the budget",
+    "ADT502": "peak HBM within 10% of the budget",
+    "ADT503": "un-donated superstep carry doubles state residency",
+    "ADT510": "same-mesh programs issue incompatible collective orders",
+    "ADT511": "cross-program replica-group mismatch on a collective",
 }

@@ -1,0 +1,615 @@
+"""Structured parser over lowered StableHLO/MHLO program text.
+
+``lowered.py`` answers yes/no questions with token scans; this module
+builds an actual model of the program — functions, SSA statements with
+result sizes, brace-tracked regions, the call graph — so two deeper
+analyses become possible:
+
+- the **collective schedule**: the ordered cross-replica collectives a
+  program issues (kind, replica groups, payload bytes, loop depth), the
+  artifact TACCL (arXiv:2111.04867) treats as first-class. Two programs
+  that run on the same mesh (train on some processes, eval on others; a
+  fused superstep vs the per-step loop it replaces) must issue
+  *compatible* schedules or they deadlock at the first mismatched
+  collective — :func:`compare_schedules` turns that runtime hang into
+  ``ADT510``/``ADT511`` lint findings.
+- the **memory analysis** (``analysis/memory.py``): entry buffer sizes,
+  donation aliases, and a statement-level liveness sweep need def/use
+  chains and per-value byte sizes, which the parse provides.
+
+Text-based on purpose, like ``lowered.py``: it works on any ``as_text()``
+dump (including ones saved from a real TPU run and shipped to a dev box)
+without re-lowering, and has no opinion about which JAX version produced
+the text. The parser is deliberately forgiving — unknown constructs parse
+as opaque statements rather than failing the analysis.
+"""
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from autodist_tpu.analysis.diagnostics import (Diagnostic, error,
+                                               sort_diagnostics, warning)
+
+# ------------------------------------------------------------------ types
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4, "i16": 2, "ui16": 2,
+    "i8": 1, "ui8": 1, "i4": 1, "ui4": 1, "i1": 1,
+    "c64": 8, "c128": 16,
+}
+
+_TENSOR_TYPE_RE = re.compile(r"tensor<([^<>]*)>")
+
+
+def tensor_type_bytes(spec: str) -> int:
+    """Bytes of one ``tensor<...>`` type spec, e.g. ``8x4xf32`` -> 128;
+    a bare dtype (``i32``) is a scalar. Unknown dtypes count 4 bytes."""
+    parts = spec.split("x")
+    dtype = parts[-1]
+    n = 1
+    for p in parts[:-1]:
+        try:
+            n *= int(p)
+        except ValueError:
+            pass  # dynamic dim "?" — count it as 1 rather than failing
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def _types_bytes(segment: str) -> List[int]:
+    return [tensor_type_bytes(m.group(1))
+            for m in _TENSOR_TYPE_RE.finditer(segment)]
+
+
+_SHARDING_DEVICES_RE = re.compile(r"devices=\[([0-9,]+)\]")
+
+
+def sharding_divisor(sharding: str) -> int:
+    """How many distinct shards an ``mhlo.sharding`` attribute splits a
+    value into — the global-to-per-device byte divisor. ``{replicated}``
+    and ``{manual}`` divide by 1."""
+    m = _SHARDING_DEVICES_RE.search(sharding or "")
+    if not m:
+        return 1
+    tiles = [int(x) for x in m.group(1).split(",") if x]
+    div = 1
+    for t in tiles:
+        div *= max(t, 1)
+    if "last_tile_dim_replicate" in sharding and tiles:
+        div //= max(tiles[-1], 1)
+    return max(div, 1)
+
+
+# ------------------------------------------------------------- dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class HloArg:
+    """One entry-function argument."""
+
+    index: int
+    type_bytes: int
+    sharding: str = ""
+    # index of the output this arg's buffer is donated to (tf.aliasing_output),
+    # or None when the caller keeps ownership
+    aliased_output: Optional[int] = None
+    # jax >= 0.4.x sharded lowerings mark donation with
+    # ``jax.buffer_donor = true`` instead and resolve the alias at compile
+    buffer_donor: bool = False
+
+    @property
+    def donated(self) -> bool:
+        return self.aliased_output is not None or self.buffer_donor
+
+    @property
+    def per_device_bytes(self) -> float:
+        return self.type_bytes / sharding_divisor(self.sharding)
+
+
+@dataclasses.dataclass(frozen=True)
+class HloResult:
+    index: int
+    type_bytes: int
+    sharding: str = ""
+    result_info: str = ""  # jax.result_info label, e.g. "[0].params['w']"
+
+    @property
+    def per_device_bytes(self) -> float:
+        return self.type_bytes / sharding_divisor(self.sharding)
+
+
+@dataclasses.dataclass
+class HloStatement:
+    """One SSA statement of a function body."""
+
+    result_id: str                 # "" for return/terminators
+    op: str                        # mnemonic, e.g. "dot_general", "call"
+    operand_ids: List[str]
+    out_bytes: List[int]
+    lineno: int
+    loop_depth: int                # while/scan regions enclosing it
+    call_target: str = ""          # @target of call/func.call/custom_call
+
+    @property
+    def total_out_bytes(self) -> int:
+        return sum(self.out_bytes)
+
+
+# StableHLO / MHLO / jaxpr spellings -> the cost model's collective classes
+# (the same classes _COLLECTIVE_KINDS in kernel/common/utils.py prices)
+COLLECTIVE_CLASS = {
+    "all_reduce": "reduce", "all-reduce": "reduce", "psum": "reduce",
+    "reduce_scatter": "scatter", "reduce-scatter": "scatter",
+    "psum_scatter": "scatter",
+    "all_gather": "gather", "all-gather": "gather", "pgather": "gather",
+    "collective_permute": "permute", "collective-permute": "permute",
+    "ppermute": "permute",
+    "all_to_all": "alltoall", "all-to-all": "alltoall",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveOp:
+    """One cross-replica collective in program order."""
+
+    kind: str                                    # cost class, e.g. "reduce"
+    op: str                                      # spelled op, "all_reduce"
+    payload_bytes: int                           # operand bytes (per device)
+    result_bytes: int
+    replica_groups: Tuple[Tuple[int, ...], ...]  # () when unannotated
+    channel: int
+    lineno: int
+    loop_depth: int                              # >0: inside a while/scan
+
+    @property
+    def group_size(self) -> int:
+        return len(self.replica_groups[0]) if self.replica_groups else 1
+
+    def signature(self) -> tuple:
+        """Identity for cross-program matching: what must agree for two
+        programs to rendezvous on this collective."""
+        return (self.kind, self.replica_groups, self.payload_bytes)
+
+    def describe(self) -> str:
+        return "%s(%dB, groups=%s)" % (
+            self.op, self.payload_bytes,
+            [list(g) for g in self.replica_groups] or "?")
+
+
+@dataclasses.dataclass
+class HloFunction:
+    name: str
+    args: List[HloArg]
+    results: List[HloResult]
+    statements: List[HloStatement]
+    lineno: int = 0
+
+    @property
+    def returned_ids(self) -> set:
+        out = set()
+        for st in self.statements:
+            if st.op in ("return", "func.return"):
+                out.update(st.operand_ids)
+        return out
+
+
+@dataclasses.dataclass
+class HloProgram:
+    funcs: Dict[str, HloFunction]
+    entry: Optional[HloFunction]
+    num_partitions: int = 1
+    num_replicas: int = 1
+    module_name: str = ""
+
+    def collectives(self) -> List["CollectiveOp"]:
+        return collective_schedule(self)
+
+
+# ------------------------------------------------------------------ parser
+
+_FUNC_NAME_RE = re.compile(r"func\.func\s+(?:public\s+|private\s+)?@([\w$.-]+)")
+# attr dicts can nest one brace level: {mhlo.sharding = "{replicated}"}
+_ATTRS = r"(?:\s*\{((?:[^{}]|\{[^{}]*\})*)\})?"
+_ARG_RE = re.compile(r"%arg(\d+):\s*tensor<([^<>]*)>" + _ATTRS)
+_RESULT_RE = re.compile(r"tensor<([^<>]*)>" + _ATTRS)
+_STMT_RE = re.compile(r'^\s*%([\w.$-]+)(?::(\d+))?\s*=\s*"?([\w.$-]+)"?')
+_OPERAND_RE = re.compile(r"%([\w.$-]+)(?:#\d+)?")
+_CALL_TARGET_RE = re.compile(r"@([\w$.-]+)")
+_ALIAS_RE = re.compile(r"tf\.aliasing_output\s*=\s*(\d+)")
+_DONOR_RE = re.compile(r"jax\.buffer_donor\s*=\s*true")
+_SHARDING_ATTR_RE = re.compile(r'mhlo\.sharding\s*=\s*"([^"]*)"')
+_RESULT_INFO_RE = re.compile(r'jax\.result_info\s*=\s*"([^"]*)"')
+_REPLICA_GROUPS_RE = re.compile(r"replica_groups\s*=\s*dense<(.*?)>")
+_CHANNEL_RE = re.compile(r"handle\s*=\s*(\d+)")
+_NUM_PARTITIONS_RE = re.compile(r"mhlo\.num_partitions\s*=\s*(\d+)")
+_NUM_REPLICAS_RE = re.compile(r"mhlo\.num_replicas\s*=\s*(\d+)")
+_MODULE_RE = re.compile(r"module\s+@([\w$.-]+)")
+
+# lines that OPEN a while/scan-style loop region; ``stablehlo.while``'s
+# two regions print as `` cond {`` / ``} do {`` on later lines
+_LOOP_OPENERS = ("stablehlo.while", "mhlo.while")
+_LOOP_REGION_RE = re.compile(r"(?:^\s*|\}\s*)(?:cond|do)\s*\{")
+
+def _parse_replica_groups(line: str) -> Tuple[Tuple[int, ...], ...]:
+    m = _REPLICA_GROUPS_RE.search(line)
+    if not m:
+        return ()
+    body = m.group(1)
+    groups = []
+    for grp in re.findall(r"\[([0-9,\s]*)\]", body):
+        ids = tuple(int(x) for x in grp.replace(" ", "").split(",") if x)
+        if ids:
+            groups.append(ids)
+    if not groups:
+        # dense<0> style scalar init (single group of everything)
+        flat = tuple(int(x) for x in re.findall(r"-?\d+", body))
+        if flat:
+            groups.append(flat)
+    return tuple(groups)
+
+
+def _split_signature(sig_line: str) -> Tuple[str, str]:
+    """Split a ``func.func`` line into the args segment and the results
+    segment (after ``->``)."""
+    if ") -> " in sig_line:
+        args_part, results_part = sig_line.split(") -> ", 1)
+        return args_part, results_part
+    return sig_line, ""
+
+
+def _statement_out_bytes(line: str) -> List[int]:
+    """Result byte sizes of one single-line statement: the types after the
+    last ``->`` when present, else the trailing ``: T1, T2`` annotation."""
+    if "->" in line:
+        return _types_bytes(line.rsplit("->", 1)[1])
+    if " : " in line:
+        return _types_bytes(line.rsplit(" : ", 1)[1])
+    return []
+
+
+def parse_hlo_text(text: str) -> HloProgram:
+    """Parse a lowered-program dump into functions, statements and
+    regions. Forgiving by design: lines that match no construct are
+    skipped, so partial dumps and future dialect changes degrade to a
+    smaller model rather than an exception."""
+    funcs: Dict[str, HloFunction] = {}
+    entry_name: Optional[str] = None
+    entry_public = False
+    num_partitions = num_replicas = 1
+    module_name = ""
+
+    cur: Optional[HloFunction] = None
+    cur_depth = 0            # brace depth inside the current function
+    loop_starts: List[int] = []
+    pending_loops = 0        # openers whose '{' lands on a later line
+    # a multi-line statement being stitched (collective with a region
+    # whose `(A) -> R` type signature arrives on the closing line)
+    pending_stmt: Optional[dict] = None
+    pending_region_depth = 0
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if cur is None:
+            m = _MODULE_RE.search(line)
+            if m and not module_name:
+                module_name = m.group(1)
+            m = _NUM_PARTITIONS_RE.search(line)
+            if m:
+                num_partitions = int(m.group(1))
+            m = _NUM_REPLICAS_RE.search(line)
+            if m:
+                num_replicas = int(m.group(1))
+        fm = _FUNC_NAME_RE.search(line) if "func.func" in line else None
+        if fm:
+            name = fm.group(1)
+            args_seg, results_seg = _split_signature(line)
+            args = []
+            for am in _ARG_RE.finditer(args_seg):
+                attrs = am.group(3) or ""
+                alias = _ALIAS_RE.search(attrs)
+                shard = _SHARDING_ATTR_RE.search(attrs)
+                args.append(HloArg(
+                    index=int(am.group(1)),
+                    type_bytes=tensor_type_bytes(am.group(2)),
+                    sharding=shard.group(1) if shard else "",
+                    aliased_output=(int(alias.group(1)) if alias
+                                    else None),
+                    buffer_donor=bool(_DONOR_RE.search(attrs))))
+            results = []
+            for i, rm in enumerate(_RESULT_RE.finditer(results_seg)):
+                attrs = rm.group(2) or ""
+                shard = _SHARDING_ATTR_RE.search(attrs)
+                info_m = _RESULT_INFO_RE.search(attrs)
+                results.append(HloResult(
+                    index=i,
+                    type_bytes=tensor_type_bytes(rm.group(1)),
+                    sharding=shard.group(1) if shard else "",
+                    result_info=info_m.group(1) if info_m else ""))
+            cur = HloFunction(name=name, args=args, results=results,
+                              statements=[], lineno=lineno)
+            funcs[name] = cur
+            is_public = "public" in line.split("@")[0]
+            if entry_name is None or (is_public and not entry_public):
+                entry_name, entry_public = name, is_public
+            cur_depth = 1  # the signature line's body-opening brace
+            loop_starts = []
+            pending_loops = 0
+            pending_stmt = None
+            continue
+        if cur is None:
+            continue
+
+        opens, closes = line.count("{"), line.count("}")
+        first_open = line.find("{")
+        closes_before = line.count(
+            "}", 0, first_open if first_open >= 0 else len(line))
+        closes_after = closes - closes_before
+
+        # -------- multi-line statement stitching (collective regions)
+        if pending_stmt is not None:
+            cur_depth += opens - closes
+            if cur_depth <= pending_region_depth:
+                # region closed: the `}) : (A) -> R` line carries the types
+                pending_stmt["out_bytes"] = _statement_out_bytes(line)
+                pending_stmt["payload_bytes"] = (
+                    _types_bytes(line.rsplit(":", 1)[1].split("->")[0])
+                    if ":" in line else [])
+                _finish_statement(cur, pending_stmt)
+                pending_stmt = None
+            continue
+
+        is_loop_open = any(tok in line for tok in _LOOP_OPENERS)
+        loop_region = bool(_LOOP_REGION_RE.search(line))
+
+        # closes textually BEFORE the first open (`} do {`, a bare `}`)
+        cur_depth -= closes_before
+        while loop_starts and cur_depth <= loop_starts[-1]:
+            loop_starts.pop()
+        if cur_depth <= 0:
+            cur = None
+            continue
+
+        sm = _STMT_RE.match(line)
+        terminator = re.match(r"^\s*(?:stablehlo\.|func\.)?return\b",
+                              line.lstrip("} "))
+        if sm or terminator:
+            if sm:
+                result_id, op = sm.group(1), sm.group(3)
+                op = op.split(".")[-1]  # stablehlo.add -> add
+                rhs = line[sm.end():]
+            else:
+                result_id, op = "", "return"
+                rhs = line
+            operands = [m.group(1) for m in _OPERAND_RE.finditer(rhs)]
+            target_m = _CALL_TARGET_RE.search(rhs)
+            stmt = HloStatement(
+                result_id=result_id, op=op,
+                operand_ids=operands,
+                out_bytes=_statement_out_bytes(line),
+                lineno=lineno,
+                loop_depth=len(loop_starts) + pending_loops,
+                call_target=target_m.group(1) if target_m else "")
+            cls = COLLECTIVE_CLASS.get(op)
+            if cls is not None and opens > closes:
+                # region-carrying collective: its `(A) -> R` signature is
+                # on the region-closing line — stitch it there
+                pending_stmt = {
+                    "stmt": stmt, "class": cls,
+                    "groups": _parse_replica_groups(line),
+                    "channel": _channel_of(line)}
+                pending_region_depth = cur_depth
+                cur_depth += opens - closes_after
+                continue
+            if cls is not None:
+                # region-free collective (collective_permute, all_to_all)
+                payload = _types_bytes(line.split("->")[0].rsplit(":", 1)[-1]
+                                       if ":" in line else "")
+                _attach_collective(stmt, cls, _parse_replica_groups(line),
+                                   _channel_of(line), payload)
+            cur.statements.append(stmt)
+
+        # -------- region bookkeeping (lowered.py's brace machinery,
+        # extended: counted pending openers + `cond {`/`} do {` regions)
+        remaining = opens
+        if remaining > 0:
+            while pending_loops > 0 and remaining > 0:
+                loop_starts.append(cur_depth)
+                pending_loops -= 1
+                remaining -= 1
+                cur_depth += 1
+            if (is_loop_open or loop_region) and remaining > 0:
+                loop_starts.append(cur_depth)
+                remaining -= 1
+                cur_depth += 1
+            cur_depth += remaining
+        elif is_loop_open:
+            pending_loops += 1
+        cur_depth -= closes_after
+        while loop_starts and cur_depth <= loop_starts[-1]:
+            loop_starts.pop()
+        if cur_depth <= 0:
+            cur = None
+
+    entry = funcs.get(entry_name) if entry_name else None
+    return HloProgram(funcs=funcs, entry=entry,
+                      num_partitions=num_partitions,
+                      num_replicas=num_replicas, module_name=module_name)
+
+
+def _channel_of(line: str) -> int:
+    m = _CHANNEL_RE.search(line)
+    return int(m.group(1)) if m else 0
+
+
+def _attach_collective(stmt: HloStatement, cls: str, groups, channel,
+                       payload: List[int]):
+    stmt.collective = CollectiveOp(  # type: ignore[attr-defined]
+        kind=cls, op=stmt.op,
+        payload_bytes=sum(payload) or stmt.total_out_bytes,
+        result_bytes=stmt.total_out_bytes,
+        replica_groups=groups, channel=channel,
+        lineno=stmt.lineno, loop_depth=stmt.loop_depth)
+
+
+def _finish_statement(func: HloFunction, pending: dict):
+    stmt: HloStatement = pending["stmt"]
+    stmt.out_bytes = pending["out_bytes"]
+    _attach_collective(stmt, pending["class"], pending["groups"],
+                       pending["channel"], pending["payload_bytes"])
+    func.statements.append(stmt)
+
+
+# ------------------------------------------------------------- schedules
+
+
+class CollectiveSchedule(list):
+    """Ordered :class:`CollectiveOp`\\ s of one program (a ``list`` with
+    schedule-level helpers)."""
+
+    @property
+    def total_payload_bytes(self) -> int:
+        return sum(c.payload_bytes for c in self)
+
+    def class_payload_bytes(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for c in self:
+            out[c.kind] = out.get(c.kind, 0) + c.payload_bytes
+        return out
+
+    def per_step(self) -> "CollectiveSchedule":
+        """The per-iteration schedule: a fused ``multi_step(k)`` program
+        runs its microstep inside a while/scan body, so EVERY collective
+        sits at loop depth >= 1 and the loop body IS the per-step
+        schedule. A program with any top-level collective is already
+        per-step — a model-internal while/scan (ring attention, a scanned
+        layer stack) must NOT strip the gradient collectives around it —
+        so the unwrap applies only when all collectives are in-loop."""
+        if not self or any(c.loop_depth == 0 for c in self):
+            return self
+        return CollectiveSchedule(
+            dataclasses.replace(c, loop_depth=c.loop_depth - 1)
+            for c in self)
+
+    def signature(self) -> tuple:
+        return tuple(c.signature() for c in self)
+
+
+def collective_schedule(text_or_program) -> CollectiveSchedule:
+    """Extract the ordered collective schedule of a lowered program,
+    walking the call graph from the entry function (call-site loop depth
+    propagates into callees — a collective in a function called from a
+    scan body is an in-loop collective)."""
+    program = (text_or_program if isinstance(text_or_program, HloProgram)
+               else parse_hlo_text(text_or_program))
+    out = CollectiveSchedule()
+    if program.entry is None:
+        return out
+    seen: List[str] = []
+
+    def walk(func: HloFunction, depth_offset: int):
+        if func.name in seen:
+            return  # defensive: no recursion in HLO, but never loop
+        seen.append(func.name)
+        for st in func.statements:
+            coll = getattr(st, "collective", None)
+            if coll is not None:
+                out.append(dataclasses.replace(
+                    coll, loop_depth=coll.loop_depth + depth_offset))
+            elif st.call_target and st.call_target in program.funcs:
+                walk(program.funcs[st.call_target],
+                     depth_offset + st.loop_depth)
+        seen.pop()
+
+    walk(program.entry, 0)
+    return out
+
+
+def _embeds(needle: Sequence[tuple], haystack: Sequence[tuple]) -> bool:
+    """True when ``needle`` is an ordered subsequence of ``haystack``."""
+    it = iter(haystack)
+    return all(any(h == n for h in it) for n in needle)
+
+
+def compare_schedules(ref, other, ref_label: str = "train",
+                      other_label: str = "eval") -> List[Diagnostic]:
+    """Cross-program collective-schedule consistency (ADT510/ADT511).
+
+    Two programs that can run concurrently on the same mesh must agree on
+    the order and grouping of the collectives they share: a replica
+    executing program A blocks in its i-th collective while a replica
+    executing program B blocks in a *different* one — the classic
+    mismatched-schedule deadlock. ``other``'s per-step schedule must embed
+    (as an ordered subsequence, matching kind + replica groups + payload)
+    into ``ref``'s; a kind-sequence that embeds but with different replica
+    groups is the softer ``ADT511``.
+
+    Accepts schedules, programs, or raw text for both sides.
+    """
+    ref_sched = _as_schedule(ref).per_step()
+    other_sched = _as_schedule(other).per_step()
+    out: List[Diagnostic] = []
+    if not other_sched or not ref_sched:
+        return out
+
+    full_ref = [c.signature() for c in ref_sched]
+    full_other = [c.signature() for c in other_sched]
+    if _embeds(full_other, full_ref):
+        return out
+
+    order_ref = [(c.kind, c.payload_bytes) for c in ref_sched]
+    order_other = [(c.kind, c.payload_bytes) for c in other_sched]
+    if _embeds(order_other, order_ref):
+        # the ORDER of collectives is compatible; the matched ops must
+        # disagree on replica groups. Greedy-align to name the first.
+        it = iter(ref_sched)
+        for oc in other_sched:
+            for rc in it:
+                if (rc.kind, rc.payload_bytes) == (oc.kind,
+                                                   oc.payload_bytes):
+                    if (rc.replica_groups != oc.replica_groups
+                            and rc.replica_groups and oc.replica_groups):
+                        out.append(warning(
+                            "ADT511",
+                            "%s and %s programs disagree on replica groups "
+                            "for a %s collective: %s vs %s (lines %d/%d) — "
+                            "on a shared mesh the rendezvous never "
+                            "completes" % (
+                                ref_label, other_label, oc.kind,
+                                rc.describe(), oc.describe(),
+                                rc.lineno, oc.lineno),
+                            fixit="rebuild both programs from the same "
+                                  "compiled strategy so device meshes and "
+                                  "axis groupings agree"))
+                    break
+        if not out:
+            out.append(warning(
+                "ADT511",
+                "%s program's collectives embed into %s's by kind and "
+                "payload but differ in grouping/channel annotations"
+                % (other_label, ref_label),
+                fixit="rebuild both programs from the same compiled "
+                      "strategy"))
+        return sort_diagnostics(out)
+
+    out.append(error(
+        "ADT510",
+        "%s and %s programs issue incompatible collective orders on the "
+        "same mesh: %s's sequence [%s] does not embed into %s's [%s] — "
+        "replicas running different programs will block in mismatched "
+        "collectives and deadlock" % (
+            ref_label, other_label, other_label,
+            ", ".join("%s:%dB" % (c.kind, c.payload_bytes)
+                      for c in other_sched), ref_label,
+            ", ".join("%s:%dB" % (c.kind, c.payload_bytes)
+                      for c in ref_sched)),
+        fixit="derive every same-mesh program (train/eval/fused) from one "
+              "compiled strategy and do not reorder collectives by hand"))
+    return sort_diagnostics(out)
+
+
+def _as_schedule(x) -> CollectiveSchedule:
+    if isinstance(x, CollectiveSchedule):
+        return x
+    if isinstance(x, (HloProgram, str)):
+        return collective_schedule(x)
+    return CollectiveSchedule(x)

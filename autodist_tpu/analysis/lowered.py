@@ -53,11 +53,18 @@ _HOST_TOKENS = ("infeed", "outfeed", "send_to_host", "recv_from_host",
 
 # result tensor type, e.g. tensor<128x512xf32>
 _TENSOR_RE = re.compile(r"tensor<([0-9]+(?:x[0-9]+)*)x[a-z][a-z0-9]*>")
-_BRANCH_TOKENS = ("stablehlo.if", "stablehlo.case", "mhlo.if", "mhlo.case",
-                  "cond[", "cond ")
-# loop-region openers: StableHLO/MHLO while ops, jaxpr scan/while_loop
-# pretty-prints — the fused multi-step engine's microstep body lives here
-_LOOP_TOKENS = ("stablehlo.while", "mhlo.while", "scan[", "while[")
+# StableHLO/MHLO region ops delimit their bodies with BRACES; jaxpr
+# pretty-prints delimit the whole statement — params AND sub-jaxprs —
+# with the op's square BRACKET (``scan[ ... jaxpr={...} ... ] a b``), so
+# the two families need different span tracking. A jaxpr ``while[``
+# carries TWO sub-jaxprs (cond_jaxpr + body_jaxpr) and nested scans
+# re-open brackets inside the span, which is why brace-only tracking
+# used to lose every region after the first (one level deep).
+_BRANCH_BRACE_TOKENS = ("stablehlo.if", "stablehlo.case", "mhlo.if",
+                        "mhlo.case")
+_BRANCH_BRACKET_TOKENS = ("cond[",)
+_LOOP_BRACE_TOKENS = ("stablehlo.while", "mhlo.while")
+_LOOP_BRACKET_TOKENS = ("scan[", "while[")
 
 
 def _line_tensor_shapes(line: str) -> List[Tuple[int, ...]]:
@@ -78,26 +85,37 @@ def lint_lowered_text(text: str,
     out: List[Diagnostic] = []
     full_shapes = {tuple(int(d) for d in shape): name
                    for name, shape in (mp_full_shapes or {}).items()}
-    # depth of every open if/case (and while/scan) region, tracked by
-    # brace nesting; an opener whose braces land on a LATER line (jaxpr
-    # ``cond[``/``scan[`` pretty-print this way) is held pending until
-    # its first ``{``
+    # StableHLO regions: depth of every open if/case (and while) region,
+    # tracked by brace nesting; an opener whose braces land on a LATER
+    # line is held pending (counted — two openers can be pending) until
+    # its first ``{``. jaxpr statements: bracket-depth spans of every
+    # open ``scan[``/``while[``/``cond[`` — the whole span (params and
+    # every sub-jaxpr, however deeply nested) is the region.
     brace_depth = 0
+    bracket_depth = 0
     branch_starts: List[int] = []
     loop_starts: List[int] = []
-    pending_branch = False
-    pending_loop = False
+    branch_spans: List[int] = []
+    loop_spans: List[int] = []
+    pending_branch = 0
+    pending_loop = 0
     flagged_branch = False
     seen_host: set = set()
     seen_loop_host: set = set()
     seen_gather: set = set()
     for lineno, line in enumerate(text.splitlines(), 1):
         lowered_line = line.strip()
-        is_branch_open = any(tok in line for tok in _BRANCH_TOKENS)
-        is_loop_open = any(tok in line for tok in _LOOP_TOKENS)
+        is_branch_open = any(tok in line for tok in _BRANCH_BRACE_TOKENS)
+        is_loop_open = any(tok in line for tok in _LOOP_BRACE_TOKENS)
         has_collective = any(tok in line for tok in COLLECTIVE_TOKENS)
-        in_branch = (branch_starts or pending_branch or is_branch_open)
-        in_loop = (loop_starts or pending_loop or is_loop_open)
+        if any(tok in line for tok in _BRANCH_BRACKET_TOKENS):
+            branch_spans.append(bracket_depth)
+        if any(tok in line for tok in _LOOP_BRACKET_TOKENS):
+            loop_spans.append(bracket_depth)
+        in_branch = (branch_starts or pending_branch or is_branch_open
+                     or branch_spans)
+        in_loop = (loop_starts or pending_loop or is_loop_open
+                   or loop_spans)
         if in_branch and has_collective and not flagged_branch:
             out.append(warning(
                 "ADT407",
@@ -155,20 +173,25 @@ def lint_lowered_text(text: str,
         if opens > 0:
             if is_branch_open or pending_branch:
                 branch_starts.append(brace_depth)
-                pending_branch = False
+                pending_branch = max(pending_branch - 1, 0)
             if is_loop_open or pending_loop:
                 loop_starts.append(brace_depth)
-                pending_loop = False
+                pending_loop = max(pending_loop - 1, 0)
         else:
             if is_branch_open:
-                pending_branch = True  # braces arrive on a later line
+                pending_branch += 1  # braces arrive on a later line
             if is_loop_open:
-                pending_loop = True
+                pending_loop += 1
         brace_depth += opens - line.count("}")
         while branch_starts and brace_depth <= branch_starts[-1]:
             branch_starts.pop()
         while loop_starts and brace_depth <= loop_starts[-1]:
             loop_starts.pop()
+        bracket_depth += line.count("[") - line.count("]")
+        while branch_spans and bracket_depth <= branch_spans[-1]:
+            branch_spans.pop()
+        while loop_spans and bracket_depth <= loop_spans[-1]:
+            loop_spans.pop()
     return sort_diagnostics(out)
 
 

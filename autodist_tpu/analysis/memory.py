@@ -1,0 +1,294 @@
+"""Static per-device peak-HBM analysis (the ADT5xx memory pass).
+
+Two estimators share one reporting shape, so OOM surfaces at lint time
+instead of as a runtime allocation failure:
+
+- :func:`estimate_from_text` — the **lowered-program** estimator: entry
+  buffer sizes (sharding-aware, donation-aware) plus a statement-level
+  liveness sweep over the parsed program (``analysis/hlo.py``) for the
+  temporaries XLA actually materializes. This is the number
+  ``Runner.memory_report()`` reports, and the one checked against
+  ``compiled.memory_analysis()`` in tests.
+- :func:`plan_memory_report` — the **plan-level** estimator: the cost
+  model's strategy-aware heuristic (params + optimizer state + gradient
+  buffer + activations under partitioning/host-PS/remat), available
+  BEFORE any tracing or lowering — the CLI's ``--hbm-budget`` gate runs
+  here, so a projected OOM fails the lint with no compile attempt.
+
+Both check against a budget derived from ``ResourceSpec.chip_hbm_bytes()``
+(per-chip capacity by generation, overridable per cluster) and report:
+
+- ``ADT501`` (error): projected per-device peak exceeds the budget;
+- ``ADT502`` (warning): peak within 10% of the budget — one allocator
+  fragmentation event from an OOM;
+- ``ADT503`` (warning): a fused superstep program whose carry is not
+  donated — state lives twice for the whole superstep.
+
+The liveness sweep is a conservative model of XLA's buffer assignment:
+only "anchor" ops that survive fusion (contractions, reductions,
+collectives, data movement, loops) are charged a buffer from definition
+to last use; elementwise chains fuse into their consumers and charge
+nothing. No attempt is made to model rematerialization or buffer
+reuse beyond liveness — the estimate is meant to be within tens of
+percent, biased high.
+"""
+import dataclasses
+from typing import Dict, List, Optional
+
+from autodist_tpu.analysis.diagnostics import (Diagnostic, error, warning)
+from autodist_tpu.analysis.hlo import (COLLECTIVE_CLASS, HloFunction,
+                                       HloProgram, parse_hlo_text)
+
+GIB = float(1 << 30)
+
+# op mnemonics whose outputs XLA materializes as real buffers (fusion
+# boundaries); everything else is assumed to fuse into its consumer
+_ANCHOR_OPS = frozenset({
+    "dot_general", "dot", "convolution", "conv_general_dilated",
+    "reduce", "reduce_window", "sort", "while", "gather", "scatter",
+    "concatenate", "pad", "dynamic_slice", "rng_bit_generator", "fft",
+    "cholesky", "triangular_solve", "custom_call",
+}) | frozenset(COLLECTIVE_CLASS)
+
+# custom_call targets that are sharding annotations, not real computations
+_PASS_THROUGH_TARGETS = ("Sharding", "SPMDFullToShardShape",
+                         "SPMDShardToFullShape")
+
+
+@dataclasses.dataclass
+class MemoryEstimate:
+    """Per-device peak-HBM estimate of one lowered program."""
+
+    num_partitions: int = 1
+    args_bytes: float = 0.0           # entry arguments (per-device)
+    output_bytes: float = 0.0         # entry results (per-device)
+    aliased_bytes: float = 0.0        # donated args (buffer shared w/ output)
+    peak_temp_bytes: float = 0.0      # liveness-sweep peak of anchors
+    # largest single in-flight collective payload — informational: the
+    # liveness sweep already holds both the operand and the result of a
+    # collective live across it, so adding this again would double-count
+    collective_scratch_bytes: float = 0.0
+    outputs_by_label: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+
+    @property
+    def peak_hbm_bytes(self) -> float:
+        return (self.args_bytes + self.output_bytes - self.aliased_bytes
+                + self.peak_temp_bytes)
+
+    def to_dict(self) -> dict:
+        return {
+            "num_partitions": self.num_partitions,
+            "args_bytes": round(self.args_bytes),
+            "output_bytes": round(self.output_bytes),
+            "aliased_bytes": round(self.aliased_bytes),
+            "peak_temp_bytes": round(self.peak_temp_bytes),
+            "collective_scratch_bytes": round(self.collective_scratch_bytes),
+            "peak_hbm_bytes": round(self.peak_hbm_bytes),
+            "peak_hbm_gib": round(self.peak_hbm_bytes / GIB, 4),
+            "outputs_by_label": {k: round(v) for k, v in
+                                 sorted(self.outputs_by_label.items())},
+        }
+
+
+def _classify_result(info: str) -> str:
+    if ".params" in info or "param" in info:
+        return "params"
+    if "opt_state" in info or "opt" in info:
+        return "opt_state"
+    if info in ("[]", "") or ".step" in info:
+        return "counters"
+    return "metrics"
+
+
+def _function_temp_peak(func: HloFunction) -> float:
+    """Liveness-sweep peak over this function's anchor-op values: each
+    anchor output is live from its defining statement to its last use;
+    values the function returns are charged to the caller's output
+    accounting instead."""
+    last_use: Dict[str, int] = {}
+    for idx, st in enumerate(func.statements):
+        for op_id in st.operand_ids:
+            last_use[op_id] = idx
+    returned = func.returned_ids
+    live_until: List[tuple] = []  # (def_idx, last_use_idx, bytes)
+    for idx, st in enumerate(func.statements):
+        if not st.result_id or st.result_id in returned:
+            continue
+        if st.op == "custom_call" and st.call_target in _PASS_THROUGH_TARGETS:
+            continue
+        if st.op not in _ANCHOR_OPS:
+            continue
+        end = last_use.get(st.result_id, idx)
+        live_until.append((idx, end, st.total_out_bytes))
+    # event sweep: +bytes at def, -bytes after last use, prefix-sum for
+    # the peak — O(S + A), not O(S x A) (a fused dump of a large model
+    # has 1e4+ statements)
+    delta = [0.0] * (len(func.statements) + 1)
+    for d, e, b in live_until:
+        delta[d] += b
+        delta[e + 1] -= b
+    peak = live = 0.0
+    for change in delta:
+        live += change
+        peak = max(peak, live)
+    return peak
+
+
+def estimate_from_text(text_or_program) -> MemoryEstimate:
+    """Per-device peak-HBM estimate of a lowered program dump.
+
+    ``peak = args + outputs - donated_aliases + temp_peak + collective
+    scratch``, with entry buffers divided by their sharding (a
+    ``{devices=[4,1]}`` batch arg costs a quarter per device) and the
+    temp peak taken from the per-function liveness sweep (function frames
+    are not concurrent in XLA, so the max over functions, not the sum).
+    """
+    program = (text_or_program if isinstance(text_or_program, HloProgram)
+               else parse_hlo_text(text_or_program))
+    est = MemoryEstimate(num_partitions=program.num_partitions)
+    if program.entry is None:
+        return est
+    entry = program.entry
+    est.args_bytes = float(sum(a.per_device_bytes for a in entry.args))
+    est.output_bytes = float(sum(r.per_device_bytes for r in entry.results))
+    # donated args share buffers with outputs — explicitly
+    # (tf.aliasing_output = N) or lazily (jax.buffer_donor, resolved by
+    # XLA at compile time); either way at most output_bytes can alias
+    est.aliased_bytes = min(
+        float(sum(a.per_device_bytes for a in entry.args if a.donated)),
+        est.output_bytes)
+    for r in entry.results:
+        label = _classify_result(r.result_info)
+        est.outputs_by_label[label] = (est.outputs_by_label.get(label, 0.0)
+                                       + r.per_device_bytes)
+    est.peak_temp_bytes = max(
+        (_function_temp_peak(f) for f in program.funcs.values()),
+        default=0.0)
+    est.collective_scratch_bytes = float(max(
+        (c.payload_bytes for c in program.collectives()), default=0))
+    return est
+
+
+# ---------------------------------------------------------------- budgets
+
+
+def budget_diagnostics(peak_bytes: float, budget_bytes: float,
+                       source: str = "lowered program",
+                       headroom_warn: float = 0.9) -> List[Diagnostic]:
+    """ADT501/ADT502 against a per-device HBM budget."""
+    out: List[Diagnostic] = []
+    if budget_bytes <= 0:
+        return out
+    if peak_bytes > budget_bytes:
+        out.append(error(
+            "ADT501",
+            "projected OOM: per-device peak HBM %.3f GiB exceeds the "
+            "%.3f GiB budget (%s estimate) — this plan crashes at the "
+            "first step's allocation, not at lint time" % (
+                peak_bytes / GIB, budget_bytes / GIB, source),
+            fixit="partition storage (ZeRO/PartitionedPS), offload to "
+                  "host-PS, enable remat, or shrink the per-device "
+                  "batch"))
+    elif peak_bytes > headroom_warn * budget_bytes:
+        out.append(warning(
+            "ADT502",
+            "per-device peak HBM %.2f GiB is within %d%% of the %.2f GiB "
+            "budget (%s estimate) — allocator fragmentation or a larger "
+            "batch tips this into OOM" % (
+                peak_bytes / GIB, round((1 - headroom_warn) * 100),
+                budget_bytes / GIB, source),
+            fixit="leave >=10% headroom: partition storage, remat, or "
+                  "shrink the batch"))
+    return out
+
+
+def donation_diagnostics(text_or_program,
+                         fuse_steps: int = 1) -> List[Diagnostic]:
+    """ADT503: a fused superstep program (its microstep loop is the
+    program body) whose entry carry is not donated keeps TWO copies of
+    params + optimizer state resident for the whole superstep.
+
+    Fires only when the caller declares the program fused
+    (``fuse_steps > 1`` — Runner.memory_report and the CLI's
+    ``--fuse-steps`` both know): a while op alone is no evidence, since
+    per-step programs legitimately contain model-internal loops (scanned
+    layer stacks, ring attention) and eval programs are never donated."""
+    if fuse_steps <= 1:
+        return []
+    program = (text_or_program if isinstance(text_or_program, HloProgram)
+               else parse_hlo_text(text_or_program))
+    if program.entry is None:
+        return []
+    if any(a.donated for a in program.entry.args):
+        return []
+    carry = sum(a.per_device_bytes for a in program.entry.args)
+    return [warning(
+        "ADT503",
+        "fused superstep carry is not donated: none of the %d entry "
+        "arguments alias an output, so ~%.2f GiB of state is resident "
+        "twice for the whole superstep" % (
+            len(program.entry.args), carry / GIB),
+        fixit="dispatch through run_superstep/multi_step (donate=True) "
+              "so the carry buffers are reused in place")]
+
+
+# ------------------------------------------------------------- plan level
+
+
+def plan_peak_hbm(strategy, model_item, resource_spec,
+                  fuse_steps: int = 1, cost_model=None) -> float:
+    """Strategy-aware per-device peak estimate with NO tracing of the
+    lowered program — the cost model's heuristic (params + opt state +
+    gradient buffer + activations under partitioning/host-PS/remat),
+    plus the fused engine's device-resident PS carry (values stay
+    counted as the pulled copy; the carry additionally pins each host-PS
+    var's optimizer state on device for the superstep)."""
+    from autodist_tpu.simulator.cost_model import CostModel
+    cm = cost_model or CostModel(model_item, resource_spec)
+    peak = cm.hbm_bytes(strategy)
+    if fuse_steps > 1:
+        peak += _fused_carry_opt_bytes(strategy, model_item, cm)
+    return peak
+
+
+def _fused_carry_opt_bytes(strategy, model_item, cost_model) -> float:
+    """Optimizer-state bytes the fused carry keeps device-resident for
+    host-PS vars (per-step execution leaves them in host RAM)."""
+    from autodist_tpu.strategy.base import PSSynchronizer
+    infos = model_item.var_infos
+    params_total = float(model_item.total_bytes()) or 1.0
+    opt_total = cost_model.opt_state_bytes()
+    carry = 0.0
+    for node in strategy.node_config:
+        info = infos.get(node.var_name)
+        if info is None:
+            continue
+        syncs = ([node.synchronizer] if node.synchronizer else
+                 [p.synchronizer for p in node.part_configs])
+        if any(isinstance(s, PSSynchronizer) and not s.local_replication
+               for s in syncs):
+            carry += opt_total * info.byte_size / params_total
+    return carry
+
+
+def plan_memory_report(strategy, model_item, resource_spec,
+                       budget_bytes: Optional[float] = None,
+                       fuse_steps: int = 1) -> dict:
+    """The CLI/AutoDist-facing plan-level memory gate: heuristic peak,
+    budget (explicit GiB or the spec's chip capacity), utilization and
+    the ADT501/502 diagnostics."""
+    peak = plan_peak_hbm(strategy, model_item, resource_spec,
+                         fuse_steps=fuse_steps)
+    budget = (budget_bytes if budget_bytes is not None
+              else resource_spec.chip_hbm_bytes())
+    diags = budget_diagnostics(peak, budget, source="plan-level")
+    return {
+        "peak_hbm_bytes": round(peak),
+        "peak_hbm_gib": round(peak / GIB, 4),
+        "budget_bytes": round(budget),
+        "budget_gib": round(budget / GIB, 4),
+        "utilization": round(peak / budget, 4) if budget else None,
+        "fuse_steps": fuse_steps,
+        "diagnostics": diags,
+    }

@@ -180,17 +180,27 @@ class AutoDist:
 
     def _verify_strategy(self, strategy: Strategy, item: ModelItem):
         """Static verification BEFORE kernel transformation
-        (``analysis/rules.py``): whole failure classes — malformed
+        (``analysis/rules.py`` + the plan-level memory gate of
+        ``analysis/memory.py``): whole failure classes — malformed
         partitioners, dangling PS destinations, sync/compressor
-        mismatches — surface here as typed diagnostics instead of
-        ``ValueError``s deep in the lowering (or collective deadlocks at
-        runtime)."""
+        mismatches, and a projected per-device OOM against the chip's
+        HBM capacity (ADT501) — surface here as typed diagnostics
+        instead of ``ValueError``s deep in the lowering (or collective
+        deadlocks / allocation failures at runtime)."""
         if self._validate == "off":
             return
         from autodist_tpu.analysis import verify
         from autodist_tpu.analysis.diagnostics import (
             Severity, StrategyVerificationError)
-        diags = verify(strategy, item, self._resource_spec)
+        diags = list(verify(strategy, item, self._resource_spec))
+        try:
+            from autodist_tpu.analysis import memory as memory_lib
+            diags += memory_lib.plan_memory_report(
+                strategy, item, self._resource_spec)["diagnostics"]
+        except Exception as e:  # noqa: BLE001 — the memory gate is
+            # best-effort: a model the cost heuristics cannot trace must
+            # not fail an otherwise-verifiable build
+            logging.debug("plan-level memory gate skipped: %s", e)
         errors = [d for d in diags if d.severity >= Severity.ERROR]
         for d in diags:
             log = (logging.warning if d.severity >= Severity.WARNING
@@ -370,7 +380,9 @@ class AutoDist:
         dstep = GraphTransformer(compiled, mesh, item).transform()
         if is_async and dstep.ps_store is not None:
             self._wire_async_ps(dstep)
-        self._runner = Runner(dstep, tracing=self._tracing)
+        self._runner = Runner(
+            dstep, tracing=self._tracing,
+            hbm_budget_bytes=self._resource_spec.chip_hbm_bytes())
         return self._runner
 
     def build_step(self, step_fn: Callable, state, example_batch) -> Runner:
@@ -395,7 +407,9 @@ class AutoDist:
         mesh = mesh_lib.mesh_from_strategy(compiled, self._resource_spec,
                                            backend=self._backend)
         dstep = GraphTransformer(compiled, mesh, item).transform()
-        self._runner = Runner(dstep, tracing=self._tracing)
+        self._runner = Runner(
+            dstep, tracing=self._tracing,
+            hbm_budget_bytes=self._resource_spec.chip_hbm_bytes())
         return self._runner
 
     def _validate_async(self, compiled: Strategy, item: ModelItem) -> bool:

@@ -360,19 +360,36 @@ class DistributedStep:
                 for n, aval in ps_avals.items()}
         return ps_avals, opt_avals
 
-    def lowered_text(self, state: TrainState, batch, fuse_steps: int = 1) -> str:
-        """StableHLO text of the compiled train step (used by snapshots and
-        by tests asserting on the program's collective structure). PS values
-        enter as avals — lowering must not cost a real pull. With
-        ``fuse_steps=k > 1``, lowers the fused k-microstep scan program
-        instead; ``batch`` must then be the stacked ``[k, ...]`` feed (real
-        arrays or avals)."""
+    def lowered_text(self, state: TrainState, batch, fuse_steps: int = 1,
+                     program: str = "train", donate: bool = False) -> str:
+        """StableHLO text of the compiled step (used by snapshots, tests
+        asserting on collective structure, and the static analyzers in
+        ``analysis/hlo.py``/``analysis/memory.py``). PS values enter as
+        avals — lowering must not cost a real pull.
+
+        ``program="eval"`` lowers the forward-only eval program (falling
+        back to the train step when no eval lowering exists, e.g. step_fn
+        mode). With ``fuse_steps=k > 1``, lowers the fused k-microstep
+        scan program instead; ``batch`` must then be the stacked
+        ``[k, ...]`` feed (real arrays or avals). ``donate=True`` lowers
+        the donated variant — the one that actually runs in steady state
+        — whose entry carry aliases its outputs (what the ADT503
+        donation check and honest peak-HBM estimates need)."""
+        if program not in ("train", "eval"):
+            raise ValueError("program must be 'train' or 'eval', got %r"
+                             % (program,))
+        if program == "eval":
+            ps_avals, _ = self._ps_avals()
+            fn = (self._eval_fn if self._eval_fn is not None
+                  else self._step_fn_nodonate)
+            return fn.lower(state, ps_avals, batch).as_text()
         if fuse_steps > 1:
             ps_avals, opt_avals = self._ps_avals(with_opt=True)
-            return self._fused_fn(donate=False).lower(
+            return self._fused_fn(donate=donate).lower(
                 state, ps_avals, opt_avals, batch).as_text()
         ps_avals, _ = self._ps_avals()
-        return self._step_fn_nodonate.lower(state, ps_avals, batch).as_text()
+        fn = self._step_fn if donate else self._step_fn_nodonate
+        return fn.lower(state, ps_avals, batch).as_text()
 
     # ------------------------------------------------------------- state mgmt
 

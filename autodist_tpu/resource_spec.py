@@ -23,6 +23,20 @@ from autodist_tpu.utils import logging
 DEFAULT_NETWORK_BANDWIDTH_GBPS = 1
 # Default ICI link bandwidth per direction for a v4-like slice, bytes/sec.
 DEFAULT_ICI_BANDWIDTH_GBPS = 400
+# Per-chip HBM capacity by generation, bytes (public figures); "cpu" is
+# host-RAM order for the CPU-mesh development path. The single source of
+# truth for every memory budget in the system — the cost model's
+# feasibility gate and the ADT5xx static HBM analyzer both read it
+# through ResourceSpec.chip_hbm_bytes().
+CHIP_HBM_BYTES = {
+    "v2": 8e9,
+    "v3": 16e9,
+    "v4": 32e9,
+    "v5e": 16e9,
+    "v5p": 95e9,
+    "v6e": 32e9,
+    "cpu": 64e9,
+}
 
 
 class DeviceType(Enum):
@@ -243,6 +257,27 @@ class ResourceSpec:
 
     def ici_bandwidth_gbps(self) -> float:
         return float(self._slice_info.get("ici_bandwidth", DEFAULT_ICI_BANDWIDTH_GBPS))
+
+    def chip_kind(self) -> str:
+        """Chip generation of this cluster ("v4", "v5e", ..., or "cpu"),
+        from ``slice.type`` in the yaml; TPU clusters with no declared
+        type default to v4, chipless specs to the CPU development path."""
+        kind = str(self._slice_info.get("type", "")).lower()
+        for k in sorted(CHIP_HBM_BYTES, key=len, reverse=True):
+            if k != "cpu" and k in kind:
+                return k
+        return "v4" if self.num_tpus else "cpu"
+
+    def chip_hbm_bytes(self) -> float:
+        """Per-chip HBM capacity in bytes — the memory budget one device's
+        params + optimizer state + activations + collective scratch must
+        fit. Overridable per cluster via ``slice.hbm_gib`` in the yaml
+        (e.g. a partial-HBM MIG-style reservation); defaults to the
+        generation's public figure."""
+        override = self._slice_info.get("hbm_gib")
+        if override is not None:
+            return float(override) * (1 << 30)
+        return CHIP_HBM_BYTES[self.chip_kind()]
 
     def node_tpu_count(self, address: str) -> int:
         return len(self._nodes[address].tpu_indices)

@@ -82,8 +82,13 @@ class MetricsHandle:
 class Runner:
     """Owns a DistributedStep + TrainState and runs steps."""
 
-    def __init__(self, distributed_step, tracing: bool = False):
+    def __init__(self, distributed_step, tracing: bool = False,
+                 hbm_budget_bytes: Optional[float] = None):
         self._dstep = distributed_step
+        # per-device HBM budget for memory_report(): AutoDist passes the
+        # resource spec's chip capacity; a bare Runner has no budget and
+        # memory_report only estimates (no ADT501/502 gate)
+        self._hbm_budget = hbm_budget_bytes
         self._remapper = Remapper(distributed_step.mesh,
                                   distributed_step.mesh_axis,
                                   seq_axis=distributed_step.seq_axis,
@@ -329,23 +334,30 @@ class Runner:
         return handle.result() if sync else handle
 
     def lowered_text(self, batch, state: Optional[TrainState] = None,
-                     fuse_steps: int = 1) -> str:
+                     fuse_steps: int = 1, program: str = "train",
+                     donate: bool = False) -> str:
         """StableHLO text of the compiled step for ``batch`` — the input
-        of the post-lowering lint pass (``analysis/lowered.py``). Pure
-        lowering: no step runs, host-PS values enter as avals. With
-        ``fuse_steps=k > 1``, lowers the fused k-microstep scan program
-        (the stacked feed is synthesized as avals from ``batch``)."""
+        of the post-lowering lint pass (``analysis/lowered.py``) and the
+        static HBM/schedule analyzers (``analysis/hlo.py``,
+        ``analysis/memory.py``). Pure lowering: no step runs, host-PS
+        values enter as avals. ``program="eval"`` lowers the
+        forward-only eval program. With ``fuse_steps=k > 1``, lowers the
+        fused k-microstep scan program (the stacked feed is synthesized
+        as avals from ``batch``). ``donate=True`` lowers the donated
+        variant that actually runs in steady state."""
         st = state if state is not None else self.state
         if st is None:
             raise RuntimeError("Runner.lowered_text before init()")
         placed = self._remapper.remap_feed(batch)
-        if fuse_steps > 1:
+        if fuse_steps > 1 and program == "train":
             stacked = jax.tree_util.tree_map(
                 lambda l: jax.ShapeDtypeStruct(
                     (fuse_steps,) + tuple(np.shape(l)), l.dtype), placed)
             return self._dstep.lowered_text(st, stacked,
-                                            fuse_steps=fuse_steps)
-        return self._dstep.lowered_text(st, placed)
+                                            fuse_steps=fuse_steps,
+                                            donate=donate)
+        return self._dstep.lowered_text(st, placed, program=program,
+                                        donate=donate)
 
     def lint_lowered(self, batch, state: Optional[TrainState] = None,
                      fuse_steps: int = 1):
@@ -356,6 +368,100 @@ class Runner:
         from autodist_tpu.analysis import lowered as lowered_lib
         return lowered_lib.lint_runner(self, batch, state,
                                        fuse_steps=fuse_steps)
+
+    def memory_report(self, batch, state: Optional[TrainState] = None,
+                      fuse_steps: int = 1,
+                      hbm_budget_bytes: Optional[float] = None,
+                      donate: bool = True) -> dict:
+        """Static per-device peak-HBM report of the compiled step for
+        ``batch`` — buffer sizes from the lowered program's entry
+        signature (sharding- and donation-aware) plus a liveness sweep
+        for the temporaries, checked against the per-chip HBM budget
+        (``ResourceSpec.chip_hbm_bytes()`` via AutoDist, or an explicit
+        ``hbm_budget_bytes``). Pure lowering: nothing compiles, nothing
+        allocates — OOM surfaces here as an ``ADT501`` diagnostic
+        instead of a runtime crash. ``donate=True`` (default) analyzes
+        the donated program that actually runs in steady state;
+        ``fuse_steps=k`` analyzes the fused superstep program (whose
+        un-donated carry is the ``ADT503`` hazard). See
+        docs/performance.md for reading the report and sizing budgets."""
+        from autodist_tpu.analysis import hlo as hlo_lib
+        from autodist_tpu.analysis import memory as memory_lib
+        text = self.lowered_text(batch, state, fuse_steps=fuse_steps,
+                                 donate=donate)
+        program = hlo_lib.parse_hlo_text(text)
+        est = memory_lib.estimate_from_text(program)
+        schedule = hlo_lib.collective_schedule(program)
+        budget = (hbm_budget_bytes if hbm_budget_bytes is not None
+                  else self._hbm_budget)
+        diags = memory_lib.donation_diagnostics(program,
+                                                fuse_steps=fuse_steps)
+        report = {
+            "program": {"fuse_steps": fuse_steps, "donated": donate,
+                        "num_partitions": est.num_partitions},
+            "estimate": est.to_dict(),
+            "peak_hbm_bytes": round(est.peak_hbm_bytes),
+            "peak_hbm_gib": round(est.peak_hbm_bytes / memory_lib.GIB, 4),
+            "collectives": {
+                "count": len(schedule),
+                "per_step_count": len(schedule.per_step()),
+                "per_class_payload_bytes":
+                    schedule.per_step().class_payload_bytes(),
+            },
+        }
+        if budget is not None:
+            diags = diags + memory_lib.budget_diagnostics(
+                est.peak_hbm_bytes, budget, source="lowered-program")
+            report.update(
+                budget_bytes=round(budget),
+                budget_gib=round(budget / memory_lib.GIB, 4),
+                utilization=(round(est.peak_hbm_bytes / budget, 4)
+                             if budget else None))
+        report["diagnostics"] = diags
+        return report
+
+    def collective_schedule(self, batch, state: Optional[TrainState] = None,
+                            program: str = "train", fuse_steps: int = 1):
+        """The ordered collective schedule (kind, replica groups, payload
+        bytes, loop depth) of one of this runner's compiled programs —
+        see ``analysis/hlo.py``."""
+        from autodist_tpu.analysis import hlo as hlo_lib
+        text = self.lowered_text(batch, state, fuse_steps=fuse_steps,
+                                 program=program)
+        return hlo_lib.collective_schedule(text)
+
+    def static_profile(self, batch, state: Optional[TrainState] = None,
+                       fuse_steps: int = 1):
+        """Measured per-collective wire bytes of the compiled step — a
+        ``StaticCollectiveProfile`` to attach to a ``Simulator`` /
+        ``CostModel`` (``attach_static_profile``), replacing the jaxpr
+        cost heuristics with what the lowering actually emits."""
+        from autodist_tpu.simulator.cost_model import StaticCollectiveProfile
+        schedule = self.collective_schedule(batch, state,
+                                            fuse_steps=fuse_steps)
+        n_dev = max(int(getattr(self._dstep.mesh, "size", 1)), 1)
+        return StaticCollectiveProfile.from_schedule(
+            schedule, default_group_size=n_dev)
+
+    def lint_schedules(self, batch, state: Optional[TrainState] = None,
+                       fuse_steps: int = 1):
+        """Cross-program collective-schedule consistency (ADT510/511):
+        the eval program — and, with ``fuse_steps=k > 1``, the fused
+        superstep program's per-microstep body — must embed into the
+        train step's schedule, or replicas running different programs on
+        the same mesh deadlock in mismatched collectives."""
+        from autodist_tpu.analysis import hlo as hlo_lib
+        train = self.collective_schedule(batch, state)
+        diags = list(hlo_lib.compare_schedules(
+            train, self.collective_schedule(batch, state, program="eval"),
+            "train", "eval"))
+        if fuse_steps > 1:
+            diags += hlo_lib.compare_schedules(
+                train,
+                self.collective_schedule(batch, state,
+                                         fuse_steps=fuse_steps),
+                "train", "fused")
+        return diags
 
     def step_stats(self) -> dict:
         """Wall-time statistics over this runner's steps (the throughput

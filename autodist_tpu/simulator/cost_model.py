@@ -17,6 +17,7 @@ from typing import Dict, Optional
 
 from autodist_tpu.strategy.base import (AllReduceSynchronizer, PSSynchronizer,
                                         Strategy)
+from autodist_tpu.utils import logging
 
 # Peak dense bf16 FLOP/s per chip by generation (public figures).
 CHIP_PEAK_FLOPS = {
@@ -25,13 +26,9 @@ CHIP_PEAK_FLOPS = {
     "v5p": 459e12,
     "cpu": 5e10,
 }
-# HBM per chip by generation (public figures); "cpu" is host RAM order
-CHIP_HBM_BYTES = {
-    "v4": 32e9,
-    "v5e": 16e9,
-    "v5p": 95e9,
-    "cpu": 64e9,
-}
+# HBM per chip now lives in resource_spec.py (the ResourceSpec owns the
+# cluster's memory budget; re-exported here for back-compat)
+from autodist_tpu.resource_spec import CHIP_HBM_BYTES  # noqa: E402,F401
 # extra compute for gradient rematerialization: "full" re-runs the whole
 # forward in the backward (fwd+bwd ~3x fwd -> ~4x), "dots" recomputes
 # only the cheap non-contraction work (~3.5x)
@@ -98,6 +95,48 @@ def collective_wire_bytes(kind: str, traced_bytes: float, k: int,
 
 
 @dataclasses.dataclass
+class StaticCollectiveProfile:
+    """Measured per-step collective costs of a LOWERED program — the
+    replacement for the jaxpr-level heuristics when a lowering exists.
+
+    Built from a :class:`~autodist_tpu.analysis.hlo.CollectiveSchedule`
+    (duck-typed: anything iterable of objects with ``kind``,
+    ``payload_bytes`` and ``group_size``). Payloads are the per-device
+    operand bytes the program actually moves (forward AND backward ops
+    are both present in the text, so no dual-class doubling applies);
+    wire bytes are ring-priced per op at its OWN replica-group size —
+    more precise than pricing by a single mesh-axis extent.
+    """
+
+    class_payload_bytes: Dict[str, float]
+    class_wire_bytes: Dict[str, float]
+    num_collectives: int = 0
+
+    @classmethod
+    def from_schedule(cls, schedule,
+                      default_group_size: int = 1) -> "StaticCollectiveProfile":
+        per_step = (schedule.per_step() if hasattr(schedule, "per_step")
+                    else schedule)
+        payload: Dict[str, float] = {}
+        wire: Dict[str, float] = {}
+        n = 0
+        for c in per_step:
+            k = c.group_size if c.group_size > 1 else default_group_size
+            if k <= 1:
+                continue  # single-device group: no wire crossed
+            payload[c.kind] = payload.get(c.kind, 0.0) + c.payload_bytes
+            wire[c.kind] = (wire.get(c.kind, 0.0)
+                            + collective_wire_bytes(c.kind,
+                                                    c.payload_bytes, k))
+            n += 1
+        return cls(payload, wire, n)
+
+    @property
+    def total_wire_bytes(self) -> float:
+        return sum(self.class_wire_bytes.values())
+
+
+@dataclasses.dataclass
 class CostBreakdown:
     compute_s: float
     allreduce_s: float
@@ -134,14 +173,29 @@ class CostModel:
                  mxu_efficiency: float = DEFAULT_MXU_EFFICIENCY,
                  flops_per_step: Optional[float] = None,
                  hbm_capacity_bytes: Optional[float] = None,
-                 calibration=None, while_trip_count: int = 1):
+                 calibration=None, while_trip_count: int = 1,
+                 static_profile: Optional[StaticCollectiveProfile] = None):
         self._item = model_item
         self._spec = resource_spec
+        # measured collective costs from lowered programs: one profile per
+        # strategy id, plus an optional default applied to every strategy
+        # (the `static_profile` kwarg). When a strategy has a profile, its
+        # collective seconds are priced from MEASURED wire bytes and the
+        # heuristic-vs-measured drift is logged per collective class.
+        self._static_profiles: Dict[Optional[str], StaticCollectiveProfile] = {}
+        if static_profile is not None:
+            self._static_profiles[None] = static_profile
         self._chip = chip_kind or self._guess_chip()
         self._eff = mxu_efficiency
         self._flops = flops_per_step
-        self._hbm_capacity = (hbm_capacity_bytes if hbm_capacity_bytes
-                              is not None else CHIP_HBM_BYTES[self._chip])
+        if hbm_capacity_bytes is not None:
+            self._hbm_capacity = hbm_capacity_bytes
+        elif chip_kind is not None:
+            # an explicit chip override prices that generation's memory
+            # even when the spec describes another
+            self._hbm_capacity = CHIP_HBM_BYTES[chip_kind]
+        else:
+            self._hbm_capacity = resource_spec.chip_hbm_bytes()
         self._act_cache = None
         # assumed iterations for while_loop bodies when profiling the
         # loss's collectives (statically unknowable; see
@@ -154,6 +208,53 @@ class CostModel:
             calibration = Calibration.load(calibration)
         self.calibration = calibration
 
+    def attach_static_profile(self, profile: StaticCollectiveProfile,
+                              strategy: Optional[Strategy] = None):
+        """Attach MEASURED collective costs (extracted from a lowered
+        program via ``analysis.hlo.collective_schedule`` /
+        ``Runner.static_profile``) for ``strategy`` — or, with no
+        strategy, as the default for every estimate. Subsequent
+        :meth:`estimate` calls price that strategy's collectives from the
+        measured wire bytes instead of the jaxpr heuristics and log the
+        per-class drift."""
+        key = getattr(strategy, "id", None) if strategy is not None else None
+        self._static_profiles[key] = profile
+
+    def _static_profile_for(self, strategy: Strategy
+                            ) -> Optional[StaticCollectiveProfile]:
+        by_id = self._static_profiles.get(getattr(strategy, "id", None))
+        return by_id if by_id is not None else self._static_profiles.get(None)
+
+    def _heuristic_wire_by_class(self, strategy: Strategy, n: int,
+                                 ar_bytes: float) -> Dict[str, float]:
+        """The jaxpr-heuristic wire bytes per collective class — the
+        numbers a static profile replaces, kept for drift logging."""
+        out: Dict[str, float] = {}
+        if n > 1 and ar_bytes > 0:
+            out["reduce"] = 2.0 * (n - 1) / n * ar_bytes
+        mesh_shape = strategy.graph_config.mesh_shape or {}
+        for axis, by_kind in self._collective_profile().items():
+            k = int(mesh_shape.get(axis, 1))
+            if k <= 1:
+                continue
+            for kind, traced in by_kind.items():
+                out[kind] = out.get(kind, 0.0) + (
+                    collective_wire_bytes(kind, traced, k, "fwd")
+                    + collective_wire_bytes(kind, traced, k, "bwd"))
+        return out
+
+    def _log_static_drift(self, strategy: Strategy,
+                          profile: StaticCollectiveProfile, n: int,
+                          ar_bytes: float):
+        heur = self._heuristic_wire_by_class(strategy, n, ar_bytes)
+        for kind in sorted(set(heur) | set(profile.class_wire_bytes)):
+            h = heur.get(kind, 0.0)
+            m = profile.class_wire_bytes.get(kind, 0.0)
+            ratio = (m / h) if h > 0 else float("inf") if m > 0 else 1.0
+            logging.info(
+                "static profile drift [%s/%s]: heuristic=%.0fB "
+                "measured=%.0fB ratio=%.2f", strategy.id, kind, h, m, ratio)
+
     def verify(self, strategy: Strategy):
         """Static diagnostics for a candidate (``analysis/rules.py``):
         the cheap validity gate the simulator applies BEFORE estimating —
@@ -163,11 +264,8 @@ class CostModel:
         return _verify(strategy, self._item, self._spec)
 
     def _guess_chip(self) -> str:
-        kind = str(self._spec.slice_info.get("type", "")).lower()
-        for k in ("v5p", "v5e", "v4"):
-            if k in kind:
-                return k
-        return "v4" if self._spec.num_tpus else "cpu"
+        kind = self._spec.chip_kind()
+        return kind if kind in CHIP_PEAK_FLOPS else "v4"
 
     # ---------------------------------------------------------------- pieces
 
@@ -339,6 +437,20 @@ class CostModel:
             total += wire / ici_bw
         return total
 
+    def opt_state_bytes(self) -> float:
+        """Total optimizer-state bytes (full tree, undistributed); 0.0
+        when no optimizer is attached. Shared by :meth:`hbm_bytes` and
+        the plan-level memory analyzer (``analysis/memory.py``)."""
+        try:
+            import jax
+            import numpy as np
+            spec = self._item.opt_state_spec
+            return float(sum(
+                int(np.prod(l.shape or (1,))) * np.dtype(l.dtype).itemsize
+                for l in jax.tree_util.tree_leaves(spec)))
+        except Exception:  # noqa: BLE001 — no optimizer attached
+            return 0.0
+
     def hbm_bytes(self, strategy: Strategy) -> float:
         """Per-device HBM estimate under a strategy: device-resident
         params + optimizer state + one gradient buffer + activations.
@@ -347,18 +459,9 @@ class CostModel:
         replica count (ZeRO); ``graph_config.remat`` shrinks the
         activation term ("dots": contraction outputs only; "full":
         batch residuals plus the peak recompute window)."""
-        import jax
-        import numpy as np
         infos = self._item.var_infos
         n = max(len(strategy.graph_config.replicas), 1)
-        opt_total = 0.0
-        try:
-            spec = self._item.opt_state_spec
-            opt_total = sum(
-                int(np.prod(l.shape or (1,))) * np.dtype(l.dtype).itemsize
-                for l in jax.tree_util.tree_leaves(spec))
-        except Exception:  # noqa: BLE001 — no optimizer attached
-            pass
+        opt_total = self.opt_state_bytes()
         params_total = float(self._item.total_bytes())
 
         mesh_shape = strategy.graph_config.mesh_shape or {}
@@ -493,6 +596,20 @@ class CostModel:
 
         # ring all-reduce: 2*(N-1)/N of the payload crosses each link
         allreduce_s = (2.0 * (n - 1) / n) * ar_bytes / ici_bw if n > 1 else 0.0
+        mp_s = self.mp_comm_time(strategy, ici_bw)
+        profile = self._static_profile_for(strategy)
+        if profile is not None:
+            # a lowering exists: price collectives from the MEASURED wire
+            # bytes (fwd+bwd ops are both in the program text, each ring-
+            # priced at its own replica-group size) and log the drift the
+            # heuristics would have had. Reduce-class stays on the
+            # overlappable gradient path; everything else (gathers,
+            # permutes, all-to-alls) is in-loss model-parallel traffic on
+            # the compute critical path, like the heuristic mp_s.
+            self._log_static_drift(strategy, profile, n, ar_bytes)
+            allreduce_s = profile.class_wire_bytes.get("reduce", 0.0) / ici_bw
+            mp_s = sum(w for kind, w in profile.class_wire_bytes.items()
+                       if kind != "reduce") / ici_bw
         # PS (host-offloaded, no proxy): every step pulls values host->device
         # and pushes grads device->host over PCIe on each node, plus
         # cross-node serving over the busiest server's NIC
@@ -526,7 +643,6 @@ class CostModel:
                 # the stashed input in its backward tick (per-microbatch
                 # remat): ~one extra forward on top of fwd+bwd
                 compute_s *= F1B_RECOMPUTE_FACTOR
-        mp_s = self.mp_comm_time(strategy, ici_bw)
         cal = self.calibration
         if cal is not None:
             compute_s *= cal.compute_scale

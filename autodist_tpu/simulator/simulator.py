@@ -61,6 +61,12 @@ class Simulator:
         return SimulationResult(strategy, self._cost_model.estimate(strategy),
                                 label)
 
+    def attach_static_profile(self, profile, strategy: Strategy = None):
+        """Attach measured collective costs from a lowered program (see
+        ``CostModel.attach_static_profile``); subsequent simulate/rank
+        calls price that strategy from measurements, logging drift."""
+        self._cost_model.attach_static_profile(profile, strategy)
+
     def calibrate(self, measured: Sequence[Tuple[Strategy, float]],
                   save_path: Optional[str] = None):
         """Fit the cost model's term scales to measured step times
@@ -84,8 +90,8 @@ class Simulator:
             cal.save(save_path)
         return cal
 
-    def rank(self, candidates: Sequence[Tuple[str, Strategy]]
-             ) -> List[SimulationResult]:
+    def rank(self, candidates: Sequence[Tuple[str, Strategy]],
+             skip_projected_oom: bool = False) -> List[SimulationResult]:
         """Feasible (fits-in-HBM) candidates rank ahead of infeasible
         ones regardless of estimated speed — a fast strategy that OOMs is
         not a strategy; within each group, cheapest step time wins. If
@@ -100,7 +106,14 @@ class Simulator:
         error-severity diagnostics are skipped with a logged reason —
         there is no point ranking a plan that cannot compile. If EVERY
         candidate fails verification the unverified ranking is returned
-        (with a warning) so a caller always gets an ordering."""
+        (with a warning) so a caller always gets an ordering.
+
+        ``skip_projected_oom=True`` additionally DROPS candidates whose
+        memory estimate raises ``ADT501`` (projected per-device OOM
+        against the chip's HBM budget), mirroring the verify() skip path
+        — each skip is logged with the diagnostic, and if every candidate
+        would OOM the unskipped ranking is returned with a warning. The
+        default keeps the softer rank-infeasible-last behavior."""
         from autodist_tpu.analysis.diagnostics import Severity
         kept = []
         for label, s in candidates:
@@ -122,6 +135,26 @@ class Simulator:
                 "the same diagnostics")
             kept = list(candidates)
         results = [self.simulate(s, label) for label, s in kept]
+        if skip_projected_oom:
+            from autodist_tpu.analysis.memory import budget_diagnostics
+            fitting = []
+            for r in results:
+                oom = [d for d in budget_diagnostics(
+                    r.breakdown.hbm_bytes, r.breakdown.hbm_capacity,
+                    source="plan-level") if d.code == "ADT501"]
+                if oom:
+                    logging.info(
+                        "simulator: skipping projected-OOM candidate %s: "
+                        "%s", r.label or r.strategy.id, oom[0].format())
+                    continue
+                fitting.append(r)
+            if results and not fitting:
+                logging.warning(
+                    "simulator: every candidate is projected to OOM "
+                    "(ADT501); ranking them anyway — expect allocation "
+                    "failures at the first step")
+            else:
+                results = fitting
         results.sort(key=lambda r: (not r.breakdown.feasible,
                                     r.step_time_s * _risk_premium(r.strategy)))
         if results and not results[0].breakdown.feasible:

@@ -521,6 +521,55 @@ e:f32[4] = cond[
         lint_lowered_text("b:f32[4] = psum[axes=('data',)] a"))
 
 
+def test_lowered_nested_scan_in_scan_flags_adt408():
+    """Regression: region tracking beyond one level. A scan-in-scan
+    program (jaxpr pretty-print) must flag a host transfer in the INNER
+    body, in the outer body AFTER the inner scan closes, and — the case
+    the old brace-only tracker lost — inside a ``while[`` whose statement
+    carries TWO sub-jaxprs (cond_jaxpr + body_jaxpr)."""
+    inner = """
+c:f32[] d:f32[3,4] = scan[
+  jaxpr={ lambda ; e:f32[] f:f32[4]. let
+      g:f32[] = scan[
+        jaxpr={ lambda ; h:f32[] i:f32[]. let
+            j:f32[] = outfeed h
+          in (j,) }
+      ] e f
+    in (g,) }
+] a b
+"""
+    assert "ADT408" in codes(lint_lowered_text(inner))
+    after_inner = """
+c:f32[] = scan[
+  jaxpr={ lambda ; e:f32[]. let
+      g:f32[] = scan[
+        jaxpr={ lambda ; h:f32[]. let
+            k:f32[] = add h h
+          in (k,) }
+      ] e
+      m:f32[] = outfeed g
+    in (m,) }
+] a
+o:f32[] = outfeed c
+"""
+    diags = lint_lowered_text(after_inner)
+    # in-loop transfer is ADT408; the one AFTER the whole scan statement
+    # closes is back on the flat hot path (ADT406)
+    assert {"ADT406", "ADT408"} <= codes(diags)
+    two_region_while = """
+b:f32[] = while[
+  cond_jaxpr={ lambda ; a:f32[]. let
+      c:bool[] = lt a 1.0
+    in (c,) }
+  body_jaxpr={ lambda ; a:f32[]. let
+      d:f32[] = outfeed a
+    in (d,) }
+] x
+"""
+    diags = lint_lowered_text(two_region_while)
+    assert "ADT408" in codes(diags) and "ADT406" not in codes(diags)
+
+
 def test_cli_strategy_json_deserialize_defect_exits_one(tmp_path, capsys):
     """A plan whose defect surfaces at DESERIALIZE time (unknown
     synchronizer kind) is still an ADT finding: exit 1 with ADT301 in

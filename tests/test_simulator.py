@@ -165,6 +165,45 @@ def test_feasibility_gate_prefers_remat_when_tight():
     assert not ranked[1].breakdown.feasible
 
 
+def test_rank_skip_projected_oom_drops_adt501_candidates(caplog):
+    """Satellite: with ``skip_projected_oom=True`` a candidate whose
+    memory estimate raises ADT501 (projected per-device OOM) is DROPPED
+    from the ranking with a logged reason — mirroring the verify() skip
+    path — and when every candidate would OOM, the unskipped ranking is
+    returned with a warning instead of an empty list."""
+    import logging as pylogging
+    from autodist_tpu.utils.logging import get_logger
+    item, spec = _item(), _spec()
+    cands = [("plain", S.AllReduce().build(item, spec)),
+             ("remat", S.WithRemat(S.AllReduce(),
+                                   policy="dots").build(item, spec))]
+    roomy = Simulator(item, spec, hbm_capacity_bytes=1e15)
+    plain_hbm = roomy.simulate(cands[0][1]).breakdown.hbm_bytes
+    remat_hbm = roomy.simulate(cands[1][1]).breakdown.hbm_bytes
+    tight = Simulator(item, spec,
+                      hbm_capacity_bytes=(plain_hbm + remat_hbm) / 2)
+    # default keeps the soft behavior: infeasible candidates rank last
+    assert [r.label for r in tight.rank(cands)] == ["remat", "plain"]
+    logger = get_logger()
+    logger.addHandler(caplog.handler)
+    try:
+        with caplog.at_level(pylogging.INFO, logger="autodist_tpu"):
+            skipped = tight.rank(cands, skip_projected_oom=True)
+            # every candidate OOMs -> fall back to the full ranking
+            impossible = Simulator(item, spec,
+                                   hbm_capacity_bytes=min(plain_hbm,
+                                                          remat_hbm) / 2)
+            all_oom = impossible.rank(cands, skip_projected_oom=True)
+    finally:
+        logger.removeHandler(caplog.handler)
+    assert [r.label for r in skipped] == ["remat"]
+    assert any("skipping projected-OOM" in r.getMessage()
+               and "ADT501" in r.getMessage() for r in caplog.records)
+    assert len(all_oom) == 2
+    assert any("every candidate is projected to OOM" in r.getMessage()
+               for r in caplog.records)
+
+
 def _activation_heavy_item(batch=8192, width=64, depth=8):
     """Small params, huge per-step activations — the regime where remat
     (not ZeRO/host-PS, which relieve PARAM/opt memory) is the right
