@@ -65,6 +65,8 @@ def reset():
     from autodist_tpu.ops import embedding
     embedding.clear_capture()
     patch.clear_captured()
+    from autodist_tpu.telemetry import spans as _tspans
+    _tspans.reset()  # drop recorded spans/counters, re-read ADT_TRACE
 
 
 class AutoDist:

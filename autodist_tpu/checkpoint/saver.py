@@ -131,10 +131,12 @@ class Saver:
             dstep = runner_or_step
         if state is None:
             raise ValueError("no state to save")
+        from autodist_tpu.telemetry import spans as tel
         # cross-process collectives: run on all processes before any gating
-        params = dstep.gather_params(state)
-        opt_state_host = dstep.gather_opt_state(state)
-        sync_state_host = dstep.gather_sync_state(state)
+        with tel.span("ckpt.gather", "ckpt"):
+            params = dstep.gather_params(state)
+            opt_state_host = dstep.gather_opt_state(state)
+            sync_state_host = dstep.gather_sync_state(state)
         if step is None:
             step = int(jax.device_get(state.step))
         if self.chief_only and not const.is_chief():
@@ -144,16 +146,19 @@ class Saver:
                 "strategy_id": dstep.strategy.id}
 
         def write():
-            np.savez(path + ".params.npz", **_tree_to_flat(params))
-            np.savez(path + ".opt.npz", **_tree_to_flat(opt_state_host))
-            sync_flat = _tree_to_flat(sync_state_host)
-            if sync_flat:
-                np.savez(path + ".sync.npz", **sync_flat)
-            # meta last: a checkpoint only becomes visible to _own_metas /
-            # latest() once all its data files exist
-            with open(path + ".meta.json", "w") as f:
-                json.dump(meta, f)
-            self._gc()
+            with tel.span("ckpt.write", "ckpt", step=int(step)):
+                np.savez(path + ".params.npz", **_tree_to_flat(params))
+                np.savez(path + ".opt.npz", **_tree_to_flat(opt_state_host))
+                sync_flat = _tree_to_flat(sync_state_host)
+                if sync_flat:
+                    np.savez(path + ".sync.npz", **sync_flat)
+                # meta last: a checkpoint only becomes visible to
+                # _own_metas / latest() once all its data files exist
+                with open(path + ".meta.json", "w") as f:
+                    json.dump(meta, f)
+            with tel.span("ckpt.gc", "ckpt"):
+                self._gc()
+            tel.counter_add("ckpt.saves")
             logging.info("saved checkpoint %s (step %d)", path, step)
 
         if not self.async_save:

@@ -56,6 +56,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from autodist_tpu import const
 from autodist_tpu.checkpoint.saver import BackgroundWriter
 from autodist_tpu.kernel.common import variable_utils
+from autodist_tpu.telemetry import spans as tel
 from autodist_tpu.utils import logging
 
 _FORMAT = "autodist_tpu.sharded.v1"
@@ -248,12 +249,14 @@ class ShardedSaver:
             if var and var in dstep.layouts:
                 opt_layouts[n] = dstep.layouts[var]
         suffix = self._mesh_suffix(dstep)
-        self._device_tree_entries("P", state.params, collect, leaves_meta,
-                                  dstep.layouts, suffix)
-        self._device_tree_entries("O", state.opt_state, collect, leaves_meta,
-                                  opt_layouts, suffix)
-        self._device_tree_entries("S", state.sync_state, collect, leaves_meta,
-                                  {}, suffix)
+        with tel.span("ckpt.collect", "ckpt", step=int(step),
+                      mode="async" if self.async_save else "sync"):
+            self._device_tree_entries("P", state.params, collect,
+                                      leaves_meta, dstep.layouts, suffix)
+            self._device_tree_entries("O", state.opt_state, collect,
+                                      leaves_meta, opt_layouts, suffix)
+            self._device_tree_entries("S", state.sync_state, collect,
+                                      leaves_meta, {}, suffix)
 
         ps_meta: Dict[str, dict] = {}
         store = dstep.ps_store
@@ -298,39 +301,51 @@ class ShardedSaver:
         }
 
         def write(barrier=None):
-            shard_path = "%s.shard-p%d.npz" % (base, pid)
-            tmp = shard_path + ".tmp"
-            w = _StreamingNpzWriter(tmp)
-            w.write("__nonce__", np.frombuffer(nonce.encode(), np.uint8))
-            written_keys: List[str] = []
-            for item in entries:
-                if callable(item):  # per-shard group producer (PS)
-                    for key, arr in item():
-                        w.write(key, arr)
+            with tel.span("ckpt.write", "ckpt", step=int(step)):
+                shard_path = "%s.shard-p%d.npz" % (base, pid)
+                tmp = shard_path + ".tmp"
+                w = _StreamingNpzWriter(tmp)
+                w.write("__nonce__", np.frombuffer(nonce.encode(), np.uint8))
+                written_keys: List[str] = []
+                for item in entries:
+                    if callable(item):  # per-shard group producer (PS)
+                        for key, arr in item():
+                            w.write(key, arr)
+                            written_keys.append(key)
+                    else:
+                        key, arr = item
+                        w.write(key, arr() if callable(arr) else arr)
                         written_keys.append(key)
-                else:
-                    key, arr = item
-                    w.write(key, arr() if callable(arr) else arr)
-                    written_keys.append(key)
-            w.close()
-            os.replace(tmp, shard_path)
-            index_path = "%s.shard-p%d.index.json" % (base, pid)
-            tmp = index_path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump({"pid": pid, "nonce": nonce,
-                           "keys": written_keys}, f)
-            os.replace(tmp, index_path)
-            entries.clear()  # free the host copies as soon as they're on disk
+                w.close()
+                os.replace(tmp, shard_path)
+                index_path = "%s.shard-p%d.index.json" % (base, pid)
+                tmp = index_path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"pid": pid, "nonce": nonce,
+                               "keys": written_keys}, f)
+                os.replace(tmp, index_path)
+                entries.clear()  # free host copies once they're on disk
             if barrier is not None:
-                barrier()
+                t_bar = time.monotonic()
+                with tel.span("ckpt.barrier", "ckpt", step=int(step),
+                              kind="device"):
+                    barrier()
+                tel.counter_add("ckpt.barrier_s",
+                                time.monotonic() - t_bar)
             if pid == 0:
-                key_owner = self._await_indexes(base, nproc)
+                t_bar = time.monotonic()
+                with tel.span("ckpt.barrier", "ckpt", step=int(step),
+                              kind="index-files"):
+                    key_owner = self._await_indexes(base, nproc)
+                tel.counter_add("ckpt.barrier_s", time.monotonic() - t_bar)
                 meta["keys"] = key_owner
                 tmp = base + ".shard-meta.json.tmp"
                 with open(tmp, "w") as f:
                     json.dump(meta, f)
                 os.replace(tmp, base + ".shard-meta.json")
-                self._gc()
+                with tel.span("ckpt.gc", "ckpt"):
+                    self._gc()
+                tel.counter_add("ckpt.saves")
                 logging.info("sharded checkpoint %s committed (step %d, "
                              "%d keys over %d processes)", base, step,
                              len(key_owner), nproc)
@@ -418,6 +433,7 @@ class ShardedSaver:
                 if f == fname or (f.startswith(base + ".shard-p")):
                     try:
                         os.remove(os.path.join(self.directory, f))
+                        tel.counter_add("ckpt.gc_removed")
                     except FileNotFoundError:
                         pass
 

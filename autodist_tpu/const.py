@@ -139,6 +139,19 @@ class ENV(Enum):
     # (0 = auto: min(4, cpu_count); 1 = the single-dispatch baseline).
     # Bit-exact either way — grouping never changes per-shard math.
     ADT_PS_APPLY_THREADS = ("ADT_PS_APPLY_THREADS", int, 0)
+    # ---- runtime telemetry (telemetry/spans.py; docs/observability.md)
+    # span tracing mode: "0" off (counters still collected), "1" record
+    # every span, "sampled" record 1/ADT_TRACE_SAMPLE spans
+    ADT_TRACE = ("ADT_TRACE", str, "0")
+    # ring-buffer capacity (completed spans kept; oldest dropped first)
+    ADT_TRACE_BUFFER = ("ADT_TRACE_BUFFER", int, 65536)
+    # sampled-mode stride: record one span out of every N
+    ADT_TRACE_SAMPLE = ("ADT_TRACE_SAMPLE", int, 16)
+    # where bench/CLI write exported traces by default
+    ADT_TRACE_FILE = ("ADT_TRACE_FILE", str, "")
+    # log line format: "text" (default) or "json" (structured lines
+    # carrying span ids so logs correlate with traces)
+    ADT_LOG_FORMAT = ("ADT_LOG_FORMAT", str, "text")
 
     @property
     def val(self):

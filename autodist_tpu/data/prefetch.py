@@ -12,6 +12,8 @@ from typing import Callable, Iterable, Iterator
 
 import numpy as np
 
+from autodist_tpu.telemetry import spans as tel
+
 
 def stack_batches(group):
     """Stack a list of same-structure batches into one ``[k, ...]`` feed
@@ -83,6 +85,9 @@ class DevicePrefetcher:
         self._it = iter(iterable)
         self._queue = collections.deque()
         self._exhausted = False
+        # observable data-loss accounting (stack mode's dropped tails)
+        self.dropped_batches = 0
+        self.dropped_examples = 0
 
     def _next_host_item(self):
         """One queue item's host batch: a plain batch, or a [k, ...]
@@ -98,13 +103,36 @@ class DevicePrefetcher:
         if not group:
             raise StopIteration
         if len(group) < self.stack_k:
+            # count the DATA cost of the drop, not just the event: the
+            # tail's examples never train (once per epoch — the iterator
+            # is exhausted exactly once), and the registry exposes the
+            # running totals so a multi-epoch job can see the loss rate
+            examples = sum(self._batch_examples(b) for b in group)
+            self.dropped_batches += len(group)
+            self.dropped_examples += examples
+            tel.counter_add("prefetch.dropped_batches", len(group))
+            tel.counter_add("prefetch.dropped_examples", examples)
+            tel.instant("prefetch.dropped_tail", "prefetch",
+                        batches=len(group), examples=examples)
             from autodist_tpu.utils import logging
             logging.warning(
                 "DevicePrefetcher(stack=%d): dropping trailing group of "
-                "%d batch(es) — a short stack would recompile the fused "
-                "program", self.stack_k, len(group))
+                "%d batch(es) / %d example(s) this epoch — a short stack "
+                "would recompile the fused program (totals so far: %d "
+                "batches, %d examples)", self.stack_k, len(group),
+                examples, self.dropped_batches, self.dropped_examples)
             raise StopIteration
         return stack_batches(group)
+
+    @staticmethod
+    def _batch_examples(batch) -> int:
+        """Leading-dim example count of one host batch (0 if opaque)."""
+        import jax
+        for leaf in jax.tree_util.tree_leaves(batch):
+            shape = np.shape(leaf)
+            if len(shape) >= 1:
+                return int(shape[0])
+        return 0
 
     def _fill(self):
         while not self._exhausted and len(self._queue) < self._depth:
@@ -114,7 +142,12 @@ class DevicePrefetcher:
                 self._exhausted = True
                 return
             # placement is async: this enqueues the transfer and returns
-            self._queue.append(self._place(host_batch))
+            with tel.span("prefetch.place", "prefetch",
+                          stack=self.stack_k):
+                self._queue.append(self._place(host_batch))
+        # occupancy AFTER filling: 0 here means the consumer is about to
+        # stall on the host side — the starvation signal
+        tel.gauge_set("prefetch.queue_depth", len(self._queue))
 
     def __iter__(self) -> Iterator:
         return self
@@ -124,6 +157,7 @@ class DevicePrefetcher:
         if not self._queue:
             raise StopIteration
         out = self._queue.popleft()
+        tel.counter_add("prefetch.batches")
         self._fill()  # immediately start the replacement transfer
         return out
 

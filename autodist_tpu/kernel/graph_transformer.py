@@ -35,6 +35,7 @@ from autodist_tpu.kernel.synchronization.synchronizer import Synchronizer
 from autodist_tpu.parallel import collectives
 from autodist_tpu.parallel import ps as ps_lib
 from autodist_tpu.strategy.base import Strategy
+from autodist_tpu.telemetry import spans as tel
 from autodist_tpu.train_state import TrainState
 from autodist_tpu.utils import logging
 
@@ -119,11 +120,13 @@ class DistributedStep:
         microstep that ran."""
         if self.ps_store is None:
             return {}
-        self._flush_fused_ps()
-        if self._ps_pipe is not None:
-            return self._ps_pipe.values()
-        from autodist_tpu.parallel.mesh import tree_to_mesh
-        return tree_to_mesh(self.mesh, self.ps_store.pull(), P())
+        with tel.span("dstep.pull_ps", "dstep"):
+            tel.counter_add("dstep.ps_pulls")
+            self._flush_fused_ps()
+            if self._ps_pipe is not None:
+                return self._ps_pipe.values()
+            from autodist_tpu.parallel.mesh import tree_to_mesh
+            return tree_to_mesh(self.mesh, self.ps_store.pull(), P())
 
     # back-compat spelling (promoted to the public name above)
     _pull_ps = pull_ps
@@ -151,9 +154,11 @@ class DistributedStep:
         gather, mirror digest) must see all submitted gradients applied."""
         if self.ps_store is None:
             return
-        if self._ps_pipe_existing is not None:
-            self._ps_pipe_existing.flush()
-        self._flush_fused_ps()
+        with tel.span("dstep.flush_ps", "dstep"):
+            tel.counter_add("dstep.ps_flushes")
+            if self._ps_pipe_existing is not None:
+                self._ps_pipe_existing.flush()
+            self._flush_fused_ps()
 
     def invalidate_ps(self) -> None:
         """Flush and discard the pipeline's staged values and the fused
@@ -179,14 +184,16 @@ class DistributedStep:
         if self.ps_store is None:
             return {}, {}
         if self._fused_ps_vals is None:
-            self.flush_ps()
-            from autodist_tpu.parallel.mesh import tree_to_mesh
-            self._fused_ps_vals = tree_to_mesh(
-                self.mesh, self.ps_store.pull(), P())
-            self._fused_ps_opt = tree_to_mesh(
-                self.mesh,
-                {n: self.ps_store.full_little_opt(n)
-                 for n in self.ps_store.var_names}, P())
+            with tel.span("dstep.pull_ps", "dstep", fused=True):
+                tel.counter_add("dstep.ps_pulls")
+                self.flush_ps()
+                from autodist_tpu.parallel.mesh import tree_to_mesh
+                self._fused_ps_vals = tree_to_mesh(
+                    self.mesh, self.ps_store.pull(), P())
+                self._fused_ps_opt = tree_to_mesh(
+                    self.mesh,
+                    {n: self.ps_store.full_little_opt(n)
+                     for n in self.ps_store.var_names}, P())
         return self._fused_ps_vals, self._fused_ps_opt
 
     def _flush_fused_ps(self) -> None:
@@ -279,14 +286,16 @@ class DistributedStep:
             raise ValueError(
                 "stacked batch has mismatched leading (microstep) dims %s"
                 % sorted(lead))
-        ps_vals, ps_opt = self._ensure_fused_ps_carry()
-        new_state, new_vals, new_opt, metrics = fn(
-            state, ps_vals, ps_opt, stacked_batch)
-        if self.ps_store is not None:
-            self._fused_ps_vals, self._fused_ps_opt = new_vals, new_opt
-            self._fused_ps_dirty = True
-        self.dispatches += 1
-        return new_state, metrics
+        with tel.span("dstep.dispatch", "dstep", fused=True):
+            ps_vals, ps_opt = self._ensure_fused_ps_carry()
+            new_state, new_vals, new_opt, metrics = fn(
+                state, ps_vals, ps_opt, stacked_batch)
+            if self.ps_store is not None:
+                self._fused_ps_vals, self._fused_ps_opt = new_vals, new_opt
+                self._fused_ps_dirty = True
+            self.dispatches += 1
+            tel.counter_add("dstep.dispatches")
+            return new_state, metrics
 
     def close_ps(self) -> None:
         """Flush the pipeline, land the fused carry, and shut the
@@ -309,11 +318,13 @@ class DistributedStep:
         buffers — callers holding their own reference to the input state must
         pass ``donate=False``."""
         fn = self._step_fn if donate else self._step_fn_nodonate
-        ps_vals = self.pull_ps()
-        new_state, ps_grads, metrics = fn(state, ps_vals, batch)
-        self._push_ps(ps_grads)
-        self.dispatches += 1
-        return new_state, metrics
+        with tel.span("dstep.dispatch", "dstep", fused=False):
+            ps_vals = self.pull_ps()
+            new_state, ps_grads, metrics = fn(state, ps_vals, batch)
+            self._push_ps(ps_grads)
+            self.dispatches += 1
+            tel.counter_add("dstep.dispatches")
+            return new_state, metrics
 
     def evaluate(self, state: TrainState, batch, ps_vals=None):
         """Forward-only metrics: no grads, no optimizer, no gradient

@@ -49,6 +49,7 @@ import numpy as np
 import optax
 
 from autodist_tpu.model_item import _normalize_path
+from autodist_tpu.telemetry import spans as tel
 from autodist_tpu.utils import logging
 
 
@@ -418,6 +419,14 @@ class PSStore:
         In serving (async) mode, values of groups owned by OTHER processes
         are fetched from the service — the latest published version, no
         barrier (the reference's async read-from-PS)."""
+        with tel.span("ps.pull", "ps",
+                      serving=self._serve_groups is not None):
+            out = self._pull_impl()
+        tel.counter_add("ps.pulls")
+        return out
+
+    def _pull_impl(self) -> Dict[str, np.ndarray]:
+        bytes0 = self.stats["bytes_pulled"]
         if self._serve_groups is None:
             out = self._local_full()
             for name in out:
@@ -471,6 +480,8 @@ class PSStore:
                     shard_vals.setdefault(name, {})[int(si)] = arr
             out = self._assemble(shard_vals)
         self.stats["pulls"] += 1
+        tel.counter_add("ps.bytes_pulled",
+                        self.stats["bytes_pulled"] - bytes0)
         return out
 
     def _degraded_bound(self) -> int:
@@ -496,6 +507,9 @@ class PSStore:
             return None
         grp["degraded"] = used + 1
         self.stats["degraded_pulls"] += 1
+        tel.counter_add("ps.degraded_pulls")
+        tel.instant("ps.degraded_pull", "ps", host=host,
+                    used=used + 1, bound=bound)
         # no service.reconnect() here: the resilient client reconnects
         # internally, and dropping it would discard its circuit-breaker
         # state — every degraded pull would re-pay the full retry budget
@@ -530,6 +544,14 @@ class PSStore:
         Serving (async) mode packs each owner group's gradients into a blob
         and enqueues it on the owner's queue; the owner's apply thread
         applies gradients one at a time (no barrier)."""
+        with tel.span("ps.push", "ps",
+                      serving=self._serve_groups is not None):
+            self._push_impl(grads)
+        tel.counter_add("ps.pushes")
+
+    def _push_impl(self, grads: Dict[str, Any]) -> None:
+        bytes0 = self.stats["bytes_pushed"]
+        drops0 = self.stats.get("dropped_pushes", 0)
         if self._serve_groups is None:
             if self.any_async() and not self._warned_sync_fallback:
                 self._warned_sync_fallback = True
@@ -637,6 +659,11 @@ class PSStore:
                 self.stats["bytes_pushed"] += len(blob)
             self._my_pushes += 1
         self.stats["pushes"] += 1
+        tel.counter_add("ps.bytes_pushed",
+                        self.stats["bytes_pushed"] - bytes0)
+        dropped = self.stats.get("dropped_pushes", 0) - drops0
+        if dropped:
+            tel.counter_add("ps.dropped_pushes", dropped)
 
     def apply_local(self, grads: Dict[str, Any], shard_filter=None) -> None:
         """The PS-side update op: apply gradients to the resident shards
@@ -697,7 +724,10 @@ class PSStore:
                     add(name, si, np.asarray(gs))
             if not order:
                 return
-            new_vals, new_opts = self._apply_sharded(shards, opts, gshards)
+            with tel.span("ps.apply", "ps", shards=len(order)):
+                new_vals, new_opts = self._apply_sharded(shards, opts,
+                                                         gshards)
+            tel.counter_add("ps.applies", len(order))
             per_var: Dict[str, Dict[int, Tuple]] = {}
             for name, si, key in order:
                 per_var.setdefault(name, {})[si] = (
@@ -1027,7 +1057,9 @@ class PSStore:
         leaves along the plan axis; shared leaves copied whole — the same
         slicing rule as :meth:`load_opt_from_full`). One writeback replaces
         k per-microstep pushes; the wire accounting reflects that."""
-        with jax.default_device(self._cpu):
+        bytes0 = self.stats["bytes_pushed"]
+        with tel.span("ps.absorb", "ps", vars=len(values)), \
+                jax.default_device(self._cpu):
             for name, full in values.items():
                 plan = self.plans[name]
                 info = self._var_infos[name]
@@ -1051,6 +1083,9 @@ class PSStore:
                 self.stats["applies"] += 1
         if values:
             self.stats["pushes"] += 1
+            tel.counter_add("ps.pushes")
+            tel.counter_add("ps.bytes_pushed",
+                            self.stats["bytes_pushed"] - bytes0)
 
     def full_opt_leaf(self, slot_path: str, var_name: str):
         """Reconstruct one optimizer-state subtree in the var's full layout

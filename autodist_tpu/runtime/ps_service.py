@@ -33,6 +33,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from autodist_tpu.telemetry import spans as tel
 from autodist_tpu.utils import logging
 
 _MAGIC = b"ADPS"
@@ -280,9 +281,12 @@ class AsyncPSWorker:
         return self
 
     def _publish(self, version: int):
-        self._service.publish(version, pack_arrays(self._values_fn()))
-        if self._opt_fn is not None:
-            self._service.publish_opt(version, pack_arrays(self._opt_fn()))
+        with tel.span("ps_service.publish", "ps_service", version=version):
+            self._service.publish(version, pack_arrays(self._values_fn()))
+            if self._opt_fn is not None:
+                self._service.publish_opt(version,
+                                          pack_arrays(self._opt_fn()))
+        tel.counter_add("ps_service.published")
 
     def _loop(self):
         while not self._stop.is_set():
@@ -311,8 +315,10 @@ class AsyncPSWorker:
                 time.sleep(self._poll_s)
                 continue
             try:
-                self._apply_fn(unpack_arrays(blob))
+                with tel.span("ps_service.apply", "ps_service"):
+                    self._apply_fn(unpack_arrays(blob))
                 self._applied += 1
+                tel.counter_add("ps_service.applied")
                 self._publish(self._applied)
             except OSError as e:
                 # the gradient IS applied locally; only the republish hit

@@ -46,6 +46,7 @@ import uuid
 from typing import Callable, List, Optional
 
 from autodist_tpu import const
+from autodist_tpu.telemetry import spans as tel
 from autodist_tpu.utils import logging
 
 
@@ -133,6 +134,10 @@ class ResilientCoordinationClient:
             self._breaker_open_until = (time.monotonic()
                                         + self._breaker_cooldown_s)
             self.stats["breaker_opens"] += 1
+            tel.counter_add("coord.breaker_opens")
+            tel.instant("coord.breaker_open", "coord",
+                        target="%s:%d" % (self._host, self._port),
+                        failures=self._consecutive_failures)
             logging.warning(
                 "coordination circuit OPEN for %.1fs after %d consecutive "
                 "transport failures to %s:%d",
@@ -150,7 +155,10 @@ class ResilientCoordinationClient:
         delay = min(self._backoff_max_s,
                     self._backoff_base_s * (2 ** attempt))
         # full jitter: [delay/2, delay] — seeded, so fault tests replay
-        time.sleep(delay * (0.5 + 0.5 * self._rng.random()))
+        slept = delay * (0.5 + 0.5 * self._rng.random())
+        with tel.span("coord.backoff", "coord", attempt=attempt):
+            time.sleep(slept)
+        tel.counter_add("coord.backoff_s", slept)
 
     def _call(self, fn: Callable, op: str, block: bool = False,
               retry_ambiguous: bool = True):
@@ -165,11 +173,14 @@ class ResilientCoordinationClient:
             self._check_breaker()
             if attempt:
                 self.stats["retries"] += 1
+                tel.counter_add("coord.retries")
+                tel.instant("coord.retry", "coord", op=op, attempt=attempt)
                 self._backoff(attempt - 1)
             try:
                 if self._client is None:
                     self._client = self._connect()
                     self.stats["reconnects"] += 1
+                    tel.counter_add("coord.reconnects")
             except OSError as e:
                 last_err = e
                 self._note_failure()

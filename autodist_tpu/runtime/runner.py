@@ -19,6 +19,7 @@ import numpy as np
 
 from autodist_tpu import const
 from autodist_tpu.remapper import Remapper
+from autodist_tpu.telemetry import spans as tel
 from autodist_tpu.train_state import TrainState
 from autodist_tpu.utils import logging
 
@@ -48,8 +49,14 @@ class MetricsHandle:
         """Host metrics (forces the device→host copy on first call).
         Superstep handles return stacked ``[k, ...]`` leaves."""
         if self._host is None:
-            self._host = self._remapper.remap_fetch(self._device)
+            with tel.span("runner.readback", "runner",
+                          microsteps=self.microsteps):
+                self._host = self._remapper.remap_fetch(self._device)
             self._device = None  # free the device buffers
+            tel.counter_add("runner.readbacks")
+            tel.counter_add("runner.d2h_bytes", sum(
+                getattr(np.asarray(leaf), "nbytes", 0)
+                for leaf in jax.tree_util.tree_leaves(self._host)))
         return self._host
 
     def unstack(self) -> list:
@@ -250,6 +257,8 @@ class Runner:
         protocol by its true k optimizer applies."""
         self._step_count += microsteps
         self._superstep_count += 1
+        tel.counter_add("runner.steps", microsteps)
+        tel.counter_add("runner.supersteps")
         self._maybe_heartbeat()
         if self._coord is not None:
             # bounded staleness across processes (the reference's size-s
@@ -285,26 +294,29 @@ class Runner:
         st = state if state is not None else self.state
         if st is None:
             raise RuntimeError("Runner.run before init()")
-        sharded_batch = self._remapper.remap_feed(batch)
-        self._start_trace_if_due()
-        self._check_ps_owner_health()
-        # donate only the Runner-owned state; an explicitly-passed state is a
-        # caller reference that must stay valid
-        new_state, metrics = self._dstep(st, sharded_batch, donate=state is None)
-        if state is None:
-            self.state = new_state
-        self._after_dispatch(1)
-        self._stop_trace_if_due(metrics)
-        handle = MetricsHandle(metrics, self._remapper, microsteps=1)
-        if sync:
-            # result() pulls the metrics to host, so the step's device work
-            # is complete: this wall time is an honest per-step duration
-            host_metrics = handle.result()
+        with tel.span("runner.dispatch", "runner", microsteps=1, sync=sync):
+            sharded_batch = self._remapper.remap_feed(batch)
+            self._start_trace_if_due()
+            self._check_ps_owner_health()
+            # donate only the Runner-owned state; an explicitly-passed state
+            # is a caller reference that must stay valid
+            new_state, metrics = self._dstep(st, sharded_batch,
+                                             donate=state is None)
+            if state is None:
+                self.state = new_state
+            self._after_dispatch(1)
+            self._stop_trace_if_due(metrics)
+            handle = MetricsHandle(metrics, self._remapper, microsteps=1)
+            if sync:
+                # result() pulls the metrics to host, so the step's device
+                # work is complete: this wall time is an honest per-step
+                # duration
+                host_metrics = handle.result()
+                self._record_step_time(t_begin)
+                return ((new_state, host_metrics) if state is not None
+                        else host_metrics)
             self._record_step_time(t_begin)
-            return ((new_state, host_metrics) if state is not None
-                    else host_metrics)
-        self._record_step_time(t_begin)
-        return (new_state, handle) if state is not None else handle
+            return (new_state, handle) if state is not None else handle
 
     def run_superstep(self, stacked_batch, sync: bool = False):
         """One FUSED superstep: k microsteps (k = the stacked feed's
@@ -321,17 +333,18 @@ class Runner:
         placed = self._remapper.remap_feed_stack(stacked_batch)
         leaves = jax.tree_util.tree_leaves(placed)
         k = int(np.shape(leaves[0])[0]) if leaves else 1
-        self._start_trace_if_due()
-        self._check_ps_owner_health()
-        new_state, metrics = self._dstep.run_multi(self.state, placed)
-        self.state = new_state
-        self._after_dispatch(k)
-        self._stop_trace_if_due(metrics)
-        handle = MetricsHandle(metrics, self._remapper, microsteps=k)
-        if sync:
-            handle.result()
-        self._record_step_time(t_begin)
-        return handle.result() if sync else handle
+        with tel.span("runner.dispatch", "runner", microsteps=k, sync=sync):
+            self._start_trace_if_due()
+            self._check_ps_owner_health()
+            new_state, metrics = self._dstep.run_multi(self.state, placed)
+            self.state = new_state
+            self._after_dispatch(k)
+            self._stop_trace_if_due(metrics)
+            handle = MetricsHandle(metrics, self._remapper, microsteps=k)
+            if sync:
+                handle.result()
+            self._record_step_time(t_begin)
+            return handle.result() if sync else handle
 
     def lowered_text(self, batch, state: Optional[TrainState] = None,
                      fuse_steps: int = 1, program: str = "train",
@@ -481,13 +494,22 @@ class Runner:
         ``microsteps`` for backward compatibility (identical without
         fusion). Reading the stats never forces a device sync — under
         ``sync=False`` stepping the samples measure dispatch-to-dispatch
-        time, re-synced at every metrics readback boundary."""
+        time, re-synced at every metrics readback boundary.
+
+        The shape is STABLE (a monitoring consumer can rely on every key
+        existing): ``steady_*``/``goodput`` are None before any steady
+        sample, and ``telemetry`` merges the process-wide registry
+        counters (``telemetry/spans.py``) that attribute the wall time —
+        jitted dispatches, metric readbacks and their D2H bytes, host-PS
+        wire bytes, control-plane retries, prefetcher drops."""
         import statistics
         micro, sup = self._step_count, self._superstep_count
         out = {"steps": micro, "supersteps": sup, "microsteps": micro,
                "total_s": round(self._total_step_s, 6),
                "first_step_s": (round(self._first_step_s, 6)
-                                if self._first_step_s is not None else None)}
+                                if self._first_step_s is not None else None),
+               "steady_median_s": None, "steady_p10_s": None,
+               "steady_p90_s": None, "goodput": None}
         recent = self._recent_step_s
         if recent:
             # method="inclusive": the default exclusive method extrapolates
@@ -505,6 +527,17 @@ class Runner:
                 goodput=round(min(1.0, statistics.median(recent) * sup
                               / self._total_step_s), 4)
                 if self._total_step_s > 0 else None)
+        c = tel.counters()
+        out["telemetry"] = {
+            "dispatches": c.get("dstep.dispatches", 0.0),
+            "readbacks": c.get("runner.readbacks", 0.0),
+            "d2h_bytes": c.get("runner.d2h_bytes", 0.0),
+            "ps_bytes_pulled": c.get("ps.bytes_pulled", 0.0),
+            "ps_bytes_pushed": c.get("ps.bytes_pushed", 0.0),
+            "coord_retries": c.get("coord.retries", 0.0),
+            "prefetch_dropped_batches": c.get("prefetch.dropped_batches",
+                                              0.0),
+        }
         return out
 
     def _check_ps_owner_health(self):
@@ -698,6 +731,19 @@ class Runner:
         PRE-stacked sources cannot be split — ``DevicePrefetcher(stack=k)``
         drops a short tail (with a warning) and a ``steps`` bound that is
         not a multiple of k stops at the last whole superstep."""
+        # one long span bracketing the whole fit window: the per-dispatch
+        # spans nest inside it, so a trace shows the training phase as a
+        # single labeled interval with its knobs as args
+        with tel.span("runner.fit", "runner", fuse_steps=fuse_steps,
+                      metrics_every=metrics_every, save_every=save_every):
+            return self._fit(batches, steps, callbacks, save_every, saver,
+                             fuse_steps, metrics_every)
+
+    def _fit(self, batches, steps, callbacks, save_every, saver,
+             fuse_steps, metrics_every) -> list:
+        # the body of fit() — the public contract lives on fit's
+        # docstring; split out only so the whole window runs inside one
+        # "runner.fit" span
         src_k = getattr(batches, "stack_k", 1)
         if src_k != 1 and src_k != max(1, fuse_steps):
             # a stacked source feeding the wrong k would not fail loudly:

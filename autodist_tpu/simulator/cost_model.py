@@ -536,7 +536,12 @@ class CostModel:
 
     # ------------------------------------------------------------------ main
 
-    def estimate(self, strategy: Strategy) -> CostBreakdown:
+    def estimate(self, strategy: Strategy,
+                 use_static_profile: bool = True) -> CostBreakdown:
+        """Price one candidate. ``use_static_profile=False`` forces the
+        pure jaxpr-heuristic pricing even when a measured profile is
+        attached — the baseline the drift reports compare against
+        (``telemetry/drift.py``) without touching shared state."""
         n = max(len(strategy.graph_config.replicas), 1)
         # int8 rings run per-axis on multi-axis meshes (sequential rings),
         # so compression no longer degrades off single-axis meshes
@@ -597,7 +602,8 @@ class CostModel:
         # ring all-reduce: 2*(N-1)/N of the payload crosses each link
         allreduce_s = (2.0 * (n - 1) / n) * ar_bytes / ici_bw if n > 1 else 0.0
         mp_s = self.mp_comm_time(strategy, ici_bw)
-        profile = self._static_profile_for(strategy)
+        profile = (self._static_profile_for(strategy)
+                   if use_static_profile else None)
         if profile is not None:
             # a lowering exists: price collectives from the MEASURED wire
             # bytes (fwd+bwd ops are both in the program text, each ring-

@@ -1,0 +1,33 @@
+"""Runtime telemetry: span tracing, metrics registry, drift tracking.
+
+The runtime observability layer (docs/observability.md):
+
+- :mod:`~autodist_tpu.telemetry.spans` — the thread-safe ring-buffer
+  :class:`TraceRecorder` and the ``span()``/``counter_add()`` helpers the
+  framework's hot paths are instrumented with (near-zero cost when
+  ``ADT_TRACE=0``);
+- :mod:`~autodist_tpu.telemetry.export` — Chrome-trace/Perfetto JSON,
+  Prometheus ``metrics_text()``, and cross-process publish/scrape over
+  the coordination service;
+- :mod:`~autodist_tpu.telemetry.drift` — measured-vs-predicted drift
+  reports feeding ``simulator/calibration.py``;
+- ``python -m autodist_tpu.telemetry`` — inspect/merge/diff/validate
+  trace files, print drift tables.
+"""
+from autodist_tpu.telemetry.spans import (  # noqa: F401
+    TraceRecorder, configure, counter_add, counters, current_span_id,
+    gauge_set, get_recorder, instant, reset, span, tracing_enabled)
+from autodist_tpu.telemetry.export import (  # noqa: F401
+    chrome_trace, merge_traces, metrics_text, publish_telemetry,
+    scrape_cluster, validate_chrome_trace, write_trace)
+from autodist_tpu.telemetry.drift import (  # noqa: F401
+    DriftReport, build_report, fit_calibration, report_for_runner)
+
+__all__ = [
+    "TraceRecorder", "configure", "counter_add", "counters",
+    "current_span_id", "gauge_set", "get_recorder", "instant", "reset",
+    "span", "tracing_enabled",
+    "chrome_trace", "merge_traces", "metrics_text", "publish_telemetry",
+    "scrape_cluster", "validate_chrome_trace", "write_trace",
+    "DriftReport", "build_report", "fit_calibration", "report_for_runner",
+]

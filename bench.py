@@ -304,7 +304,13 @@ def smoke_main(fused: bool = False):
     metrics_every=2)``), asserts parity with the per-step loop AND the
     k× dispatch reduction, and reports the paired fused-vs-per-step
     throughput ratio — so the scan-fused lowering path compiles (and
-    stays numerically honest) on every PR."""
+    stays numerically honest) on every PR.
+
+    Under ``ADT_TRACE=1`` the run also exports a Perfetto-loadable trace
+    (``ADT_TRACE_FILE`` or ``<trace dir>/smoke-trace.json``), validates
+    it against the chrome-trace schema, and embeds a per-subsystem
+    timing breakdown + the registry counters in the BENCH json — future
+    rounds get phase-level attribution of where the smoke seconds went."""
     import jax
     jax.config.update("jax_platforms",
                       os.environ.get("ADT_BENCH_PLATFORM") or "cpu")
@@ -352,6 +358,11 @@ def smoke_main(fused: bool = False):
         np.testing.assert_allclose([m["loss"] for m in h1],
                                    [m["loss"] for m in h2],
                                    rtol=1e-5, atol=1e-6)
+        # snapshot stats BEFORE the paired loops: the registry (process-
+        # global) still holds exactly r2's fused fit here, so the
+        # telemetry section agrees with the per-runner step counts beside
+        # it — after loop_plain it would also count r1's per-step work
+        fused_stats = r2.step_stats()
         # steady-state paired ratio (post-compile): per-step vs fused
         def loop_plain():
             r1.fit(list(batches))
@@ -361,9 +372,42 @@ def smoke_main(fused: bool = False):
         t0 = time.perf_counter(); loop_fused(); tf = time.perf_counter() - t0
         result.update(fuse_steps=k, dispatches=[d1, d2],
                       fused_vs_per_step=round(tp / max(tf, 1e-9), 4),
-                      stats=r2.step_stats())
+                      stats=fused_stats)
+    result.update(_smoke_telemetry())
     adt.reset()
     print(RESULT_TAG + json.dumps(result), flush=True)
+
+
+def _smoke_telemetry():
+    """Trace export + phase breakdown for the smoke result (ADT_TRACE=1).
+    Per-subsystem total seconds come from the recorded span categories,
+    so a BENCH reader sees WHERE the smoke wall time went (dispatch vs
+    PS vs readback vs checkpoint) instead of one opaque loop time."""
+    from autodist_tpu import const
+    from autodist_tpu.telemetry import export, spans
+    if not spans.tracing_enabled():
+        return {}
+    rec = spans.get_recorder()
+    by_cat = {}
+    for row in rec.summary().values():
+        agg = by_cat.setdefault(row["cat"], {"count": 0, "total_s": 0.0})
+        agg["count"] += row["count"]
+        agg["total_s"] = round(agg["total_s"] + row["total_s"], 6)
+    path = (const.ENV.ADT_TRACE_FILE.val
+            or os.path.join(const.DEFAULT_TRACE_DIR, "smoke-trace.json"))
+    out = {"phase_breakdown": by_cat,
+           "telemetry_counters": {k: v for k, v in rec.counters().items()
+                                  if v}}
+    try:
+        export.write_trace(path)
+        errors = export.validate_chrome_trace(export.load_trace(path))
+        if errors:
+            raise ValueError("; ".join(errors))
+        out["trace_file"] = path
+        out["trace_events"] = len(rec.events())
+    except Exception as e:  # noqa: BLE001 — telemetry must not fail smoke
+        out["trace_error"] = "%s: %s" % (type(e).__name__, str(e)[:160])
+    return out
 
 
 def probe_main():

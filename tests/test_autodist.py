@@ -79,9 +79,13 @@ def test_step_stats_goodput():
     ad = adt.AutoDist(strategy_builder=strategy.AllReduce())
     runner = ad.build(loss, optax.sgd(0.1), params, batch)
     runner.init(params)
-    assert runner.step_stats() == {"steps": 0, "supersteps": 0,
-                                   "microsteps": 0, "total_s": 0.0,
-                                   "first_step_s": None}
+    stats0 = runner.step_stats()
+    assert (stats0["steps"], stats0["supersteps"], stats0["microsteps"],
+            stats0["total_s"], stats0["first_step_s"]) == (0, 0, 0, 0.0, None)
+    # the shape is stable: steady percentiles exist (as None) pre-sample,
+    # and the telemetry merge carries the registry counters
+    assert stats0["steady_median_s"] is None
+    assert stats0["telemetry"]["dispatches"] == 0.0
     for _ in range(12):
         runner.run(batch)
     stats = runner.step_stats()

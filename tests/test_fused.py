@@ -148,9 +148,13 @@ def test_step_stats_superstep_microstep_accounting():
     applies — the unit examples/s math multiplies by batch size)."""
     params, loss_fn, batches = _make_problem(n_batches=10)
     runner = _build(lambda: S.AllReduce(), params, loss_fn, batches[0])
-    assert runner.step_stats() == {
-        "steps": 0, "supersteps": 0, "microsteps": 0,
-        "total_s": 0.0, "first_step_s": None}
+    stats0 = runner.step_stats()
+    assert (stats0["steps"], stats0["supersteps"], stats0["microsteps"],
+            stats0["total_s"], stats0["first_step_s"]) == (0, 0, 0, 0.0, None)
+    # stable JSON shape: every key exists from step zero (None pre-sample)
+    assert stats0["steady_median_s"] is None and stats0["goodput"] is None
+    assert set(stats0["telemetry"]) >= {"dispatches", "d2h_bytes",
+                                        "coord_retries"}
     # 10 batches at k=4: two fused supersteps + a trailing per-step pair
     hist = runner.fit(iter(batches), fuse_steps=4)
     assert len(hist) == 10
