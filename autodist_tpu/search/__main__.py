@@ -1,0 +1,6 @@
+"""Entry point for ``python -m autodist_tpu.search``."""
+import sys
+
+from autodist_tpu.search.cli import main
+
+sys.exit(main())

@@ -1,0 +1,442 @@
+"""Typed per-variable candidate space for the auto-strategy search.
+
+The original AutoDist's AutoSync searched *per-variable* synchronizer
+choices (reference ``docs/design/rationale.rst``); our zoo-ranking
+``AutoStrategy`` only ever picked a whole-graph template. This module is
+the missing dimension: a :class:`PlanSpec` assigns every trainable
+variable its own :class:`VarChoice` (PS vs AllReduce, partition axis +
+shard count, compressor) plus plan-level knobs (gradient bucketing
+granularity, PS staleness window, remat policy), and a :class:`PlanSpace`
+that
+
+- enumerates **seed** plans mirroring the zoo families (plus best-effort
+  conversions of actual zoo strategies via :meth:`PlanSpace.from_strategy`),
+- applies **mutation operators** (deterministic under a caller-owned
+  ``random.Random``) that by construction keep plans inside what the
+  lowering supports — shard counts are divisors of the split dim, sparse
+  variables never take the dense reduce-scatter path (ADT309), compressors
+  only ride unpartitioned dense float AllReduce wires (ADT306/308) — so
+  ``analysis.verify`` stays a cheap *gate*, not the search's inner loop,
+- **materializes** a PlanSpec into a :class:`~autodist_tpu.strategy.base.
+  Strategy` using the exact node shapes the zoo builders emit (greedy
+  least-loaded PS destination assignment, round-robined shard
+  destinations), so a searched plan lowers through the same kernels.
+
+Everything here is pure and trace-free: scoring happens in
+``search/scoring.py`` through the calibrated cost model.
+"""
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from autodist_tpu.strategy.base import (AllReduceSynchronizer, GraphConfig,
+                                        PSSynchronizer, Strategy,
+                                        VarConfig)
+from autodist_tpu.strategy.partitioned_ps_strategy import (
+    make_partition_str, smallest_divisor_shards)
+from autodist_tpu.strategy.ps_lb_strategy import byte_size_load_fn, greedy_assign
+from autodist_tpu.strategy.ps_strategy import reduction_devices, replica_devices
+
+# gradient-bucketing granularities the search may pick (vars per group,
+# AllReduce family; one huge bucket minimizes per-collective launches,
+# small buckets overlap earlier — the cost model prices the launch count)
+CHUNK_SIZES = (8, 32, 128, 512)
+# plan-level staleness windows for host-PS variables (sync training)
+STALENESS_CHOICES = (0, 2)
+# plan-level remat policies (None = store all activations)
+REMAT_CHOICES = (None, "dots")
+# compressors the search offers on dense float AllReduce wires; PowerSGD
+# additionally requires rank >= 2 (ADT308)
+_DENSE_COMPRESSORS = ("NoneCompressor", "HorovodCompressor",
+                      "Int8CompressorEF")
+_MATRIX_COMPRESSORS = _DENSE_COMPRESSORS + ("PowerSGDCompressor:2",)
+
+
+@dataclasses.dataclass(frozen=True)
+class VarChoice:
+    """One variable's synchronization decision.
+
+    ``shards``/``axis`` describe ZeRO-style storage partitioning (the
+    ``partitioner`` string of the strategy IR); ``shards == 1`` means
+    unpartitioned. ``compressor`` only applies to unpartitioned dense
+    AllReduce wires; ``ps_proxy`` only to PS."""
+    sync: str = "AllReduce"               # "AllReduce" | "PS"
+    compressor: str = "NoneCompressor"
+    shards: int = 1
+    axis: int = 0
+    ps_proxy: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanSpec:
+    """A full per-variable plan: hashable, order-stable, mutation-friendly.
+
+    ``choices`` pairs every trainable variable (in ``ModelItem`` order)
+    with its :class:`VarChoice`; the remaining fields are plan-level
+    knobs. Frozen so drivers can dedup visited candidates by the spec
+    itself."""
+    choices: Tuple[Tuple[str, VarChoice], ...]
+    chunk_size: int = 128
+    staleness: int = 0
+    remat: Optional[str] = None
+
+    def choice_map(self) -> Dict[str, VarChoice]:
+        return dict(self.choices)
+
+    def replace_choice(self, name: str, choice: VarChoice) -> "PlanSpec":
+        return dataclasses.replace(self, choices=tuple(
+            (n, choice if n == name else c) for n, c in self.choices))
+
+    def describe(self) -> str:
+        """Compact human label: sync-family counts + plan knobs."""
+        ar = sum(1 for _, c in self.choices if c.sync == "AllReduce")
+        ps = len(self.choices) - ar
+        comp = sum(1 for _, c in self.choices
+                   if c.compressor != "NoneCompressor")
+        sharded = sum(1 for _, c in self.choices if c.shards > 1)
+        bits = ["ar=%d" % ar, "ps=%d" % ps]
+        if comp:
+            bits.append("comp=%d" % comp)
+        if sharded:
+            bits.append("sharded=%d" % sharded)
+        bits.append("chunk=%d" % self.chunk_size)
+        if self.staleness:
+            bits.append("stale=%d" % self.staleness)
+        if self.remat:
+            bits.append("remat=%s" % self.remat)
+        return "plan[%s]" % ",".join(bits)
+
+
+def _partition_options(shape, cap: int) -> List[Tuple[int, int]]:
+    """(axis, shards) pairs that split one axis into an exact divisor
+    count — the only partitionings the lowering stores unpadded and the
+    linter leaves un-flagged. At most 4 counts per axis (smallest,
+    largest, powers of two between) keeps the branching factor bounded."""
+    out: List[Tuple[int, int]] = []
+    for axis, dim in enumerate(shape or ()):
+        divisors = [k for k in range(2, min(int(dim), cap) + 1)
+                    if dim % k == 0]
+        if not divisors:
+            continue
+        keep = {divisors[0], divisors[-1]}
+        keep.update(k for k in divisors if k & (k - 1) == 0)
+        out.extend((axis, k) for k in sorted(keep)[:4])
+    return out
+
+
+class PlanSpace:
+    """The candidate space for one (ModelItem, ResourceSpec) pair."""
+
+    def __init__(self, model_item, resource_spec):
+        self._item = model_item
+        self._spec = resource_spec
+        self.var_names: List[str] = list(model_item.trainable_var_names)
+        self.infos = {n: model_item.var_infos[n] for n in self.var_names}
+        self.destinations = reduction_devices(resource_spec)
+        self.replicas = replica_devices(resource_spec)
+        self.n_replicas = max(len(self.replicas), 1)
+        cap = max(self.n_replicas, len(self.destinations), 2)
+        self.partition_options: Dict[str, List[Tuple[int, int]]] = {
+            n: _partition_options(self.infos[n].shape, cap)
+            for n in self.var_names}
+        self.compressor_options: Dict[str, Tuple[str, ...]] = {}
+        for n in self.var_names:
+            info = self.infos[n]
+            dtype = str(getattr(info, "dtype", "float32"))
+            if info.sparse or not dtype.startswith(("float", "bfloat")):
+                # ADT306: compression is dead weight on sparse or
+                # non-float wires — not part of this variable's space
+                self.compressor_options[n] = ("NoneCompressor",)
+            elif len(info.shape) >= 2:
+                self.compressor_options[n] = _MATRIX_COMPRESSORS
+            else:
+                self.compressor_options[n] = _DENSE_COMPRESSORS
+
+    # ------------------------------------------------------------- validity
+
+    def canon(self, choice: VarChoice, name: str) -> VarChoice:
+        """Clamp a choice to this variable's valid sub-space (the single
+        place mutation results are normalized, so operators stay simple)."""
+        info = self.infos[name]
+        sync = choice.sync if choice.sync in ("PS", "AllReduce") else "AllReduce"
+        shards, axis = choice.shards, choice.axis
+        if shards > 1 and (axis, shards) not in self.partition_options[name]:
+            shards, axis = 1, 0
+        if sync == "AllReduce" and info.sparse and shards > 1:
+            # ADT309: a partitioned reduce-scatter densifies the
+            # row-sparse gradient to the full table every step
+            shards, axis = 1, 0
+        compressor = choice.compressor
+        if (sync != "AllReduce" or shards > 1
+                or compressor not in self.compressor_options[name]):
+            compressor = "NoneCompressor"
+        proxy = bool(choice.ps_proxy) if sync == "PS" else False
+        return VarChoice(sync=sync, compressor=compressor, shards=shards,
+                         axis=axis, ps_proxy=proxy)
+
+    def make_plan(self, choices: Dict[str, VarChoice], chunk_size: int = 128,
+                  staleness: int = 0, remat: Optional[str] = None) -> PlanSpec:
+        return PlanSpec(
+            choices=tuple((n, self.canon(choices.get(n, VarChoice()), n))
+                          for n in self.var_names),
+            chunk_size=chunk_size, staleness=staleness, remat=remat)
+
+    # ---------------------------------------------------------------- seeds
+
+    def seeds(self) -> List[Tuple[str, PlanSpec]]:
+        """Per-variable re-expressions of the zoo families — the search
+        starts where the hand-written builders already are and only moves
+        when the cost model says a deviation pays."""
+        def compressed(comp, base=None):
+            """All-AllReduce (or ``base``) with ``comp`` on every variable
+            whose sub-space allows it (canon strips the rest) — the
+            analog of the zoo's whole-graph compressor variants."""
+            base = base or {}
+            return {n: base.get(n) or VarChoice(compressor=comp)
+                    for n in self.var_names}
+
+        ar = {n: VarChoice() for n in self.var_names}
+        host_ps = {n: VarChoice(sync="PS") for n in self.var_names}
+        proxy_ps = {n: VarChoice(sync="PS", ps_proxy=True)
+                    for n in self.var_names}
+        sparse_ps = {n: VarChoice(sync="PS") for n in self.var_names
+                     if self.infos[n].sparse}
+        parallax = {n: sparse_ps.get(n) or VarChoice()
+                    for n in self.var_names}
+        cap = max(len(self.destinations), 2)
+        part_ps = {}
+        for n in self.var_names:
+            dim0 = self.infos[n].shape[0] if self.infos[n].shape else 0
+            k = smallest_divisor_shards(dim0, cap) if dim0 > 1 else 1
+            part_ps[n] = (VarChoice(sync="PS", shards=k, axis=0)
+                          if k > 1 else VarChoice(sync="PS"))
+        zero = {}
+        for n in self.var_names:
+            dim0 = self.infos[n].shape[0] if self.infos[n].shape else 0
+            k = (smallest_divisor_shards(dim0, self.n_replicas)
+                 if dim0 > 1 and not self.infos[n].sparse else 1)
+            zero[n] = (VarChoice(shards=k, axis=0) if k > 1 else VarChoice())
+        out = [
+            ("seed:ar", self.make_plan(ar)),
+            ("seed:ar512", self.make_plan(ar, chunk_size=512)),
+            ("seed:ar-bf16", self.make_plan(
+                compressed("HorovodCompressor"))),
+            ("seed:ar-int8", self.make_plan(
+                compressed("Int8CompressorEF"))),
+            ("seed:ar-psgd2", self.make_plan(
+                compressed("PowerSGDCompressor:2"))),
+            ("seed:host-ps", self.make_plan(host_ps)),
+            ("seed:ps-stale2", self.make_plan(host_ps, staleness=2)),
+            ("seed:proxy-ps", self.make_plan(proxy_ps)),
+            ("seed:parallax", self.make_plan(parallax)),
+            ("seed:parallax-bf16", self.make_plan(
+                compressed("HorovodCompressor", base=sparse_ps))),
+            ("seed:parallax-int8", self.make_plan(
+                compressed("Int8CompressorEF", base=sparse_ps))),
+            ("seed:part-ps", self.make_plan(part_ps)),
+            ("seed:zero", self.make_plan(zero)),
+            ("seed:ar-remat", self.make_plan(ar, chunk_size=512,
+                                             remat="dots")),
+        ]
+        return out
+
+    def from_strategy(self, strategy: Strategy) -> Optional[PlanSpec]:
+        """Best-effort conversion of a built (zoo) strategy into a
+        PlanSpec seed; ``None`` when the plan uses dimensions outside
+        this space (model-parallel ``mp_axes``, uneven ``shard_sizes``,
+        async PS, unknown variables)."""
+        gc = strategy.graph_config
+        if gc.mesh_shape or gc.seq_axis or gc.pp_schedule:
+            return None
+        choices: Dict[str, VarChoice] = {}
+        staleness = 0
+        for name in self.var_names:
+            node = strategy.find(name)
+            if node is None or node.mp_axes or node.shard_sizes is not None:
+                return None
+            syncs = ([node.synchronizer] if node.synchronizer else
+                     [p.synchronizer for p in node.part_configs])
+            syncs = [s for s in syncs if s is not None]
+            if not syncs:
+                return None
+            first = syncs[0]
+            shards = node.num_shards if node.partitioner else 1
+            axis = (node.partition_axis or 0) if node.partitioner else 0
+            if isinstance(first, AllReduceSynchronizer):
+                choice = VarChoice(compressor=first.compressor or
+                                   "NoneCompressor",
+                                   shards=shards, axis=axis)
+            elif isinstance(first, PSSynchronizer):
+                if not first.sync:
+                    return None  # async PS is outside the search space
+                staleness = max(staleness, int(first.staleness or 0))
+                choice = VarChoice(sync="PS", shards=shards, axis=axis,
+                                   ps_proxy=bool(first.local_replication))
+            else:
+                return None
+            canon = self.canon(choice, name)
+            if canon.shards != choice.shards:
+                return None  # partitioning this space cannot express
+            choices[name] = canon
+        return self.make_plan(choices, staleness=staleness, remat=gc.remat)
+
+    # ------------------------------------------------------------ mutations
+
+    def mutate(self, plan: PlanSpec, rng) -> Optional[Tuple[PlanSpec, str]]:
+        """One random plan mutation: ``(new_plan, op_description)`` or
+        ``None`` when no operator applies. Deterministic given ``rng``
+        state; the result is canonicalized, so it always materializes to
+        a strategy the verifier accepts."""
+        ops = []
+        names = self.var_names
+        cm = plan.choice_map()
+
+        def pick_var():
+            return names[rng.randrange(len(names))]
+
+        def flip_sync():
+            n = pick_var()
+            c = cm[n]
+            target = "PS" if c.sync == "AllReduce" else "AllReduce"
+            new = self.canon(dataclasses.replace(c, sync=target), n)
+            return plan.replace_choice(n, new), "sync[%s]=%s" % (n, target)
+
+        ops.append(flip_sync)
+
+        comp_vars = [n for n in names
+                     if cm[n].sync == "AllReduce" and cm[n].shards == 1
+                     and len(self.compressor_options[n]) > 1]
+        if comp_vars:
+            def set_compressor():
+                n = comp_vars[rng.randrange(len(comp_vars))]
+                opts = [o for o in self.compressor_options[n]
+                        if o != cm[n].compressor]
+                comp = opts[rng.randrange(len(opts))]
+                new = self.canon(
+                    dataclasses.replace(cm[n], compressor=comp), n)
+                return (plan.replace_choice(n, new),
+                        "compressor[%s]=%s" % (n, comp))
+            ops.append(set_compressor)
+
+        ps_vars = [n for n in names if cm[n].sync == "PS"]
+        if ps_vars:
+            def toggle_proxy():
+                n = ps_vars[rng.randrange(len(ps_vars))]
+                target = not cm[n].ps_proxy
+                new = self.canon(
+                    dataclasses.replace(cm[n], ps_proxy=target), n)
+                return (plan.replace_choice(n, new),
+                        "proxy[%s]=%s" % (n, target))
+            ops.append(toggle_proxy)
+
+        part_vars = [n for n in names if self.partition_options[n]
+                     and not (self.infos[n].sparse
+                              and cm[n].sync == "AllReduce")]
+        if part_vars:
+            def set_shards():
+                n = part_vars[rng.randrange(len(part_vars))]
+                opts = [(0, 1)] + self.partition_options[n]
+                opts = [o for o in opts if o != (cm[n].axis, cm[n].shards)]
+                axis, k = opts[rng.randrange(len(opts))]
+                new = self.canon(
+                    dataclasses.replace(cm[n], shards=k, axis=axis), n)
+                return (plan.replace_choice(n, new),
+                        "shards[%s]=%dx@%d" % (n, k, axis))
+            ops.append(set_shards)
+
+        def set_chunk():
+            opts = [c for c in CHUNK_SIZES if c != plan.chunk_size]
+            c = opts[rng.randrange(len(opts))]
+            return dataclasses.replace(plan, chunk_size=c), "chunk=%d" % c
+
+        ops.append(set_chunk)
+
+        host_ps = [n for n in names
+                   if cm[n].sync == "PS" and not cm[n].ps_proxy]
+        if host_ps:
+            def set_staleness():
+                opts = [s for s in STALENESS_CHOICES if s != plan.staleness]
+                s = opts[rng.randrange(len(opts))]
+                return dataclasses.replace(plan, staleness=s), "stale=%d" % s
+            ops.append(set_staleness)
+
+        def set_remat():
+            opts = [r for r in REMAT_CHOICES if r != plan.remat]
+            r = opts[rng.randrange(len(opts))]
+            return dataclasses.replace(plan, remat=r), "remat=%s" % r
+
+        ops.append(set_remat)
+
+        if not ops:
+            return None
+        op = ops[rng.randrange(len(ops))]
+        new_plan, desc = op()
+        if new_plan == plan:
+            return None
+        return new_plan, desc
+
+    # -------------------------------------------------------- materialize
+
+    def build(self, plan: PlanSpec) -> Strategy:
+        """Materialize a PlanSpec into the strategy IR, emitting the same
+        node shapes the zoo builders do so the searched plan lowers
+        through the exact same kernels."""
+        cm = plan.choice_map()
+        n_ps = len(self.destinations)
+        # greedy least-loaded destination for single-dest host/proxy PS
+        # vars (PSLoadBalancing's assignment, deterministic)
+        ps_infos = [self.infos[n] for n in self.var_names
+                    if cm[n].sync == "PS" and cm[n].shards <= 1]
+        assignment = greedy_assign(ps_infos, self.destinations,
+                                   byte_size_load_fn)
+        nodes: List[VarConfig] = []
+        ar_index = 0   # bucket index over AllReduce-synced vars
+        rr = 0         # round-robin pointer for partitioned-PS shards
+        for name in self.var_names:
+            c = cm[name]
+            info = self.infos[name]
+            rank = len(info.shape)
+            if c.sync == "AllReduce":
+                group = ar_index // max(plan.chunk_size, 1)
+                ar_index += 1
+                if c.shards > 1:
+                    parts = [VarConfig(
+                        var_name="%s/part_%d" % (name, i),
+                        synchronizer=AllReduceSynchronizer(group=group))
+                        for i in range(c.shards)]
+                    nodes.append(VarConfig(
+                        var_name=name,
+                        partitioner=make_partition_str(rank, c.axis,
+                                                       c.shards),
+                        part_configs=parts))
+                else:
+                    nodes.append(VarConfig(
+                        var_name=name,
+                        synchronizer=AllReduceSynchronizer(
+                            compressor=c.compressor, group=group)))
+                continue
+            staleness = 0 if c.ps_proxy else plan.staleness
+            if c.shards > 1:
+                parts = []
+                for i in range(c.shards):
+                    parts.append(VarConfig(
+                        var_name="%s/part_%d" % (name, i),
+                        synchronizer=PSSynchronizer(
+                            reduction_destination=self.destinations[
+                                rr % n_ps],
+                            local_replication=c.ps_proxy,
+                            sync=True, staleness=staleness)))
+                    rr += 1
+                nodes.append(VarConfig(
+                    var_name=name,
+                    partitioner=make_partition_str(rank, c.axis, c.shards),
+                    part_configs=parts))
+            else:
+                nodes.append(VarConfig(
+                    var_name=name,
+                    synchronizer=PSSynchronizer(
+                        reduction_destination=assignment[name],
+                        local_replication=c.ps_proxy,
+                        sync=True, staleness=staleness)))
+        return Strategy(node_config=nodes,
+                        graph_config=GraphConfig(replicas=list(self.replicas),
+                                                 remat=plan.remat))

@@ -513,6 +513,12 @@ class CostModel:
 
     def _wire_bytes(self, info, sync, compressed: bool = True) -> float:
         from autodist_tpu.kernel.synchronization import compressor as compressor_lib
+        if getattr(info, "sparse", False):
+            # sparse (gather-indexed) gradients ship as (ids, values)
+            # pairs and the lowering IGNORES compressors on them (the
+            # linter's ADT306) — pricing them compressed let whole-graph
+            # compressor candidates win on bytes they never save
+            compressed = False
         if not compressed:
             # partitioned/reduce-scatter syncs ignore compressors entirely
             return info.num_elements * WIRE_DTYPE_BYTES

@@ -61,6 +61,12 @@ class Simulator:
         return SimulationResult(strategy, self._cost_model.estimate(strategy),
                                 label)
 
+    def verify(self, strategy: Strategy):
+        """Static diagnostics for one candidate (``analysis/rules.py``) —
+        the same gate :meth:`rank` applies, exposed for the auto-strategy
+        search's per-candidate pruning."""
+        return self._cost_model.verify(strategy)
+
     def attach_static_profile(self, profile, strategy: Strategy = None):
         """Attach measured collective costs from a lowered program (see
         ``CostModel.attach_static_profile``); subsequent simulate/rank

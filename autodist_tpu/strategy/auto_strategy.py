@@ -171,9 +171,27 @@ def mp_candidates(model_item, resource_spec
     return out
 
 
+class Ranking(list):
+    """``Simulator.rank`` results plus build metadata: ``skipped`` lists
+    the candidates whose *builders* failed (label + reason, with the ADT
+    diagnostic when the failure carried one) so CLI/search output can
+    show them, and ``search_trace`` carries the per-variable search's
+    :class:`~autodist_tpu.search.trace.SearchTrace` when the search ran.
+    A plain ``list`` everywhere else — existing callers keep working."""
+
+    def __init__(self, results=(), skipped=None, search_trace=None):
+        super().__init__(results)
+        self.skipped = list(skipped or [])
+        self.search_trace = search_trace
+
+
+SEARCH_LABEL = "auto-search"
+
+
 class AutoStrategy(StrategyBuilder):
     def __init__(self, candidates: Optional[List[Tuple[str, StrategyBuilder]]] = None,
                  extra_candidates: Optional[List[Tuple[str, StrategyBuilder]]] = None,
+                 search=True,
                  **cost_model_kwargs):
         """``candidates`` REPLACES the default pool; ``extra_candidates``
         extends it — the hook for model-parallel entries (TensorParallel,
@@ -181,11 +199,39 @@ class AutoStrategy(StrategyBuilder):
         so they cannot be defaults). The cost model prices their
         forward-collective traffic (``mp_comm_time``) and the HBM gate
         understands their sharded storage, so mp candidates rank against
-        the data-parallel family on one scale."""
+        the data-parallel family on one scale.
+
+        ``search`` adds the per-variable plan synthesis
+        (``autodist_tpu/search/``) on top of the zoo: the zoo candidates
+        seed a beam/annealing search over per-variable PS-vs-AllReduce,
+        partitioning, bucketing and compressor choices, and the searched
+        plan competes in the same ranking — all scored by the shared cost
+        model with verify + ADT501 pruning, never compiling a candidate.
+        ``True`` (the default) uses the default
+        :class:`~autodist_tpu.search.drivers.SearchConfig`; pass a
+        ``SearchConfig`` to tune budget/algo/seed, or ``False`` for the
+        zoo-only ranking."""
         self._candidates = candidates
         self._extra = list(extra_candidates or [])
+        self._search = search
         self._cm_kwargs = cost_model_kwargs
-        self.last_ranking = None  # exposed for inspection/tests
+        self.last_ranking: Optional[Ranking] = None  # for inspection/tests
+
+    def _run_search(self, model_item, resource_spec, sim, built):
+        """Per-variable search seeded by the built zoo candidates; never
+        fails the build — a search error falls back to the zoo ranking."""
+        from autodist_tpu.search.drivers import SearchConfig, run_search
+        config = self._search if isinstance(self._search, SearchConfig) \
+            else None
+        try:
+            return run_search(model_item, resource_spec, config=config,
+                              simulator=sim, extra_seeds=built)
+        except Exception as e:  # noqa: BLE001 — search is an optimizer,
+            # not a dependency: the zoo ranking answers without it
+            logging.warning(
+                "AutoStrategy: per-variable search failed (%s: %s); "
+                "falling back to the zoo ranking", type(e).__name__, e)
+            return None
 
     def build(self, model_item, resource_spec) -> Strategy:
         from autodist_tpu.simulator.simulator import Simulator
@@ -193,15 +239,43 @@ class AutoStrategy(StrategyBuilder):
         if self._candidates is None:
             # models that registered mp_rules enter the tp search space
             candidates = candidates + mp_candidates(model_item, resource_spec)
-        built = []
+        built, skipped = [], []
         for label, builder in candidates:
             try:
                 built.append((label, builder.build(model_item, resource_spec)))
             except Exception as e:  # noqa: BLE001 — skip inapplicable builders
-                logging.debug("AutoStrategy: candidate %s failed (%s)", label, e)
+                diag = getattr(e, "diagnostic", None)
+                reason = (diag.format() if diag is not None
+                          else "%s: %s" % (type(e).__name__, e))
+                logging.warning("AutoStrategy: candidate %s failed: %s",
+                                label, reason)
+                skipped.append({"label": label, "reason": reason})
         sim = Simulator(model_item, resource_spec, **self._cm_kwargs)
-        ranking = sim.rank(built)
-        self.last_ranking = ranking
+        search_result = None
+        if self._search:
+            search_result = self._run_search(model_item, resource_spec,
+                                             sim, built)
+        pool = list(built)
+        if search_result is not None and search_result.ok:
+            # first in the pool: on an exact score tie the per-variable
+            # plan wins (sort is stable), matching "search is the default
+            # builder for unseen models"
+            pool = [(SEARCH_LABEL, search_result.strategy)] + pool
+        if not pool:
+            raise RuntimeError(
+                "AutoStrategy: no candidate strategy could be built "
+                "(%d builder(s) failed: %s)"
+                % (len(skipped),
+                   "; ".join("%(label)s: %(reason)s" % s
+                             for s in skipped[:3]) or "empty pool"))
+        # drop projected-OOM candidates before they can win the ranking —
+        # they would fail the pre-compile memory gate anyway (all-OOM
+        # pools fall back to the unskipped ranking inside rank())
+        ranking = sim.rank(pool, skip_projected_oom=True)
+        self.last_ranking = Ranking(
+            ranking, skipped=skipped,
+            search_trace=(search_result.trace
+                          if search_result is not None else None))
         best = ranking[0]
         logging.info("AutoStrategy picked %s (est %.3f ms/step; next: %s)",
                      best.label, best.step_time_s * 1e3,

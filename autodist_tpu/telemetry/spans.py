@@ -128,6 +128,7 @@ DEFAULT_COUNTERS = (
     "prefetch.batches", "prefetch.dropped_batches",
     "prefetch.dropped_examples",
     "ckpt.saves", "ckpt.barrier_s", "ckpt.gc_removed",
+    "search.candidates", "search.pruned",
 )
 
 

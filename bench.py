@@ -233,6 +233,8 @@ def bench_model(label, pairs=8, iters=4, deadline=None, batch_size=None):
     fused_extra = _maybe_fused_phases(runner, state_box, sharded, run_fw,
                                       iters)
     adt.reset()
+    search_extra = _search_phases(loss_fn, opt, params, batch_np, iters,
+                                  fw_rates, deadline)
     best_rate = max(fw_rates)  # steady-state (least-throttled) phase
     # flops is the GLOBAL per-step count; aggregate peak scales with the
     # device count the framework step runs over
@@ -252,6 +254,7 @@ def bench_model(label, pairs=8, iters=4, deadline=None, batch_size=None):
         "pairs": len(ratios),
     }
     out.update(fused_extra)
+    out.update(search_extra)
     return out
 
 
@@ -295,6 +298,104 @@ def _maybe_fused_phases(runner, state_box, sharded, run_fw, iters):
         print("  fused phases failed: %s" % e, file=sys.stderr, flush=True)
         return {"fuse_steps": fuse_k,
                 "fused_error": "%s: %s" % (type(e).__name__, str(e)[:160])}
+
+
+def _search_phases(loss_fn, opt, params, batch_np, iters, fw_rates,
+                   deadline):
+    """Searched-vs-zoo leg of each model bench: run the per-variable plan
+    search (autodist_tpu/search/) on the bench model, scored through the
+    calibrated cost model — static only, NO candidate is compiled, so this
+    is seconds even for the flagship models — and record the searched and
+    best-zoo ESTIMATED step times side by side. With ADT_BENCH_SEARCH=1
+    the chosen plan is additionally compiled through the full stack and
+    timed, recording the MEASURED searched step rate beside the main
+    path's rates (sequential phases, not paired: the process holds one
+    AutoDist at a time). Best-effort — a failure here is recorded, never
+    fatal to the model's main result."""
+    if deadline is not None and time.perf_counter() > deadline:
+        return {"search": {"skipped": "model budget exhausted"}}
+    try:
+        from autodist_tpu.model_item import ModelItem
+        from autodist_tpu.resource_spec import ResourceSpec
+        from autodist_tpu.search.drivers import SearchConfig, run_search
+        from autodist_tpu.search.scoring import zoo_best
+        from autodist_tpu.simulator.simulator import Simulator
+
+        item = ModelItem(loss_fn=loss_fn, optimizer=opt, params=params,
+                         example_batch=batch_np).prepare()
+        spec = ResourceSpec.from_local()
+        sim = Simulator(item, spec)
+        budget = int(os.environ.get("ADT_BENCH_SEARCH_BUDGET", "64"))
+        res = run_search(item, spec, config=SearchConfig(budget=budget),
+                         simulator=sim)
+        if not res.ok:
+            return {"search": {"error": "all %d candidates pruned (%s)"
+                               % (res.candidates,
+                                  res.trace.prune_reasons())}}
+        zoo_label, zoo_score, zoo = zoo_best(item, spec, sim)
+        doc = {"plan": res.trace.result["plan"],
+               "est_searched_ms": round(res.record.step_time_s * 1e3, 4),
+               "zoo_best": zoo_label,
+               "est_zoo_ms": round(zoo.step_time_s * 1e3, 4),
+               "beats_zoo": bool(res.record.score_s <= zoo_score + 1e-12),
+               "candidates": res.candidates, "pruned": res.pruned,
+               "search_s": round(res.wall_s, 3)}
+        print("  search: %s est %.3f ms vs zoo %s %.3f ms (%.1fs)"
+              % (doc["plan"], doc["est_searched_ms"], zoo_label,
+                 doc["est_zoo_ms"], res.wall_s),
+              file=sys.stderr, flush=True)
+    except Exception as e:  # noqa: BLE001 — extra leg, never fatal
+        print("  search leg failed: %s" % e, file=sys.stderr, flush=True)
+        return {"search": {"error": "%s: %s" % (type(e).__name__,
+                                                str(e)[:160])}}
+    if (os.environ.get("ADT_BENCH_SEARCH", "0") or "0") != "0":
+        doc.update(_measured_search_phases(loss_fn, opt, params, batch_np,
+                                           res.strategy, iters, fw_rates))
+    return {"search": doc}
+
+
+def _measured_search_phases(loss_fn, opt, params, batch_np, strategy,
+                            iters, fw_rates):
+    """Opt-in (ADT_BENCH_SEARCH=1) measured side of the search leg:
+    compile the searched plan through the full stack and time it."""
+    import autodist_tpu as adt
+    from autodist_tpu.strategy.base import StrategyBuilder
+
+    class _Fixed(StrategyBuilder):
+        def __init__(self, s):
+            self._s = s
+
+        def build(self, model_item, resource_spec):
+            return self._s
+
+    try:
+        adt.reset()
+        ad = adt.AutoDist(strategy_builder=_Fixed(strategy))
+        runner = ad.build(loss_fn, opt, params, batch_np)
+        runner.init(params)
+        sharded = runner.remapper.remap_feed(batch_np)
+        box = [runner.state]
+
+        def run_searched():
+            st, m = runner.distributed_step(box[0], sharded)
+            box[0] = st
+            return m["loss"]
+
+        lo = None
+        for _ in range(2):
+            lo = run_searched()
+        _sync(lo)
+        rates = [_phase_rate(run_searched, iters) for _ in range(4)]
+        adt.reset()
+        r = statistics.median(rates)
+        return {"measured_searched_steps_per_s": round(r, 4),
+                "measured_vs_zoo": round(r / statistics.median(fw_rates),
+                                         4)}
+    except Exception as e:  # noqa: BLE001 — opt-in extra, never fatal
+        print("  measured search phases failed: %s" % e, file=sys.stderr,
+              flush=True)
+        return {"measured_error": "%s: %s" % (type(e).__name__,
+                                              str(e)[:160])}
 
 
 def smoke_main(fused: bool = False):
@@ -373,9 +474,45 @@ def smoke_main(fused: bool = False):
         result.update(fuse_steps=k, dispatches=[d1, d2],
                       fused_vs_per_step=round(tp / max(tf, 1e-9), 4),
                       stats=fused_stats)
+    result["search"] = _smoke_search(loss_fn, params, batches[0])
     result.update(_smoke_telemetry())
     adt.reset()
     print(RESULT_TAG + json.dumps(result), flush=True)
+
+
+def _smoke_search(loss_fn, params, batch):
+    """Auto-search leg of the smoke bench: run the per-variable plan
+    search on the smoke MLP and ASSERT the searched plan's estimated
+    step time is <= the best zoo candidate's under the same cost model
+    (both scored with the ranking's lossy-compression premium). No
+    candidate is compiled — this is seconds of pure static scoring, and
+    it gates every PR on the searched-beats-zoo contract."""
+    import optax
+    from autodist_tpu.analysis.cli import default_spec
+    from autodist_tpu.model_item import ModelItem
+    from autodist_tpu.search.drivers import SearchConfig, run_search
+    from autodist_tpu.search.scoring import zoo_best
+    from autodist_tpu.simulator.simulator import Simulator
+
+    item = ModelItem(loss_fn=loss_fn, optimizer=optax.adam(1e-2),
+                     params=params, example_batch=batch).prepare()
+    spec = default_spec(4)
+    sim = Simulator(item, spec)
+    t0 = time.perf_counter()
+    res = run_search(item, spec, config=SearchConfig(budget=48),
+                     simulator=sim)
+    search_s = time.perf_counter() - t0
+    assert res.ok, "smoke search produced no plan"
+    zoo_label, zoo_score, zoo = zoo_best(item, spec, sim)
+    assert res.record.score_s <= zoo_score + 1e-12, (
+        "searched plan scores %.3e but zoo %s scores %.3e"
+        % (res.record.score_s, zoo_label, zoo_score))
+    return {"chosen": res.trace.result["plan"],
+            "est_search_ms": round(res.record.step_time_s * 1e3, 4),
+            "zoo_best": zoo_label,
+            "est_zoo_ms": round(zoo.step_time_s * 1e3, 4),
+            "candidates": res.candidates, "pruned": res.pruned,
+            "search_s": round(search_s, 3)}
 
 
 def _smoke_telemetry():
