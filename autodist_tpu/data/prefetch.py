@@ -15,14 +15,32 @@ import numpy as np
 from autodist_tpu.telemetry import spans as tel
 
 
-def stack_batches(group):
+def stack_batches(group, pad_to: int = None):
     """Stack a list of same-structure batches into one ``[k, ...]`` feed
     (the fused engine's input shape). Device-resident leaves stack on
     device (``jnp.stack`` — no host round-trip); host leaves via
     ``np.stack``. The ONE stacking rule, shared by
-    :class:`DevicePrefetcher`'s stack mode and ``Runner.fit``'s grouping
-    path."""
+    :class:`DevicePrefetcher`'s stack mode, ``Runner.fit``'s grouping
+    path, and the serving micro-batcher.
+
+    ``pad_to=n`` (>= len(group)) PADS the stacked leading dim to ``n`` by
+    repeating the last element — the serving path's pad-to-bucket rule
+    (a short request group runs on the nearest compiled bucket shape
+    instead of recompiling; repeated rows are real data, so no model can
+    NaN on them, and the caller masks rows ``>= len(group)`` out of the
+    fetches). Training callers keep the default (no padding): a padded
+    TRAINING step would silently weight the repeated examples into the
+    gradient."""
     import jax
+    if not group:
+        raise ValueError("stack_batches on an empty group — nothing to "
+                         "stack (or pad)")
+    if pad_to is not None:
+        if pad_to < len(group):
+            raise ValueError(
+                "stack_batches(pad_to=%d) with %d items — pad_to must be "
+                ">= the group size" % (pad_to, len(group)))
+        group = list(group) + [group[-1]] * (pad_to - len(group))
 
     def stack(*ls):
         if isinstance(ls[0], jax.Array):

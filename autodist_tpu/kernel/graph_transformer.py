@@ -45,6 +45,34 @@ def _tree_map_layouts(f, tree, layout_tree):
                                   is_leaf=lambda x: isinstance(x, VarLayout))
 
 
+class ForwardProgram:
+    """A compiled forward-only fetch program plus its per-leaf sharding
+    classification (``DistributedStep.predict_program``).
+
+    ``batch_mask`` mirrors the fetch tree with one bool per leaf: True
+    for leaves the lowering sharded over the batch axes (per-example
+    rows), False for replicated/reduced leaves. Serving's padded-row
+    masking and per-request fan-out MUST consult it rather than compare
+    output shapes — a replicated leaf whose leading dim happens to equal
+    the bucket size would otherwise be sliced like per-example rows.
+
+    Callable with the same ``(state, ps_vals, batch)`` signature as the
+    underlying jitted function; ``_cache_size()`` exposes the jit
+    cache's compiled-specialization count for the zero-recompile
+    serving contract."""
+
+    def __init__(self, fn: Callable, batch_mask):
+        self.fn = fn
+        self.batch_mask = batch_mask
+
+    def __call__(self, state, ps_vals, batch):
+        return self.fn(state, ps_vals, batch)
+
+    def _cache_size(self) -> Optional[int]:
+        cache_size = getattr(self.fn, "_cache_size", None)
+        return cache_size() if callable(cache_size) else None
+
+
 class DistributedStep:
     """The compiled distributed program (the reference's transformed
     GraphItem + WrappedSession rolled into one callable)."""
@@ -55,7 +83,8 @@ class DistributedStep:
                  step_fn_nodonate: Optional[Callable] = None,
                  eval_fn: Optional[Callable] = None,
                  ps_store=None, holed_params_template=None,
-                 fused_builder: Optional[Callable] = None):
+                 fused_builder: Optional[Callable] = None,
+                 forward_builder: Optional[Callable] = None):
         self.mesh = mesh
         self.mesh_axis = mesh_axis
         self.all_axes = tuple(mesh.axis_names)
@@ -83,6 +112,13 @@ class DistributedStep:
         # batch (k is implicit in the input shape; XLA specializes per k)
         self._fused_builder = fused_builder
         self._fused_jits: Dict[bool, Callable] = {}
+        # serving: ``forward_builder(serve_fn, donate_batch)`` lowers a
+        # forward-only FETCH program (user-named per-example outputs, no
+        # loss/grad/optimizer) — the inference engine's compile target;
+        # jitted programs cache per (serve_fn, donate) so steady-state
+        # serving re-dispatches, never re-lowers
+        self._forward_builder = forward_builder
+        self._predict_jits: Dict[tuple, Callable] = {}
         # device-resident PS carry for the fused engine: full values +
         # per-var little-tree optimizer states, written back to the host
         # store only at sync points (flush_ps) instead of every step
@@ -340,6 +376,48 @@ class DistributedStep:
             _, _, metrics = self._step_fn_nodonate(state, ps_vals, batch)
             return metrics
         return self._eval_fn(state, ps_vals, batch)
+
+    def predict_program(self, serve_fn: Callable,
+                        donate_batch: bool = True,
+                        example_batch=None) -> Callable:
+        """The compiled forward-only FETCH program behind the serving
+        engine (``autodist_tpu/serving/``): derived from the same
+        gather-params + fill-PS-holes path :meth:`evaluate` runs, but
+        returning ``serve_fn(full_params, batch)`` — the user's named
+        per-example outputs — instead of aggregate metrics. No grads, no
+        optimizer, no gradient collectives.
+
+        ``donate_batch=True`` donates the batch buffers (the one input a
+        serving dispatch truly consumes — the params/state are shared
+        across every request), so XLA reuses the request's own memory for
+        activations; callers that keep a reference to the placed batch
+        must pass ``donate_batch=False`` (``Runner.predict`` does).
+
+        Returns ``fn(state, ps_vals, batch) -> outputs``; outputs with a
+        leading (local-)batch dim come back sharded over the batch axes
+        — ``Remapper.remap_fetch`` reassembles the global batch — and
+        scalar outputs come back pmean-reduced like eval metrics. The
+        program is cached per ``(serve_fn, donate_batch, feed
+        structure)``: XLA additionally specializes per batch shape, which
+        is exactly the bucketed-shape discipline serving relies on for
+        zero steady-state recompiles.
+
+        ``example_batch`` fixes the FEED STRUCTURE (serving feeds are
+        usually the training batch minus its labels); defaults to the
+        model item's training batch structure."""
+        if self._forward_builder is None:
+            raise NotImplementedError(
+                "this DistributedStep was built without a forward-program "
+                "lowering path (step_fn capture mode hides the forward "
+                "pass) — serving needs loss_fn mode")
+        treedef = jax.tree_util.tree_structure(
+            example_batch if example_batch is not None
+            else self.model_item.example_batch)
+        key = (serve_fn, bool(donate_batch), treedef)
+        if key not in self._predict_jits:
+            self._predict_jits[key] = self._forward_builder(
+                serve_fn, bool(donate_batch), example_batch)
+        return self._predict_jits[key]
 
     def snapshot_lowered(self, state: TrainState, batch):
         """Dump the transformed program's StableHLO (the reference's
@@ -1228,6 +1306,100 @@ class GraphTransformer:
             in_specs=(state_specs, ps_specs, batch_specs),
             out_specs=metric_specs, check_vma=False))
 
+        # ----- serving forward-only lowering (DistributedStep.
+        # predict_program): the SAME per-device gather-params +
+        # fill-PS-holes path the eval program runs, but returning the
+        # user's ``serve_fn(full_params, batch)`` fetches. The
+        # out-structure comes from an abstract eval against the
+        # per-device LOCAL batch shapes (axes bound so forward-pass mesh
+        # collectives trace): leaves with a leading local-batch dim ship
+        # sharded over the batch axes — remap_fetch reassembles the
+        # global batch — and scalar leaves reduce like eval metrics.
+        serve_batch_axes = tuple(
+            self._strategy.graph_config.batch_axes or (axis,))
+
+        def forward_builder(serve_fn: Callable, donate_batch: bool,
+                            serve_batch=None):
+            from autodist_tpu.utils.axis_env import bound_axes
+            # serving feeds are usually a SUB-structure of the training
+            # batch (features only, no labels) — the program's feed specs
+            # come from the serve batch's own structure, by the same
+            # per-leaf rule the train step uses
+            if serve_batch is None:
+                serve_batch = item.example_batch
+            serve_specs = jax.tree_util.tree_map_with_path(
+                lambda path, leaf: rep.batch_spec(np.ndim(leaf),
+                                                  _normalize_path(path)),
+                serve_batch)
+
+            def local_aval(path, leaf):
+                return jax.ShapeDtypeStruct(
+                    rep.local_shape(np.shape(leaf), _normalize_path(path)),
+                    leaf.dtype if hasattr(leaf, "dtype")
+                    else np.asarray(leaf).dtype)
+            local_batch = jax.tree_util.tree_map_with_path(
+                local_aval, serve_batch)
+            lead = [np.shape(l)[0]
+                    for l in jax.tree_util.tree_leaves(local_batch)
+                    if np.ndim(l) >= 1]
+            local_rows = lead[0] if lead else 0
+            param_avals = jax.tree_util.tree_map(
+                lambda l: jax.ShapeDtypeStruct(
+                    np.shape(l), l.dtype if hasattr(l, "dtype")
+                    else np.asarray(l).dtype), item.params)
+            with bound_axes():
+                out_aval = jax.eval_shape(serve_fn, param_avals,
+                                          local_batch)
+            out_leaves, out_treedef = jax.tree_util.tree_flatten(out_aval)
+            # P is a tuple subclass, so spec trees are built by explicit
+            # unflatten (tree_map would descend INTO the specs)
+            flat_specs = [
+                P(serve_batch_axes)
+                if (np.ndim(a) >= 1 and local_rows
+                    and np.shape(a)[0] == local_rows) else P()
+                for a in out_leaves]
+            out_specs = jax.tree_util.tree_unflatten(out_treedef,
+                                                     flat_specs)
+
+            def local_predict(state: TrainState, ps_vals, batch):
+                gathered = _tree_map_layouts(
+                    lambda leaf, lay: lay.gather_full(leaf), state.params,
+                    layout_tree)
+                full_params = (ps_lib.fill_holes(gathered, ps_vals)
+                               if ps_names else gathered)
+                out = serve_fn(full_params, batch)
+                if N > 1:
+                    # non-batch (replicated-spec) leaves must actually BE
+                    # replicated on exit: reduce them the way eval
+                    # metrics reduce
+                    leaves = out_treedef.flatten_up_to(out)
+                    leaves = [
+                        v if len(s) else
+                        (jax.lax.pmean(v, all_axes)
+                         if jnp.issubdtype(jnp.asarray(v).dtype,
+                                           jnp.inexact)
+                         else jax.lax.pmax(v, all_axes))
+                        for v, s in zip(leaves, flat_specs)]
+                    out = jax.tree_util.tree_unflatten(out_treedef, leaves)
+                return out
+
+            sharded_predict = jax.shard_map(
+                local_predict, mesh=self._mesh,
+                in_specs=(state_specs, ps_specs, serve_specs),
+                out_specs=out_specs, check_vma=False)
+            # the per-leaf batch/replicated classification travels WITH
+            # the program: serving's padded-row masking and per-request
+            # fan-out must follow the sharding this lowering actually
+            # applied, not re-derive it from output shapes (a replicated
+            # leaf whose leading dim happens to equal the bucket size
+            # would otherwise be sliced like per-example rows)
+            batch_mask = jax.tree_util.tree_unflatten(
+                out_treedef, [len(s) > 0 for s in flat_specs])
+            return ForwardProgram(
+                jax.jit(sharded_predict,
+                        donate_argnums=(2,) if donate_batch else ()),
+                batch_mask)
+
         # ----- fused multi-step lowering (DistributedStep.multi_step):
         # k microsteps under lax.scan over a stacked [k, ...] batch in ONE
         # jitted dispatch. Host-PS updates are device-emulated inside the
@@ -1317,4 +1489,4 @@ class GraphTransformer:
             model_item=item, mesh_axis=axis, sync_state_init=sync_state_init,
             metadata=metadata, eval_fn=eval_fn, ps_store=ps_store,
             holed_params_template=holed_params,
-            fused_builder=fused_builder)
+            fused_builder=fused_builder, forward_builder=forward_builder)

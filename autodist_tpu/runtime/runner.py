@@ -871,15 +871,19 @@ class Runner:
         return history
 
     def evaluate(self, batches, steps: Optional[int] = None) -> dict:
-        """Mean of the SCALAR metrics over an iterable of host batches,
-        without updating parameters (the reference's ``model.evaluate``).
-        Runs the forward-only compiled program — no grads, no optimizer, no
-        gradient collectives. Non-scalar metrics are skipped (warned once);
-        aggregate those from per-step ``run`` output instead."""
+        """Example-weighted mean of the SCALAR metrics over an iterable of
+        host batches, without updating parameters (the reference's
+        ``model.evaluate``). Runs the forward-only compiled program — no
+        grads, no optimizer, no gradient collectives. Each batch's scalars
+        are weighted by its example count (the leading dim of its first
+        array leaf), so a ragged final batch contributes proportionally
+        instead of skewing a mean-of-means; batches with no array leaves
+        weight 1. Non-scalar metrics are skipped (warned once); aggregate
+        those from per-step ``run`` output instead."""
         import numpy as np
         if self.state is None:
             raise RuntimeError("Runner.evaluate before init()")
-        totals, count, skipped = {}, 0, set()
+        totals, weight, skipped = {}, 0.0, set()
         # ONE host-PS pull for the whole eval loop: no pushes happen
         # between eval batches, so the values cannot change — a consistent
         # snapshot, and per-batch re-pulls would be pure PCIe waste.
@@ -888,21 +892,57 @@ class Runner:
         ps_vals = self._dstep.pull_ps()
         bounded = batches if steps is None else itertools.islice(batches, steps)
         for batch in bounded:
+            n = self._batch_examples(batch)
             sharded = self._remapper.remap_feed(batch)
             metrics = self._dstep.evaluate(self.state, sharded,
                                            ps_vals=ps_vals)
             host = self._remapper.remap_fetch(metrics)
             for k, v in host.items():
                 if np.ndim(v) == 0:
-                    totals[k] = totals.get(k, 0.0) + float(v)
+                    totals[k] = totals.get(k, 0.0) + float(v) * n
                 elif k not in skipped:
                     skipped.add(k)
                     logging.warning("evaluate: skipping non-scalar metric "
                                     "%r (shape %s)", k, np.shape(v))
-            count += 1
-        if count == 0:
+            weight += n
+        if weight == 0.0:
             return {}
-        return {k: v / count for k, v in totals.items()}
+        return {k: v / weight for k, v in totals.items()}
+
+    @staticmethod
+    def _batch_examples(batch) -> int:
+        """Leading-dim example count of one batch (1 if no array leaf —
+        a weightless batch still counts once in the mean)."""
+        for leaf in jax.tree_util.tree_leaves(batch):
+            shape = np.shape(leaf)
+            if len(shape) >= 1:
+                return int(shape[0])
+        return 1
+
+    def predict(self, batch, serve_fn, ps_vals=None) -> dict:
+        """One-shot forward-only inference on a host batch: run the
+        compiled fetch program (``DistributedStep.predict_program``) and
+        return ``serve_fn(params, batch)``'s outputs on host, under the
+        user's original names (via the Remapper — sharded per-example
+        outputs reassemble into the global batch order).
+
+        This is the ad-hoc single call; sustained traffic wants the
+        serving engine (``autodist_tpu/serving/``), which adds bucketed
+        batch shapes (zero steady-state recompiles), request
+        micro-batching, per-request latency accounting, and graceful
+        degradation. ``ps_vals`` lets a caller loop reuse one host-PS
+        snapshot across calls (as :meth:`evaluate` does); the program
+        runs un-donated here because the caller may hold references to
+        the placed batch."""
+        if self.state is None:
+            raise RuntimeError("Runner.predict before init()")
+        program = self._dstep.predict_program(serve_fn, donate_batch=False,
+                                              example_batch=batch)
+        if ps_vals is None:
+            ps_vals = self._dstep.pull_ps()
+        sharded = self._remapper.remap_feed(batch)
+        return self._remapper.remap_fetch(
+            program(self.state, ps_vals, sharded))
 
 
 class WrappedSession:
@@ -925,6 +965,10 @@ class WrappedSession:
 
     def evaluate(self, batches, steps=None):
         return self._runner.evaluate(batches, steps=steps)
+
+    def predict(self, feed_dict, serve_fn, ps_vals=None):
+        """Forward-only fetches for one fed batch (``Runner.predict``)."""
+        return self._runner.predict(feed_dict, serve_fn, ps_vals=ps_vals)
 
     @property
     def state(self):

@@ -1,0 +1,28 @@
+"""Serving subsystem: strategy-compiled batched inference.
+
+The training-side machinery — one strategy compiler turning a
+single-device program into a distributed one, the Remapper, telemetry,
+the resilient control plane — applied to inference traffic
+(ROADMAP open item 5; docs/serving.md):
+
+- :class:`~autodist_tpu.serving.engine.InferenceEngine` — forward-only
+  donated-buffer programs derived from the evaluate path, one compiled
+  specialization per padded batch-bucket size, so steady-state serving
+  never recompiles; PS-backed strategies serve from a host-PS snapshot
+  with staleness-window degradation when the control plane blips.
+- :class:`~autodist_tpu.serving.batcher.MicroBatcher` — a request queue
+  in front of the engine: concurrent requests accumulate up to a max
+  batch or a deadline (``max_delay_ms``), pad to the nearest bucket, and
+  fan results back out per request; queue overflow and exhausted
+  degradation windows shed load with a typed
+  :class:`ServingUnavailable` instead of hanging.
+- per-request observability: ``serve.enqueue/batch/dispatch/readback``
+  spans, a ``serve.queue_depth`` gauge, and the ``serve.latency_ms``
+  histogram feeding p50/p99 (docs/observability.md).
+"""
+from autodist_tpu.serving.engine import (InferenceEngine, ServingConfig,
+                                         ServingUnavailable)
+from autodist_tpu.serving.batcher import MicroBatcher
+
+__all__ = ["InferenceEngine", "MicroBatcher", "ServingConfig",
+           "ServingUnavailable"]

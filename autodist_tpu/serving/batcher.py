@@ -1,0 +1,227 @@
+"""MicroBatcher — the request queue in front of the InferenceEngine.
+
+Concurrent callers :meth:`submit` single-example requests and get
+futures; one worker thread accumulates requests into groups — up to the
+engine's largest bucket, or until the FIRST request of the group has
+waited ``max_delay_ms`` — runs each group as one padded bucketed
+dispatch, and fans the fetches back out row-per-request. That deadline
+is the serving tier's core latency/throughput trade: a lone request
+waits at most ``max_delay_ms`` for company; a burst fills a bucket
+immediately and amortizes one program dispatch over the whole group.
+
+Failure behavior is SHED, NEVER HANG: a full queue rejects the submit
+with :class:`ServingUnavailable`; an exhausted PS-degradation window
+fails the GROUP's futures with the engine's typed error and the worker
+keeps serving (the next snapshot refresh may succeed — e.g. after the
+circuit breaker's cooldown). Every request is accounted: ``serve.
+requests/batches/shed/degraded/padded_rows`` counters, the
+``serve.queue_depth`` gauge, and the ``serve.latency_ms`` histogram
+(submit -> fan-out) feeding the p50/p99 readout in :meth:`stats`.
+"""
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional
+
+from autodist_tpu.serving.engine import InferenceEngine, ServingUnavailable
+from autodist_tpu.telemetry import spans as tel
+from autodist_tpu.utils import logging
+
+_SENTINEL = object()
+
+
+class _Pending:
+    __slots__ = ("example", "future", "t0")
+
+    def __init__(self, example):
+        self.example = example
+        self.future = Future()
+        self.t0 = time.perf_counter()
+
+
+class MicroBatcher:
+    """Queue + worker thread over an :class:`InferenceEngine`.
+
+    Context-manager friendly::
+
+        with MicroBatcher(engine) as mb:
+            futures = [mb.submit(req) for req in requests]
+            results = [f.result() for f in futures]
+    """
+
+    def __init__(self, engine: InferenceEngine,
+                 max_delay_ms: Optional[float] = None,
+                 max_queue: Optional[int] = None):
+        self._engine = engine
+        cfg = engine.config
+        self.max_delay_s = (cfg.max_delay_ms if max_delay_ms is None
+                            else max_delay_ms) / 1e3
+        self.max_queue = (cfg.max_queue if max_queue is None
+                          else int(max_queue))
+        self.max_batch = engine.max_batch
+        self._queue: "queue.Queue" = queue.Queue()
+        self._closed = False
+        # serializes submit's closed-check-then-put against close's
+        # closed-set-then-drain: without it a submit could enqueue AFTER
+        # the drain and its future would hang forever — the one thing
+        # this module promises never happens
+        self._submit_lock = threading.Lock()
+        self.stats_local = {"requests": 0, "batches": 0, "shed": 0,
+                            "errors": 0, "fan_out": 0}
+        self._worker = threading.Thread(target=self._run,
+                                        name="adt-serve-batcher",
+                                        daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------- submit
+
+    def submit(self, example) -> Future:
+        """Enqueue one single-example request; resolves to its fetch tree
+        (row of every batch-dim leaf). Sheds with
+        :class:`ServingUnavailable` when the queue is full or the
+        batcher is closed — backpressure is synchronous and typed, so an
+        overloaded tier fails fast instead of buffering unboundedly."""
+        with tel.span("serve.enqueue", "serve"), self._submit_lock:
+            if self._closed:
+                raise ServingUnavailable("micro-batcher is closed")
+            if self._queue.qsize() >= self.max_queue:
+                self.stats_local["shed"] += 1
+                tel.counter_add("serve.shed")
+                raise ServingUnavailable(
+                    "serving queue full (%d pending) — shedding"
+                    % self.max_queue)
+            pending = _Pending(example)
+            self._queue.put(pending)
+            self.stats_local["requests"] += 1
+            tel.counter_add("serve.requests")
+            tel.gauge_set("serve.queue_depth", self._queue.qsize())
+        return pending.future
+
+    def predict_one(self, example, timeout: Optional[float] = None):
+        """Blocking convenience: ``submit(example).result(timeout)``."""
+        return self.submit(example).result(timeout=timeout)
+
+    # ------------------------------------------------------------- worker
+
+    def _next_group(self):
+        """One request group: the first request opens the group and its
+        enqueue time starts the ``max_delay_ms`` deadline; the group
+        closes at the deadline, at ``max_batch``, or on shutdown.
+        Returns (group, saw_sentinel) — group may be empty."""
+        # blocking get: shutdown is signalled in-band (close() posts the
+        # sentinel), so an idle worker parks instead of polling
+        first = self._queue.get()
+        if first is _SENTINEL:
+            return [], True
+        group = [first]
+        deadline = first.t0 + self.max_delay_s
+        while len(group) < self.max_batch:
+            remaining = deadline - time.perf_counter()
+            try:
+                # past the deadline (e.g. the request queued while the
+                # worker served the previous batch), still DRAIN whatever
+                # is already waiting — a backlog must coalesce into full
+                # buckets, not serialize as size-1 batches
+                item = (self._queue.get(timeout=remaining)
+                        if remaining > 0 else self._queue.get_nowait())
+            except queue.Empty:
+                break
+            if item is _SENTINEL:
+                return group, True
+            group.append(item)
+        return group, False
+
+    def _run(self):
+        while True:
+            group, stop = self._next_group()
+            if group:
+                with tel.span("serve.batch", "serve", n=len(group)):
+                    self._serve_group(group)
+                tel.gauge_set("serve.queue_depth", self._queue.qsize())
+            if stop:
+                break
+
+    def _serve_group(self, group):
+        try:
+            fetched, n = self._engine.run_batch(
+                [p.example for p in group])
+        except ServingUnavailable as e:
+            # typed shed: fail THIS group, keep serving — the engine
+            # retries its snapshot refresh on the next batch
+            self.stats_local["shed"] += len(group)
+            tel.counter_add("serve.shed", len(group))
+            for p in group:
+                p.future.set_exception(e)
+            return
+        except Exception as e:  # noqa: BLE001 — one bad request (shape
+            # mismatch, dtype) must not kill the worker loop for every
+            # future caller; the group's futures carry the real error
+            self.stats_local["errors"] += len(group)
+            logging.warning("serving batch failed: %s", e)
+            for p in group:
+                p.future.set_exception(e)
+            return
+        self.stats_local["batches"] += 1
+        self.stats_local["fan_out"] += n
+        now = time.perf_counter()
+        for p, row in zip(group, self._engine.fan_out(fetched, n)):
+            tel.hist_observe("serve.latency_ms", (now - p.t0) * 1e3)
+            p.future.set_result(row)
+
+    # -------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        """Serving accounting for THIS batcher plus the engine's
+        snapshot/padding stats and the process-wide latency percentiles
+        (stable keys; percentiles are None before any request)."""
+        # engine stats first, then this batcher's — both carry a
+        # "batches" key, and the batcher's group count must win (the
+        # engine's also counts warmup dispatches and other callers)
+        out = dict(self._engine.stats)
+        out.update(self.stats_local)
+        out.update(
+            queue_depth=self._queue.qsize(),
+            buckets=list(self._engine.buckets),
+            recompiles_after_warmup=self._engine.recompiles_after_warmup(),
+            p50_ms=tel.hist_quantile("serve.latency_ms", 0.50),
+            p99_ms=tel.hist_quantile("serve.latency_ms", 0.99),
+        )
+        return out
+
+    # ------------------------------------------------------------ shutdown
+
+    def close(self, timeout: float = 30.0):
+        """Stop accepting, drain the worker, and fail any still-queued
+        requests with a typed shed (a silent dropped future would hang
+        its caller forever). Idempotent."""
+        with self._submit_lock:
+            if self._closed:
+                return
+            self._closed = True
+        # past this point no submit can enqueue (closed-check holds the
+        # same lock), so the drain below cannot race a late put
+        self._queue.put(_SENTINEL)
+        self._worker.join(timeout=timeout)
+        shed = ServingUnavailable("micro-batcher closed while queued")
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _SENTINEL and not item.future.done():
+                self.stats_local["shed"] += 1
+                tel.counter_add("serve.shed")
+                item.future.set_exception(shed)
+        if self._worker.is_alive():
+            # join timed out mid-group and the drain may have eaten the
+            # sentinel — re-post it so the worker exits instead of
+            # spinning on an empty queue forever
+            self._queue.put(_SENTINEL)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False

@@ -69,6 +69,16 @@ def chrome_trace(recorder: Optional[spans_lib.TraceRecorder] = None,
     for name, val in sorted(rec.gauges().items()):
         events.append({"ph": "C", "name": name, "pid": pid, "tid": 0,
                        "ts": last_ts, "args": {"value": val}})
+    # histogram summaries as counter samples: Chrome-trace has no native
+    # histogram phase, so the p50/p99 readouts graph as counter tracks —
+    # the SLO numbers land on the same timeline as the spans they time
+    for name, h in sorted(rec.histograms().items()):
+        if not h["count"]:
+            continue
+        for q_label in ("p50", "p99"):
+            events.append({"ph": "C", "name": "%s.%s" % (name, q_label),
+                           "pid": pid, "tid": 0, "ts": last_ts,
+                           "args": {"value": h[q_label]}})
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -202,6 +212,22 @@ def metrics_text(recorder: Optional[spans_lib.TraceRecorder] = None,
         mname = _metric_name(name)
         lines.append("# TYPE %s gauge" % mname)
         lines.append("%s%s %s" % (mname, lbl, _fmt_value(val)))
+    for name, h in sorted(rec.histograms().items()):
+        mname = _metric_name(name)
+        lines.append("# TYPE %s histogram" % mname)
+        # Prometheus histogram exposition: cumulative bucket counts with
+        # an ``le`` label (the extra label merges with the caller's), a
+        # +Inf bucket, and _sum/_count
+        cumulative = 0
+        for bound, c in zip(list(h["bounds"]) + [float("inf")],
+                            h["counts"]):
+            cumulative += c
+            le = "+Inf" if bound == float("inf") else _fmt_value(bound)
+            blbl = ('{%s,le="%s"}' % (lbl[1:-1], le)) if lbl \
+                else '{le="%s"}' % le
+            lines.append("%s_bucket%s %d" % (mname, blbl, cumulative))
+        lines.append("%s_sum%s %s" % (mname, lbl, _fmt_value(h["sum"])))
+        lines.append("%s_count%s %d" % (mname, lbl, h["count"]))
     return "\n".join(lines) + "\n"
 
 
@@ -231,6 +257,7 @@ def publish_telemetry(client, worker: str,
                               % (worker, rec.host, rec.pid)),
         "metrics": rec.counters(),
         "gauges": rec.gauges(),
+        "histograms": rec.histograms(),
     }
     client.bput(TELEMETRY_KEY % worker, version,
                 json.dumps(payload).encode())
@@ -265,6 +292,9 @@ def scrape_cluster(client, workers: Iterable[str]) -> dict:
                                          host=p["host"])
         shadow._counters = dict(p.get("metrics", {}))
         shadow._gauges = dict(p.get("gauges", {}))
+        shadow._histograms = {
+            n: spans_lib.Histogram.from_dict(d)
+            for n, d in p.get("histograms", {}).items()}
         texts.append(metrics_text(shadow, labels={"worker": w}))
     return {"trace": trace, "metrics_text": "".join(texts),
             "workers": sorted(blobs), "missing": missing}

@@ -129,7 +129,88 @@ DEFAULT_COUNTERS = (
     "prefetch.dropped_examples",
     "ckpt.saves", "ckpt.barrier_s", "ckpt.gc_removed",
     "search.candidates", "search.pruned",
+    "serve.requests", "serve.batches", "serve.compiles",
+    "serve.padded_rows", "serve.degraded", "serve.shed",
 )
+
+
+class Histogram:
+    """Fixed-bucket histogram (log-spaced bounds by default) — the
+    latency-distribution metric type counters cannot express: p50/p99
+    need the shape of the distribution, not its sum.
+
+    Buckets are CUMULATIVE-exportable (Prometheus ``le`` semantics come
+    from a running sum at export time); observation is one bisect + two
+    adds under the registry lock — cheap enough for a per-request serving
+    hot path. Quantile readout interpolates linearly inside the winning
+    bucket, clamped to the observed min/max so tiny samples do not report
+    a quantile outside the data."""
+
+    __slots__ = ("bounds", "counts", "count", "sum", "min", "max")
+
+    # log-spaced defaults sized for millisecond-unit observations:
+    # 0.05 ms .. ~105 s, x2 per bucket (22 finite bounds + overflow)
+    DEFAULT_BOUNDS = tuple(0.05 * 2 ** i for i in range(22))
+
+    def __init__(self, bounds=None):
+        self.bounds = tuple(float(b) for b in (
+            self.DEFAULT_BOUNDS if bounds is None else bounds))
+        if list(self.bounds) != sorted(self.bounds) or not self.bounds:
+            raise ValueError("histogram bounds must be sorted and "
+                             "non-empty, got %r" % (self.bounds,))
+        self.counts = [0] * (len(self.bounds) + 1)  # +1 = overflow bucket
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, value: float):
+        import bisect
+        v = float(value)
+        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+        self.count += 1
+        self.sum += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Approximate q-quantile (0 <= q <= 1) from the bucket counts;
+        None when empty."""
+        if self.count == 0:
+            return None
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("quantile q must be in [0, 1], got %r" % q)
+        rank = q * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if seen + c >= rank:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = (self.bounds[i] if i < len(self.bounds)
+                      else (self.max if self.max is not None else lo))
+                frac = (rank - seen) / c
+                est = lo + (hi - lo) * max(0.0, min(1.0, frac))
+                return min(max(est, self.min), self.max)
+            seen += c
+        return self.max
+
+    def to_dict(self) -> dict:
+        return {"bounds": list(self.bounds), "counts": list(self.counts),
+                "count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max,
+                "p50": self.quantile(0.5), "p99": self.quantile(0.99)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Histogram":
+        """Rebuild from :meth:`to_dict` output (the cross-process scrape
+        wire format)."""
+        h = cls(bounds=d["bounds"])
+        h.counts = list(d["counts"])
+        h.count = int(d["count"])
+        h.sum = float(d["sum"])
+        h.min, h.max = d.get("min"), d.get("max")
+        return h
 
 
 class TraceRecorder:
@@ -161,6 +242,7 @@ class TraceRecorder:
         self._counters: Dict[str, float] = dict.fromkeys(DEFAULT_COUNTERS,
                                                          0.0)
         self._gauges: Dict[str, float] = {}
+        self._histograms: Dict[str, Histogram] = {}
         self._ids = itertools.count(1)
         self._sample_tick = itertools.count()
         self._publish_seq = itertools.count(1)  # telemetry blob versions
@@ -232,6 +314,22 @@ class TraceRecorder:
         with self._lock:
             self._gauges[name] = float(value)
 
+    def hist_observe(self, name: str, value: float, bounds=None):
+        """Record one observation into the named histogram (created with
+        log-spaced default bounds — or ``bounds`` — on first use)."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(bounds)
+            h.observe(value)
+
+    def hist_quantile(self, name: str, q: float) -> Optional[float]:
+        """Approximate q-quantile of a histogram (None when absent or
+        empty) — the p50/p99 readout serving SLOs watch."""
+        with self._lock:
+            h = self._histograms.get(name)
+            return h.quantile(q) if h is not None else None
+
     def counters(self) -> Dict[str, float]:
         with self._lock:
             return dict(self._counters)
@@ -239,6 +337,12 @@ class TraceRecorder:
     def gauges(self) -> Dict[str, float]:
         with self._lock:
             return dict(self._gauges)
+
+    def histograms(self) -> Dict[str, dict]:
+        """Snapshot of every histogram as a plain dict (bounds, counts,
+        count, sum, min/max, p50/p99)."""
+        with self._lock:
+            return {n: h.to_dict() for n, h in self._histograms.items()}
 
     # ------------------------------------------------------------ snapshots
 
@@ -278,6 +382,7 @@ class TraceRecorder:
             self._appended = 0
             self._counters = dict.fromkeys(DEFAULT_COUNTERS, 0.0)
             self._gauges.clear()
+            self._histograms.clear()
 
 
 # ------------------------------------------------------- module-level state
@@ -389,6 +494,20 @@ def counter_add(name: str, value: float = 1.0):
 
 def gauge_set(name: str, value: float):
     get_recorder().gauge_set(name, value)
+
+
+def hist_observe(name: str, value: float, bounds=None):
+    """Always-on histogram observation (works with tracing disabled) —
+    the latency-distribution companion to :func:`counter_add`."""
+    get_recorder().hist_observe(name, value, bounds=bounds)
+
+
+def hist_quantile(name: str, q: float) -> Optional[float]:
+    return get_recorder().hist_quantile(name, q)
+
+
+def histograms() -> Dict[str, dict]:
+    return get_recorder().histograms()
 
 
 def counters() -> Dict[str, float]:

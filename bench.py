@@ -547,6 +547,280 @@ def _smoke_telemetry():
     return out
 
 
+# ------------------------------------------------------------- serving leg
+
+
+SERVE_MODELS = ["dlrm", "ncf"]
+
+
+def _serve_setup(label, smoke):
+    """(loss_fn, params, example_batch, serve_fn, feature_keys, builder)
+    for one serving bench model. DLRM rides Parallax (tables on
+    load-balanced PS, dense MLPs on AllReduce — the canonical
+    recommendation split); NCF rides host-PS. Both are zoo strategies."""
+    from autodist_tpu import strategy as S
+    if label == "dlrm":
+        from autodist_tpu.models.dlrm import DLRMConfig, make_train_setup
+        cfg = (DLRMConfig.tiny() if smoke else
+               DLRMConfig(table_sizes=(100_000, 50_000, 10_000, 1_000)))
+        loss_fn, params, batch, apply_fn = make_train_setup(
+            cfg, batch_size=64 if smoke else 256)
+        serve_fn = lambda p, b: {  # noqa: E731
+            "score": apply_fn(p, b["dense"], b["sparse"])}
+        return loss_fn, params, batch, serve_fn, ("dense", "sparse"), \
+            S.Parallax()
+    if label == "ncf":
+        from autodist_tpu.models.ncf import NCFConfig, make_train_setup
+        cfg = NCFConfig.tiny() if smoke else NCFConfig()
+        loss_fn, params, batch, apply_fn = make_train_setup(
+            cfg, batch_size=64 if smoke else 256)
+        serve_fn = lambda p, b: {  # noqa: E731
+            "score": apply_fn(p, b["user"], b["item"])}
+        return loss_fn, params, batch, serve_fn, ("user", "item"), S.PS()
+    raise ValueError(label)
+
+
+def _request_pool(batch, feature_keys):
+    """Per-example request pytrees (label leaves dropped) from the
+    synthetic example batch — the traffic generator's working set."""
+    import jax
+    feats = {k: batch[k] for k in feature_keys}
+    n = int(np.shape(next(iter(feats.values())))[0])
+    return [jax.tree_util.tree_map(lambda a, _i=i: np.asarray(a)[_i],
+                                   feats) for i in range(n)]
+
+
+def _drive_traffic(mb, requests, duration_s, concurrency):
+    """Closed-loop clients: ``concurrency`` threads each submit one
+    request and wait for its result, for ``duration_s``. Returns
+    (completed, shed, errors, wall_s) — QPS is completed/wall."""
+    import threading
+    from autodist_tpu.serving import ServingUnavailable
+    stop_at = time.perf_counter() + duration_s
+    done = [0] * concurrency
+    shed = [0] * concurrency
+    errors = [0] * concurrency
+
+    def client(i):
+        rng = np.random.RandomState(i)
+        while time.perf_counter() < stop_at:
+            req = requests[rng.randint(len(requests))]
+            try:
+                mb.submit(req).result(timeout=60)
+                done[i] += 1
+            except ServingUnavailable:
+                shed[i] += 1
+                time.sleep(0.002)  # back off as a real client would
+            except Exception:  # noqa: BLE001 — count, keep driving
+                errors[i] += 1
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 120)
+    return sum(done), sum(shed), sum(errors), time.perf_counter() - t0
+
+
+def _serve_fault_leg(runner, engine, mb, requests, duration_s,
+                     concurrency):
+    """Degraded-but-alive leg (runs when ``ADT_FAULT_PLAN`` is set): the
+    runner's PS store is re-wired as a NON-OWNING serving replica that
+    fetches every value group over the real coordination wire — through
+    a FaultyProxy executing the fault plan — while a second store (the
+    owner) publishes the authoritative values. Faults surface exactly
+    where production would see them (resets/delays/truncation on real
+    sockets); the assertion is behavioral: traffic keeps completing,
+    degraded reads and shed requests are COUNTED, nothing hangs."""
+    from autodist_tpu.parallel.ps import PSStore
+    from autodist_tpu.runtime import ps_service as pss
+    from autodist_tpu.runtime.coordination import CoordinationServer
+    from autodist_tpu.runtime.faultinject import FaultPlan, FaultyProxy
+    from autodist_tpu.runtime.resilience import ResilientCoordinationClient
+    from autodist_tpu.telemetry import spans as tel
+
+    plan = FaultPlan.from_env()
+    if not plan.rules:
+        return None
+    store = runner.distributed_step.ps_store
+    if store is None:
+        return {"skipped": "no host-PS store (AllReduce-only strategy)"}
+    hosts = {d.split(":")[0]
+             for p in store.plans.values() for d in p.destinations if d}
+    if len(hosts) > 1:
+        return {"skipped": "multi-owner plans: one-process fault leg "
+                           "models a single owner host"}
+    owner_host = hosts.pop() if hosts else "127.0.0.1"
+
+    import socket as socket_lib
+    with socket_lib.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        svc_port = s.getsockname()[1]
+    server = CoordinationServer(port=svc_port)
+    server.start()
+    proxy = FaultyProxy("127.0.0.1", svc_port, plan=plan).start()
+    owner = PSStore(dict(store.plans), store._var_infos, store._optimizer)
+    try:
+        def factory(host):
+            return pss.CoordPSService(
+                lambda: ResilientCoordinationClient(
+                    "127.0.0.1", proxy.port, rpc_timeout=2.0,
+                    max_retries=2, seed=0),
+                prefix="ps:" + host)
+        # the owner publishes the CURRENT trained values on the real wire
+        owner.init_params(store.full_values())
+        owner.enable_serving(factory, my_host=owner_host)
+        # the serving replica owns nothing: every snapshot refresh now
+        # crosses the faulted wire
+        store.enable_serving(factory, my_host="bench-serve-replica")
+        engine.config.snapshot_max_age_s = 0.0  # refresh every batch
+        c0 = tel.counters()
+        done, shed, errors, wall = _drive_traffic(
+            mb, requests, duration_s, concurrency)
+        c1 = tel.counters()
+        return {
+            "qps": round(done / wall, 2),
+            "completed": done, "shed": shed, "errors": errors,
+            "alive": done > 0,
+            "degraded_snapshots":
+                c1.get("serve.degraded", 0) - c0.get("serve.degraded", 0),
+            "degraded_ps_pulls": c1.get("ps.degraded_pulls", 0)
+                - c0.get("ps.degraded_pulls", 0),
+            "shed_requests":
+                c1.get("serve.shed", 0) - c0.get("serve.shed", 0),
+            "faults_injected": len(plan.injected),
+        }
+    finally:
+        proxy.stop()
+        owner.close()
+        server.stop()
+
+
+def _serve_bench_model(label, smoke, fault):
+    """One model's serving leg: build the strategy-compiled engine, warm
+    every bucket, drive closed-loop traffic, report QPS + latency
+    percentiles (+ the fault leg when a plan is set)."""
+    import optax
+    import autodist_tpu as adt
+    from autodist_tpu.serving import (InferenceEngine, MicroBatcher,
+                                      ServingConfig)
+    from autodist_tpu.telemetry import spans as tel
+
+    loss_fn, params, batch, serve_fn, feature_keys, builder = _serve_setup(
+        label, smoke)
+    adt.reset()
+    ad = adt.AutoDist(strategy_builder=builder)
+    runner = ad.build(loss_fn, optax.adam(1e-3), params, batch)
+    runner.init(params)
+    runner.run(batch)  # one train step: serve values that actually moved
+    requests = _request_pool(batch, feature_keys)
+    replicas = runner.remapper.num_replicas
+    buckets = ((4 * replicas, 8 * replicas) if smoke else None)
+    engine = InferenceEngine(
+        runner, serve_fn, requests[0],
+        ServingConfig(buckets=buckets,
+                      max_delay_ms=1.0 if smoke else 2.0))
+    t0 = time.perf_counter()
+    engine.warmup()
+    warmup_s = time.perf_counter() - t0
+    duration = float(os.environ.get("ADT_SERVE_DURATION_S",
+                                    "2" if smoke else "10"))
+    concurrency = int(os.environ.get("ADT_SERVE_CONCURRENCY",
+                                     "8" if smoke else "32"))
+    mb = MicroBatcher(engine)
+    try:
+        done, shed, errors, wall = _drive_traffic(mb, requests, duration,
+                                                  concurrency)
+        stats = mb.stats()
+        result = {
+            "strategy": type(builder).__name__,
+            "buckets": stats["buckets"],
+            "warmup_s": round(warmup_s, 3),
+            "qps": round(done / wall, 2),
+            "completed": done, "shed": shed, "errors": errors,
+            "p50_ms": (round(stats["p50_ms"], 3)
+                       if stats["p50_ms"] is not None else None),
+            "p99_ms": (round(stats["p99_ms"], 3)
+                       if stats["p99_ms"] is not None else None),
+            "batches": stats["batches"],
+            "avg_batch_fill": round(stats["fan_out"]
+                                    / max(stats["batches"], 1), 2),
+            "padded_rows": stats["padded_rows"],
+            "recompiles_after_warmup": stats["recompiles_after_warmup"],
+        }
+        assert result["recompiles_after_warmup"] == 0, (
+            "steady-state serving recompiled %d time(s) after warmup"
+            % result["recompiles_after_warmup"])
+        assert errors == 0, "%d serving requests errored" % errors
+        if fault:
+            fault_res = _serve_fault_leg(runner, engine, mb, requests,
+                                         duration, concurrency)
+            if fault_res is not None:
+                result["fault"] = fault_res
+        # per-replica QPS: the millions-of-users scaling unit
+        import jax
+        result["qps_per_replica"] = round(result["qps"]
+                                          / max(len(jax.devices()), 1), 2)
+        result["latency_histogram"] = tel.histograms().get(
+            "serve.latency_ms", {})
+        return result
+    finally:
+        # close the batcher thread but do NOT adt.reset() here: the next
+        # model's build-time reset (and serve_main's final one) handles
+        # isolation, and resetting now would wipe the recorder before
+        # serve_main exports the ADT_TRACE=1 trace artifact
+        mb.close()
+
+
+def serve_main(smoke: bool):
+    """``bench.py --serve`` (and the ``--smoke --serve`` CI leg): serving
+    QPS + p50/p99 latency for the recommendation flagships (DLRM, NCF)
+    on zoo strategies, with the zero-recompile contract asserted and —
+    under ``ADT_FAULT_PLAN`` — a degraded-but-alive fault leg on the
+    real coordination wire. Under ``ADT_TRACE=1`` the run exports a
+    validated Perfetto trace with the ``serve.*`` spans."""
+    import jax
+    jax.config.update("jax_platforms",
+                      os.environ.get("ADT_BENCH_PLATFORM") or "cpu")
+    labels = [s for s in os.environ.get(
+        "ADT_SERVE_MODELS", ",".join(SERVE_MODELS)).split(",") if s]
+    fault = bool(os.environ.get("ADT_FAULT_PLAN"))
+    from autodist_tpu.telemetry import export as tel_export, spans as tel
+    models = {}
+    traces = []
+    for label in labels:
+        try:
+            models[label] = _serve_bench_model(label, smoke, fault)
+            print("  serve %s: %s qps, p50 %s ms, p99 %s ms"
+                  % (label, models[label]["qps"], models[label]["p50_ms"],
+                     models[label]["p99_ms"]), file=sys.stderr, flush=True)
+        except Exception as e:  # noqa: BLE001 — one model must not cost
+            # the artifact; smoke re-raises below so CI stays strict
+            models[label] = {"error": "%s: %s" % (type(e).__name__,
+                                                  str(e)[:200])}
+            if smoke:
+                raise
+            print("  serve %s FAILED: %s" % (label, models[label]["error"]),
+                  file=sys.stderr, flush=True)
+        # snapshot THIS model's spans now: the next model's build-time
+        # adt.reset() wipes the recorder, and the exported artifact must
+        # cover every model, not just the last
+        if tel.tracing_enabled():
+            traces.append(tel_export.chrome_trace())
+    result = {"metric": "serve", "smoke": smoke, "models": models}
+    result.update(_smoke_telemetry())
+    if len(traces) > 1 and result.get("trace_file"):
+        merged = tel_export.merge_traces(traces)
+        if not tel_export.validate_chrome_trace(merged):
+            with open(result["trace_file"], "w") as f:
+                json.dump(merged, f)
+            result["trace_events"] = len(merged["traceEvents"])
+    import autodist_tpu as adt
+    adt.reset()
+    print(RESULT_TAG + json.dumps(result), flush=True)
+
+
 def probe_main():
     """Trivial device matmul — the parent's preflight. A tunnel that
     cannot run this will time out every model; recording that fact in
@@ -777,6 +1051,8 @@ if __name__ == "__main__":
         child_main(sys.argv[2])
     elif len(sys.argv) >= 2 and sys.argv[1] == "--probe":
         probe_main()
+    elif "--serve" in sys.argv[1:]:
+        serve_main(smoke="--smoke" in sys.argv[1:])
     elif len(sys.argv) >= 2 and sys.argv[1] == "--smoke":
         smoke_main(fused="--fused" in sys.argv[2:])
     else:

@@ -1,0 +1,102 @@
+"""Environment capability probes for the multi-process test suites.
+
+The two-OS-process suites (``test_distributed``, ``test_elastic``,
+``test_local_launch``) need REAL cross-process collectives on the CPU
+backend: two processes join one ``jax.distributed`` job and psum across
+the process boundary. Some jaxlib builds (including slim CI containers)
+ship a CPU backend without multi-process support — every collective fails
+with ``Multiprocess computations aren't implemented on the CPU backend``
+and the suites carry dozens of environment (not code) failures.
+
+:func:`multiprocess_collectives_supported` answers the question ONCE per
+pytest run with an actual two-process probe on the real wire path (two
+children, one ``jax.distributed`` job, one broadcast collective) so the
+suites can ``skipif`` cleanly instead. Override with ``ADT_MP_PROBE=1``
+(force-run the suites) or ``ADT_MP_PROBE=0`` (force-skip, e.g. to keep a
+known-bad sandbox fast).
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_PROBE_CHILD = """
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address="127.0.0.1:%s" % sys.argv[1],
+                           num_processes=2, process_id=int(sys.argv[2]))
+from jax.experimental import multihost_utils
+multihost_utils.sync_global_devices("adt-mp-probe")
+assert len(jax.devices()) == 2, jax.devices()
+print("MP_PROBE_OK", flush=True)
+"""
+
+_RESULT = {}
+
+MP_SKIP_REASON = ("this jaxlib's CPU backend has no multi-process "
+                  "collectives (probe failed; ADT_MP_PROBE=1 overrides)")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def multiprocess_collectives_supported(timeout_s: float = 90.0) -> bool:
+    """True when two OS processes can run a jax.distributed CPU
+    collective here. One real probe per pytest run (memoized)."""
+    if "ok" not in _RESULT:
+        override = os.environ.get("ADT_MP_PROBE", "").strip()
+        if override in ("0", "1"):
+            _RESULT["ok"] = override == "1"
+        else:
+            _RESULT["ok"] = _run_probe(timeout_s)
+    return _RESULT["ok"]
+
+
+def needs_mp_collectives():
+    """Decorator for tests whose child processes must psum ACROSS the
+    process boundary (global-mesh training, external-launch strategy
+    broadcast, sync-elastic restore). Async-PS tests that keep per-process
+    local meshes but launch through the collective strategy broadcast need
+    it too; pure control-plane tests (supervision, reap patterns, local
+    remapper validation) do not and keep running everywhere.
+
+    Returns a plain marker; conftest's ``pytest_runtest_setup`` hook runs
+    the (memoized) probe at the FIRST marked test's setup, so collection
+    and probe-free runs (``--collect-only``, ``-k`` selecting none of the
+    multi-process tests) never pay the two-process spawn."""
+    return pytest.mark.needs_mp_collectives
+
+
+def _run_probe(timeout_s: float) -> bool:
+    port = _free_port()
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=1",
+               JAX_PLATFORMS="cpu")
+    # the children must not inherit a worker identity from the test env
+    for k in ("ADT_WORKER", "ADT_PROCESS_ID", "ADT_NUM_PROCESSES"):
+        env.pop(k, None)
+    procs = [
+        subprocess.Popen([sys.executable, "-c", _PROBE_CHILD, str(port),
+                          str(i)],
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True, env=env)
+        for i in (0, 1)]
+    ok = True
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout_s)
+            ok = ok and p.returncode == 0 and "MP_PROBE_OK" in out
+    except subprocess.TimeoutExpired:
+        ok = False
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    return ok

@@ -24,6 +24,19 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "integration: multi-process tests gated by --run-integration")
+    config.addinivalue_line(
+        "markers", "needs_mp_collectives: requires multi-process CPU "
+        "collectives (probed lazily at first marked test's setup)")
+
+
+def pytest_runtest_setup(item):
+    # lazy capability gate: probe once per run, only when a marked test is
+    # actually about to execute (collection stays probe-free)
+    if "needs_mp_collectives" in item.keywords:
+        from _capabilities import (MP_SKIP_REASON,
+                                   multiprocess_collectives_supported)
+        if not multiprocess_collectives_supported():
+            pytest.skip(MP_SKIP_REASON)
 
 
 def pytest_addoption(parser):

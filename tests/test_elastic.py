@@ -22,6 +22,13 @@ import sys
 
 import pytest
 
+from _capabilities import needs_mp_collectives
+
+# async-elastic recovery couples processes only through the coordination
+# service (per-process local meshes — no cross-process collectives), so
+# most tests here run anywhere; only the SYNC-elastic flows join a real
+# two-process jax.distributed job and carry @needs_mp_collectives()
+
 HERE = os.path.dirname(os.path.abspath(__file__))
 
 USER_SCRIPT = """
@@ -268,6 +275,7 @@ print(role.upper() + "_DONE start=%d" % start, flush=True)
 """
 
 
+@needs_mp_collectives()
 def test_sync_elastic_whole_job_restart_resumes_from_checkpoint(tmp_path):
     """ADT_ELASTIC + ADT_ELASTIC_SYNC on a sync (AllReduce) job: a worker
     dies mid-lockstep, the chief reaps the mesh and re-execs itself, the
@@ -389,6 +397,7 @@ print("CHIEF_DONE start=%d world=%d" % (start, jax.device_count()),
 """
 
 
+@needs_mp_collectives()
 def test_sync_elastic_reduced_world_after_permanent_loss(tmp_path):
     """VERDICT-r4 #1 (elastic half): a worker that dies on two consecutive
     incarnations is treated as PERMANENTLY lost — the chief excludes it,

@@ -18,6 +18,8 @@ import sys
 import numpy as np
 import pytest
 
+from _capabilities import needs_mp_collectives
+
 HERE = os.path.dirname(os.path.abspath(__file__))
 
 USER_SCRIPT = """
@@ -95,6 +97,7 @@ def _run_chief(tmp_path, mode):
         env=env, capture_output=True, text=True, timeout=180)
 
 
+@needs_mp_collectives()
 def test_chief_launches_and_trains_with_worker(tmp_path):
     proc = _run_chief(tmp_path, "train")
     assert proc.returncode == 0, proc.stdout + proc.stderr

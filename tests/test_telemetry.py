@@ -530,3 +530,72 @@ def test_cli_inspect_validate_merge_diff_drift(tmp_path, capsys):
     assert cli.main(["drift", rpath]) == 0
     out = capsys.readouterr().out
     assert "reduce" in out and "strategy=s" in out
+
+
+# ------------------------------------------------------------- histograms
+
+
+def test_histogram_observe_quantiles_and_validation():
+    h = tel.Histogram()
+    assert h.quantile(0.5) is None  # empty
+    for v in (1.0, 2.0, 3.0, 4.0, 100.0):
+        h.observe(v)
+    assert h.count == 5 and h.sum == 110.0
+    assert h.min == 1.0 and h.max == 100.0
+    # quantiles interpolate inside the bucket but never leave the data
+    assert h.min <= h.quantile(0.5) <= h.max
+    assert h.quantile(0.99) <= h.max
+    assert h.quantile(1.0) == h.max
+    with pytest.raises(ValueError, match="in \\[0, 1\\]"):
+        h.quantile(1.5)
+    with pytest.raises(ValueError, match="sorted"):
+        tel.Histogram(bounds=(3.0, 1.0))
+    with pytest.raises(ValueError, match="non-empty"):
+        tel.Histogram(bounds=())
+    # wire-format round trip (the cross-process scrape path)
+    d = h.to_dict()
+    assert d["p50"] == h.quantile(0.5) and d["p99"] == h.quantile(0.99)
+    back = tel.Histogram.from_dict(d)
+    assert back.to_dict() == d
+
+
+def test_histogram_registry_prometheus_and_chrome_export():
+    rec = tel.TraceRecorder(capacity=16, sample=1, pid=42, host="h")
+    for v in (0.5, 2.0, 2.5, 40.0):
+        rec.hist_observe("serve.latency_ms", v)
+    assert rec.hist_quantile("serve.latency_ms", 0.5) is not None
+    assert rec.hist_quantile("nope", 0.5) is None
+    text = export.metrics_text(rec, labels={"worker": "w0"})
+    assert "# TYPE adt_serve_latency_ms histogram" in text
+    # cumulative le buckets merge the caller's labels, end at +Inf
+    assert 'adt_serve_latency_ms_bucket{worker="w0",le="+Inf"} 4' in text
+    assert 'adt_serve_latency_ms_sum{worker="w0"} 45' in text
+    assert 'adt_serve_latency_ms_count{worker="w0"} 4' in text
+    buckets = [int(l.rsplit(" ", 1)[1]) for l in text.splitlines()
+               if l.startswith("adt_serve_latency_ms_bucket")]
+    assert buckets == sorted(buckets)  # cumulative by construction
+    trace = export.chrome_trace(rec)
+    assert export.validate_chrome_trace(trace) == []
+    names = {e["name"] for e in trace["traceEvents"] if e["ph"] == "C"}
+    assert {"serve.latency_ms.p50", "serve.latency_ms.p99"} <= names
+
+
+def test_histogram_survives_publish_scrape_round_trip():
+    client = _FakeCoordClient()
+    rec = tel.TraceRecorder(capacity=16, sample=1, pid=7, host="n0")
+    rec.hist_observe("serve.latency_ms", 3.0)
+    rec.hist_observe("serve.latency_ms", 9.0)
+    export.publish_telemetry(client, "w0", rec)
+    scraped = export.scrape_cluster(client, ["w0"])
+    text = scraped["metrics_text"]
+    assert 'adt_serve_latency_ms_count{worker="w0"} 2' in text
+    assert 'adt_serve_latency_ms_sum{worker="w0"} 12' in text
+
+
+def test_module_level_histogram_helpers_and_reset():
+    tel.hist_observe("serve.latency_ms", 5.0)
+    assert tel.hist_quantile("serve.latency_ms", 0.5) is not None
+    assert "serve.latency_ms" in tel.histograms()
+    tel.get_recorder().clear()
+    assert tel.hist_quantile("serve.latency_ms", 0.5) is None
+    assert tel.histograms() == {}
