@@ -1,4 +1,6 @@
 """Checkpoint layer (reference ``autodist/checkpoint/``)."""
+from autodist_tpu.checkpoint import integrity
+from autodist_tpu.checkpoint.integrity import CheckpointDamaged
 from autodist_tpu.checkpoint.saver import Saver
 from autodist_tpu.checkpoint.sharded import ShardedSaver
 from autodist_tpu.checkpoint.saved_model_builder import (SavedModelBuilder,
@@ -6,11 +8,13 @@ from autodist_tpu.checkpoint.saved_model_builder import (SavedModelBuilder,
 
 
 def latest_checkpoint(directory):
-    """(step, saver) of the newest committed checkpoint in ``directory``
-    across BOTH formats (plain Saver and ShardedSaver), or (None, None).
-    The single authority for "is there something to restore, and through
-    which saver" — auto-resume (Runner.init) and the sync-elastic restart
-    gate (coordinator) must agree on the answer."""
+    """(step, saver) of the newest committed AND valid checkpoint in
+    ``directory`` across BOTH formats (plain Saver and ShardedSaver), or
+    (None, None) — ``latest()`` runs the fast integrity validation, so a
+    torn or damaged newest step is skipped here, not discovered at
+    restore time. The single authority for "is there something to
+    restore, and through which saver" — auto-resume (Runner.init) and the
+    sync-elastic restart gate (coordinator) must agree on the answer."""
     best = (None, None)
     for saver_cls in (Saver, ShardedSaver):
         try:
@@ -26,4 +30,5 @@ def latest_checkpoint(directory):
 
 
 __all__ = ["Saver", "ShardedSaver", "SavedModelBuilder",
-           "export_for_serving", "latest_checkpoint"]
+           "export_for_serving", "latest_checkpoint", "integrity",
+           "CheckpointDamaged"]

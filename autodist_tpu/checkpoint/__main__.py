@@ -1,0 +1,7 @@
+"""``python -m autodist_tpu.checkpoint`` — checkpoint lifecycle CLI."""
+import sys
+
+from autodist_tpu.checkpoint.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

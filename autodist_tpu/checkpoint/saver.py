@@ -17,19 +17,40 @@ gate (reference ``autodist/autodist.py:40-41``).
 import json
 import os
 import threading
+import time
+import zipfile
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 
 from autodist_tpu import const
+from autodist_tpu.checkpoint import integrity
+from autodist_tpu.checkpoint.integrity import CheckpointDamaged
 from autodist_tpu.kernel.common import variable_utils
+from autodist_tpu.runtime.faultinject import checkpoint_fault
+from autodist_tpu.telemetry import spans as tel
 from autodist_tpu.utils import logging
 
 
 def _tree_to_flat(tree) -> Dict[str, np.ndarray]:
     names, leaves, _ = variable_utils.flatten_named(tree)
     return {n: np.asarray(jax.device_get(l)) for n, l in zip(names, leaves)}
+
+
+def _read_npz(path: str) -> Dict[str, np.ndarray]:
+    """Fully read one npz, converting every read-path failure — vanished
+    file, I/O error, zip/npy corruption — to :class:`CheckpointDamaged`,
+    so the restore fallback loop can catch exactly that and configuration
+    errors (template mismatch in ``_flat_to_tree``) stay loud. In
+    particular a mid-read ``FileNotFoundError`` must NOT escape: the
+    caller's no-valid-checkpoint sentinel shares that type, and
+    ``Runner.init`` would misread the error as "start fresh"."""
+    try:
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+    except (OSError, ValueError, zipfile.BadZipFile) as e:
+        raise CheckpointDamaged("%s unreadable: %s" % (path, e)) from e
 
 
 def _flat_to_tree(template, flat: Dict[str, np.ndarray]):
@@ -131,7 +152,6 @@ class Saver:
             dstep = runner_or_step
         if state is None:
             raise ValueError("no state to save")
-        from autodist_tpu.telemetry import spans as tel
         # cross-process collectives: run on all processes before any gating
         with tel.span("ckpt.gather", "ckpt"):
             params = dstep.gather_params(state)
@@ -139,6 +159,7 @@ class Saver:
             sync_state_host = dstep.gather_sync_state(state)
         if step is None:
             step = int(jax.device_get(state.step))
+        checkpoint_fault("collect", step=step)
         if self.chief_only and not const.is_chief():
             return None
         path = os.path.join(self.directory, "ckpt-%d" % step)
@@ -146,19 +167,49 @@ class Saver:
                 "strategy_id": dstep.strategy.id}
 
         def write():
+            t_begin = time.monotonic()
             with tel.span("ckpt.write", "ckpt", step=int(step)):
-                np.savez(path + ".params.npz", **_tree_to_flat(params))
-                np.savez(path + ".opt.npz", **_tree_to_flat(opt_state_host))
+                trees = [(".params.npz", _tree_to_flat(params)),
+                         (".opt.npz", _tree_to_flat(opt_state_host))]
                 sync_flat = _tree_to_flat(sync_state_host)
                 if sync_flat:
-                    np.savez(path + ".sync.npz", **sync_flat)
-                # meta last: a checkpoint only becomes visible to
-                # _own_metas / latest() once all its data files exist
-                with open(path + ".meta.json", "w") as f:
+                    trees.append((".sync.npz", sync_flat))
+                # every data file goes to a .tmp sibling first and is
+                # os.replace'd into place — a crash mid-serialization can
+                # never leave a truncated npz under the FINAL name (the
+                # torn write numpy.load would fail on with no indication
+                # of why); the meta records each file's crc32+bytes so
+                # post-commit damage is detectable (integrity.py)
+                file_meta: Dict[str, dict] = {}
+                finals = []
+                for suffix, flat in trees:
+                    final = path + suffix
+                    tmp = final + ".tmp"
+                    with open(tmp, "wb") as f:
+                        # the non-seekable proxy digests the stream as it
+                        # is written (zipfile falls back to data-descriptor
+                        # mode, so the digest IS the bytes on disk) — no
+                        # second read pass over a multi-GB checkpoint
+                        w = integrity.Crc32Writer(f)
+                        np.savez(w, **flat)
+                    file_meta[os.path.basename(final)] = w.digest
+                    finals.append((tmp, final))
+                checkpoint_fault("write", path=path, step=int(step))
+                for tmp, final in finals:
+                    os.replace(tmp, final)
+                meta["files"] = file_meta
+                # meta last, atomically: a checkpoint only becomes visible
+                # to _own_metas / latest() once all its data files exist
+                checkpoint_fault("meta", path=path, step=int(step))
+                with open(path + ".meta.json.tmp", "w") as f:
                     json.dump(meta, f)
+                os.replace(path + ".meta.json.tmp", path + ".meta.json")
+                checkpoint_fault("committed", path=path, step=int(step))
             with tel.span("ckpt.gc", "ckpt"):
                 self._gc()
             tel.counter_add("ckpt.saves")
+            tel.hist_observe("ckpt.save_ms",
+                             (time.monotonic() - t_begin) * 1e3)
             logging.info("saved checkpoint %s (step %d)", path, step)
 
         if not self.async_save:
@@ -187,16 +238,34 @@ class Saver:
                     os.remove(os.path.join(self.directory, victim + suffix))
                 except FileNotFoundError:
                     pass
+        # failed-attempt debris (.tmp siblings, data files whose meta —
+        # the commit point — never landed) below the newest commit
+        victims, _ = integrity.gc_candidates(self.directory, "plain")
+        for f in victims:
+            try:
+                os.remove(os.path.join(self.directory, f))
+                tel.counter_add("ckpt.gc_orphans")
+            except FileNotFoundError:
+                pass
+        if victims:
+            logging.info("checkpoint gc: removed %d failed-attempt files "
+                         "(%s)", len(victims), ", ".join(victims[:6]))
 
     # --------------------------------------------------------------- restore
 
     def latest(self) -> Optional[str]:
+        """Base path of the newest COMMITTED checkpoint — fast validation
+        skips torn save attempts and structurally damaged steps with a
+        logged reason."""
         self.wait()  # an in-flight async write must be visible to readers
-        metas = self._own_metas()
-        if not metas:
-            return None
-        return os.path.join(self.directory,
-                            metas[-1][1].replace(".meta.json", ""))
+        for status in integrity.committed_newest_first(self.directory,
+                                                       "plain"):
+            if status.committed:
+                return status.base
+            logging.warning("checkpoint step %d is %s, skipping: %s",
+                            status.step, status.state,
+                            "; ".join(status.problems[:3]))
+        return None
 
     def restore_params(self, params_template, path: Optional[str] = None):
         """Params pytree in the original layout — usable with or without the
@@ -205,19 +274,58 @@ class Saver:
         path = path or self.latest()
         if path is None:
             raise FileNotFoundError("no checkpoint in %s" % self.directory)
-        flat = dict(np.load(path + ".params.npz"))
+        flat = _read_npz(path + ".params.npz")
         return _flat_to_tree(params_template, flat)
 
     def restore(self, runner, path: Optional[str] = None) -> Tuple[Any, int]:
-        """Restore a Runner's distributed state; returns (state, step)."""
+        """Restore a Runner's distributed state; returns (state, step).
+
+        **Last-good fallback**: with no explicit ``path``, checkpoints are
+        tried newest-first, skipping torn attempts and damaged steps (fast
+        validation up front, read-time zip-CRC failures during the load)
+        with a logged reason and ``ckpt.fallback``/``ckpt.corrupt_shards``
+        counters; hard-fails only when no valid checkpoint exists. An
+        explicit ``path`` is validated and refused when damaged."""
         self.wait()  # the path from an async save() is valid only post-write
-        path = path or self.latest()
-        if path is None:
-            raise FileNotFoundError("no checkpoint in %s" % self.directory)
+        if path is not None:
+            # validate where the path POINTS — it need not live in this
+            # saver's directory (restoring someone else's export)
+            status = integrity.validate_plain(*integrity.parse_base(path))
+            if not status.committed:
+                tel.counter_add("ckpt.corrupt_shards", len(status.damaged))
+                raise CheckpointDamaged(
+                    "checkpoint %s is %s: %s" % (
+                        path, status.state, "; ".join(status.problems[:5])))
+            return self._restore_at(runner, path)
+        tried = 0
+        for status in integrity.committed_newest_first(self.directory,
+                                                       "plain"):
+            if not status.committed:
+                logging.warning("restore: skipping step %d (%s): %s",
+                                status.step, status.state,
+                                "; ".join(status.problems[:3]))
+                tel.counter_add("ckpt.fallback")
+                tel.counter_add("ckpt.corrupt_shards", len(status.damaged))
+                continue
+            tried += 1
+            try:
+                return self._restore_at(runner, status.base)
+            except (CheckpointDamaged, zipfile.BadZipFile) as e:
+                if jax.process_count() > 1:
+                    raise  # peers must all restore the SAME step
+                logging.warning("restore: step %d damaged mid-read (%s); "
+                                "falling back", status.step, e)
+                tel.counter_add("ckpt.fallback")
+                tel.counter_add("ckpt.corrupt_shards")
+        raise FileNotFoundError(
+            "no valid checkpoint in %s (%d committed candidate(s) tried)"
+            % (self.directory, tried))
+
+    def _restore_at(self, runner, path: str) -> Tuple[Any, int]:
         dstep = runner.distributed_step
         params = self.restore_params(dstep.model_item.params, path)
         if dstep.model_item.optimizer is not None:
-            opt_flat = dict(np.load(path + ".opt.npz"))
+            opt_flat = _read_npz(path + ".opt.npz")
             opt_template = dstep.model_item.optimizer.init(
                 dstep.model_item.params)
             opt_state = _flat_to_tree(opt_template, opt_flat)
@@ -227,15 +335,19 @@ class Saver:
             opt_state = {}
         sync_state = None
         if os.path.exists(path + ".sync.npz"):
-            sync_flat = dict(np.load(path + ".sync.npz"))
+            sync_flat = _read_npz(path + ".sync.npz")
             try:
                 sync_state = _flat_to_tree(dstep._sync_state_init(), sync_flat)
             except (KeyError, ValueError) as e:
                 logging.warning("sync state in checkpoint incompatible with "
                                 "current strategy (%s); reinitializing", e)
         state = dstep.init_state(params, opt_state, sync_state)
-        with open(path + ".meta.json") as f:
-            step = json.load(f)["step"]
+        try:
+            with open(path + ".meta.json") as f:
+                step = json.load(f)["step"]
+        except (OSError, json.JSONDecodeError, KeyError) as e:
+            raise CheckpointDamaged(
+                "%s.meta.json unreadable: %s" % (path, e)) from e
         # advance the step counter to the saved step
         from autodist_tpu.train_state import TrainState
         state = TrainState(step=dstep._put(np.asarray(step, np.int32),
@@ -243,5 +355,6 @@ class Saver:
                            params=state.params, opt_state=state.opt_state,
                            sync_state=state.sync_state)
         runner.state = state
+        tel.counter_add("ckpt.restores")
         logging.info("restored checkpoint %s (step %d)", path, step)
         return state, step

@@ -54,8 +54,11 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from autodist_tpu import const
+from autodist_tpu.checkpoint import integrity
+from autodist_tpu.checkpoint.integrity import CheckpointDamaged
 from autodist_tpu.checkpoint.saver import BackgroundWriter
 from autodist_tpu.kernel.common import variable_utils
+from autodist_tpu.runtime.faultinject import checkpoint_fault
 from autodist_tpu.telemetry import spans as tel
 from autodist_tpu.utils import logging
 
@@ -121,14 +124,20 @@ def _leaf_unpad(name: str, shape, layouts) -> Optional[Tuple[int, int]]:
 
 class _StreamingNpzWriter:
     """npz writer that streams one array at a time (zipfile + np.save), so
-    peak memory while saving is a single shard, not the whole file."""
+    peak memory while saving is a single shard, not the whole file.
+    ``checksums`` maps each written key to ``[crc32, nbytes]`` of its
+    serialized npy stream — recorded in the index file so fsck and the
+    restore fallback can prove the bytes on disk are the bytes written."""
 
     def __init__(self, path: str):
         self._zf = zipfile.ZipFile(path, "w", zipfile.ZIP_STORED)
+        self.checksums: Dict[str, list] = {}
 
     def write(self, key: str, arr: np.ndarray):
         with self._zf.open(key + ".npy", "w", force_zip64=True) as f:
-            np.save(f, np.asarray(arr))
+            cf = integrity.Crc32Writer(f)
+            np.save(cf, np.asarray(arr))
+        self.checksums[key] = [cf.crc, cf.nbytes]
 
     def close(self):
         self._zf.close()
@@ -257,6 +266,7 @@ class ShardedSaver:
                                       leaves_meta, opt_layouts, suffix)
             self._device_tree_entries("S", state.sync_state, collect,
                                       leaves_meta, {}, suffix)
+        checkpoint_fault("collect", step=int(step))
 
         ps_meta: Dict[str, dict] = {}
         store = dstep.ps_store
@@ -301,6 +311,7 @@ class ShardedSaver:
         }
 
         def write(barrier=None):
+            t_begin = time.monotonic()
             with tel.span("ckpt.write", "ckpt", step=int(step)):
                 shard_path = "%s.shard-p%d.npz" % (base, pid)
                 tmp = shard_path + ".tmp"
@@ -317,14 +328,20 @@ class ShardedSaver:
                         w.write(key, arr() if callable(arr) else arr)
                         written_keys.append(key)
                 w.close()
+                checkpoint_fault("write", path=tmp, step=int(step))
                 os.replace(tmp, shard_path)
+                checkpoint_fault("index", path=shard_path, step=int(step))
                 index_path = "%s.shard-p%d.index.json" % (base, pid)
                 tmp = index_path + ".tmp"
                 with open(tmp, "w") as f:
                     json.dump({"pid": pid, "nonce": nonce,
-                               "keys": written_keys}, f)
+                               "keys": written_keys,
+                               "checksums": w.checksums}, f)
                 os.replace(tmp, index_path)
                 entries.clear()  # free host copies once they're on disk
+            # pass the BASE so damage rules at this phase can target any
+            # sibling file (shard npz / index), per the phase semantics
+            checkpoint_fault("meta", path=base, step=int(step))
             if barrier is not None:
                 t_bar = time.monotonic()
                 with tel.span("ckpt.barrier", "ckpt", step=int(step),
@@ -343,9 +360,12 @@ class ShardedSaver:
                 with open(tmp, "w") as f:
                     json.dump(meta, f)
                 os.replace(tmp, base + ".shard-meta.json")
+                checkpoint_fault("committed", path=base, step=int(step))
                 with tel.span("ckpt.gc", "ckpt"):
                     self._gc()
                 tel.counter_add("ckpt.saves")
+                tel.hist_observe("ckpt.save_ms",
+                                 (time.monotonic() - t_begin) * 1e3)
                 logging.info("sharded checkpoint %s committed (step %d, "
                              "%d keys over %d processes)", base, step,
                              len(key_owner), nproc)
@@ -378,19 +398,41 @@ class ShardedSaver:
         deadline = time.monotonic() + self.barrier_timeout
         key_owner: Dict[str, int] = {}
         pending = set(range(nproc))
+        laggard: Dict[int, str] = {}  # pid -> why its commit is incomplete
         while pending:
             for q in sorted(pending):
                 path = "%s.shard-p%d.index.json" % (base, q)
+                npz_path = "%s.shard-p%d.npz" % (base, q)
                 try:
                     with open(path) as f:
                         idx = json.load(f)
-                    with np.load("%s.shard-p%d.npz" % (base, q)) as zf:
+                except FileNotFoundError:
+                    laggard[q] = "index file %s not written" % (
+                        os.path.basename(path))
+                    continue
+                except json.JSONDecodeError as e:
+                    laggard[q] = "index file %s unreadable (%s)" % (
+                        os.path.basename(path), e)
+                    continue
+                try:
+                    with np.load(npz_path) as zf:
                         npz_nonce = bytes(zf["__nonce__"]).decode()
-                except (FileNotFoundError, json.JSONDecodeError, KeyError,
-                        zipfile.BadZipFile):
+                except FileNotFoundError:
+                    laggard[q] = "shard file %s not written" % (
+                        os.path.basename(npz_path))
+                    continue
+                except (KeyError, zipfile.BadZipFile, OSError) as e:
+                    laggard[q] = "shard file %s unreadable (%s)" % (
+                        os.path.basename(npz_path), e)
                     continue
                 if idx.get("nonce") != npz_nonce:
-                    continue  # torn pair from overlapping attempts
+                    # torn pair from overlapping attempts
+                    laggard[q] = ("index %s does not pair with %s (nonce "
+                                  "mismatch — stale file from a crashed "
+                                  "earlier attempt at this step)"
+                                  % (os.path.basename(path),
+                                     os.path.basename(npz_path)))
+                    continue
                 for k in idx["keys"]:
                     prev = key_owner.setdefault(k, q)
                     if prev != q:
@@ -400,14 +442,18 @@ class ShardedSaver:
                             "was violated (mismatched mesh layouts between "
                             "processes?)" % (k, prev, q))
                 pending.discard(q)
+                laggard.pop(q, None)
             if pending:
                 if time.monotonic() > deadline:
+                    detail = "; ".join(
+                        "p%d: %s" % (q, laggard.get(q, "no index file"))
+                        for q in sorted(pending))
                     raise TimeoutError(
-                        "sharded checkpoint commit: processes %s never "
-                        "wrote their index files under %s within %.0fs — "
-                        "is the checkpoint directory shared across hosts?"
-                        % (sorted(pending), self.directory,
-                           self.barrier_timeout))
+                        "sharded checkpoint commit: %d of %d processes "
+                        "never wrote a valid index under %s within %.0fs "
+                        "[%s] — is the checkpoint directory shared across "
+                        "hosts?" % (len(pending), nproc, self.directory,
+                                    self.barrier_timeout, detail))
                 time.sleep(0.05)
         return key_owner
 
@@ -436,19 +482,44 @@ class ShardedSaver:
                         tel.counter_add("ckpt.gc_removed")
                     except FileNotFoundError:
                         pass
+        # failed-attempt debris: shard/index/tmp files of attempts that
+        # never committed, at steps below the newest commit — a resumed
+        # run restarts past them, so they can only ever be dead weight
+        victims, _ = integrity.gc_candidates(self.directory, "sharded")
+        for f in victims:
+            try:
+                os.remove(os.path.join(self.directory, f))
+                tel.counter_add("ckpt.gc_orphans")
+            except FileNotFoundError:
+                pass
+        if victims:
+            logging.info("sharded checkpoint gc: removed %d failed-attempt "
+                         "files (%s)", len(victims), ", ".join(victims[:6]))
 
     def latest(self) -> Optional[str]:
+        """Base path of the newest COMMITTED sharded checkpoint — fast
+        validation (``integrity.validate_sharded``) skips torn attempts
+        and structurally damaged steps, with a logged reason."""
         self.wait()
-        metas = self._own_metas()
-        if not metas:
-            return None
-        return os.path.join(self.directory,
-                            metas[-1][1].replace(".shard-meta.json", ""))
+        for status in integrity.committed_newest_first(self.directory,
+                                                       "sharded"):
+            if status.committed:
+                return status.base
+            logging.warning("sharded checkpoint step %d is %s, skipping: "
+                            "%s", status.step, status.state,
+                            "; ".join(status.problems[:3]))
+        return None
 
     # --------------------------------------------------------------- restore
 
     class _ShardReader:
-        """Lazy per-process npz handles + key->pid routing."""
+        """Lazy per-process npz handles + key->pid routing. Damage that
+        surfaces at read time — a vanished shard file, a zip CRC mismatch
+        on an entry (zipfile verifies every member against its stored
+        CRC-32 as it streams) — raises :class:`CheckpointDamaged`, which
+        the restore fallback loop catches to try the next-older
+        checkpoint; anything else (a missing key = strategy mismatch)
+        stays loud."""
 
         def __init__(self, base: str, meta: dict):
             self._base = base
@@ -459,11 +530,18 @@ class ShardedSaver:
             pid = self._keys.get(key)
             if pid is None:
                 raise KeyError("checkpoint is missing key %r" % key)
-            zf = self._files.get(pid)
-            if zf is None:
-                zf = np.load("%s.shard-p%d.npz" % (self._base, pid))
-                self._files[pid] = zf
-            return zf[key]
+            path = "%s.shard-p%d.npz" % (self._base, pid)
+            try:
+                zf = self._files.get(pid)
+                if zf is None:
+                    zf = np.load(path)
+                    self._files[pid] = zf
+                return zf[key]
+            except (zipfile.BadZipFile, OSError, ValueError) as e:
+                tel.counter_add("ckpt.corrupt_shards")
+                raise CheckpointDamaged(
+                    "shard file %s is damaged (reading key %r: %s)"
+                    % (os.path.basename(path), key, e)) from e
 
         def close(self):
             for zf in self._files.values():
@@ -665,12 +743,60 @@ class ShardedSaver:
         reassembled from the overlapping saved slices — no process ever
         materializes a full leaf set in either direction (the reference's
         topology-independent ``SaveSliceInfo`` restore, reference
-        ``autodist/kernel/partitioner.py:292-347``)."""
+        ``autodist/kernel/partitioner.py:292-347``).
+
+        **Last-good fallback**: with no explicit ``path``, checkpoints are
+        tried newest-first; torn save attempts and checkpoints that fail
+        validation (or whose damage only surfaces while reading) are
+        skipped with a logged reason (counted in ``ckpt.fallback`` /
+        ``ckpt.corrupt_shards``), and the call hard-fails only when NO
+        valid checkpoint exists. An explicit ``path`` is validated and
+        refused (``CheckpointDamaged``) when torn/corrupt — restore never
+        loads a damaged checkpoint either way. Read-time fallback is
+        single-process only: in a multi-process job a divergent per-process
+        fallback choice would desynchronize the restore collectives, so
+        read-time damage raises instead."""
         self.wait()
-        path = path or self.latest()
-        if path is None:
-            raise FileNotFoundError("no sharded checkpoint in %s"
-                                    % self.directory)
+        if path is not None:
+            # validate where the path POINTS — it need not live in this
+            # saver's directory (restoring someone else's export)
+            status = integrity.validate_sharded(*integrity.parse_base(path))
+            if not status.committed:
+                tel.counter_add("ckpt.corrupt_shards", len(status.damaged))
+                raise CheckpointDamaged(
+                    "sharded checkpoint %s is %s: %s" % (
+                        path, status.state, "; ".join(status.problems[:5])))
+            return self._restore_at(runner, path)
+        tried = 0
+        for status in integrity.committed_newest_first(self.directory,
+                                                       "sharded"):
+            if not status.committed:
+                logging.warning(
+                    "sharded restore: skipping step %d (%s): %s",
+                    status.step, status.state,
+                    "; ".join(status.problems[:3]))
+                tel.counter_add("ckpt.fallback")
+                tel.counter_add("ckpt.corrupt_shards", len(status.damaged))
+                continue
+            tried += 1
+            try:
+                return self._restore_at(runner, status.base)
+            except CheckpointDamaged as e:
+                if jax.process_count() > 1:
+                    # each process reads different slices: falling back
+                    # independently would desynchronize the restore
+                    raise
+                logging.warning(
+                    "sharded restore: step %d damaged mid-read (%s); "
+                    "falling back to the previous checkpoint",
+                    status.step, e)
+                tel.counter_add("ckpt.fallback")
+        raise FileNotFoundError(
+            "no valid sharded checkpoint in %s (%d committed candidate(s) "
+            "tried)" % (self.directory, tried))
+
+    def _restore_at(self, runner, path: str) -> Tuple[Any, int]:
+        """Restore from one specific, already-validated checkpoint base."""
         dstep = runner.distributed_step
         meta = self._read_meta(path)
         suffix = self._mesh_suffix(dstep)
@@ -749,6 +875,7 @@ class ShardedSaver:
             step=dstep._put(np.asarray(step, np.int32), P()),
             params=params, opt_state=opt_state, sync_state=sync_state)
         runner.state = state
+        tel.counter_add("ckpt.restores")
         logging.info("restored sharded checkpoint %s (step %d, local slices "
                      "only)", path, step)
         return state, step

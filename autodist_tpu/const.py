@@ -129,6 +129,10 @@ class ENV(Enum):
     # declarative fault plan for the FaultyProxy harness
     # (runtime/faultinject.py): JSON, or @/path/to/plan.json
     ADT_FAULT_PLAN = ("ADT_FAULT_PLAN", str, "")
+    # declarative checkpoint-lifecycle fault plan (kill-at-phase SIGKILLs,
+    # post-commit file damage) executed by the savers' fault hooks
+    # (runtime/faultinject.py CheckpointFaultPlan): JSON, or @/path/plan.json
+    ADT_CKPT_FAULT_PLAN = ("ADT_CKPT_FAULT_PLAN", str, "")
     # host-PS transfer/compute overlap (parallel/ps.py PSPipeline): 1 =
     # background push + prefetched pull (bit-exact for sync PS; with
     # staleness>=1 or async serving the prefetch overlaps compute fully);

@@ -202,30 +202,52 @@ class Runner:
             # probe BOTH checkpoint formats — a sync-elastic job that
             # checkpoints through ShardedSaver (the scale path) must
             # auto-resume from its shard files, not fail fast because no
-            # plain-format meta exists; when both exist, the newer step wins
+            # plain-format meta exists; when both exist, the newer step
+            # wins. latest_checkpoint runs the fast integrity validation,
+            # so torn save attempts (a crash mid-save is exactly when
+            # auto-resume runs) and damaged steps are skipped up front,
+            # and restore() falls back further if damage only surfaces
+            # while reading (ckpt.fallback counts every skip).
             from autodist_tpu.checkpoint import latest_checkpoint
             _, saver = latest_checkpoint(const.ENV.ADT_CKPT_DIR.val)
             if saver is not None:
                 # restore() builds the placed state itself — a fresh
                 # init_state first would materialize the whole tree on
                 # device just to throw it away
-                _, step = saver.restore(self)
-                logging.warning("ADT_AUTO_RESUME: restored step %d from %s "
-                                "(%s)", step, const.ENV.ADT_CKPT_DIR.val,
-                                type(saver).__name__)
-                return self.state
-            if const.ENV.ADT_NUM_PROCESSES.val > 1:
+                try:
+                    _, step = saver.restore(self)
+                except FileNotFoundError as e:
+                    # every candidate was skipped as torn/corrupt
+                    if const.ENV.ADT_NUM_PROCESSES.val > 1:
+                        raise RuntimeError(
+                            "ADT_AUTO_RESUME: no valid checkpoint to "
+                            "resume from (%s) — peers restoring different "
+                            "steps would diverge, refusing to start "
+                            "fresh" % e) from e
+                    logging.warning("ADT_AUTO_RESUME: %s; starting fresh",
+                                    e)
+                else:
+                    logging.warning("ADT_AUTO_RESUME: restored step %d "
+                                    "from %s (%s)", step,
+                                    const.ENV.ADT_CKPT_DIR.val,
+                                    type(saver).__name__)
+                    return self.state
+            elif const.ENV.ADT_NUM_PROCESSES.val > 1:
                 # one process starting fresh while lockstep peers restore
                 # step N diverges every collective — fail loudly (usual
                 # cause: the checkpoint dir is not shared across hosts)
                 raise RuntimeError(
-                    "ADT_AUTO_RESUME is set but no checkpoint exists in "
-                    "%s on this process — a multi-process resume needs "
-                    "the checkpoint directory shared across hosts"
-                    % const.ENV.ADT_CKPT_DIR.val)
-            logging.warning("ADT_AUTO_RESUME set but no checkpoint in "
-                            "%s; starting fresh",
-                            const.ENV.ADT_CKPT_DIR.val)
+                    "ADT_AUTO_RESUME is set but no valid committed "
+                    "checkpoint exists in %s on this process — a "
+                    "multi-process resume needs the checkpoint directory "
+                    "shared across hosts (run `python -m "
+                    "autodist_tpu.checkpoint ls --dir %s` to inspect)"
+                    % (const.ENV.ADT_CKPT_DIR.val,
+                       const.ENV.ADT_CKPT_DIR.val))
+            else:
+                logging.warning("ADT_AUTO_RESUME set but no valid "
+                                "checkpoint in %s; starting fresh",
+                                const.ENV.ADT_CKPT_DIR.val)
         self.state = self._dstep.init_state(params, opt_state)
         return self.state
 

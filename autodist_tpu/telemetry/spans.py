@@ -128,6 +128,8 @@ DEFAULT_COUNTERS = (
     "prefetch.batches", "prefetch.dropped_batches",
     "prefetch.dropped_examples",
     "ckpt.saves", "ckpt.barrier_s", "ckpt.gc_removed",
+    "ckpt.restores", "ckpt.fallback", "ckpt.corrupt_shards",
+    "ckpt.gc_orphans",
     "search.candidates", "search.pruned",
     "serve.requests", "serve.batches", "serve.compiles",
     "serve.padded_rows", "serve.degraded", "serve.shed",

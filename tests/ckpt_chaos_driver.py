@@ -1,0 +1,82 @@
+"""Driver for the checkpoint crash-resume chaos test (test_ckpt_chaos.py).
+
+One incarnation of a training job: build on the mesh described by the
+resource spec, auto-resume from ``ADT_CKPT_DIR`` if ``ADT_AUTO_RESUME``
+is set (last *committed* checkpoint — torn/corrupt ones are skipped),
+train to ``steps`` saving a sharded checkpoint every 2 steps, and dump
+the per-step losses plus the ckpt.* telemetry counters.
+
+The parent arranges the violence: a ``ADT_CKPT_FAULT_PLAN`` kill rule
+SIGKILLs the first incarnation mid-save, file damage is injected on a
+committed checkpoint, and the second incarnation runs on a SMALLER mesh
+(8 -> 4 devices) — the cross-topology restore path under real crash
+debris.
+
+Usage: ckpt_chaos_driver.py <spec.yml> <out.json> <builder> <ckpt_dir> <steps>
+"""
+import json
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+import autodist_tpu as adt  # noqa: E402
+from autodist_tpu import strategy as S  # noqa: E402
+from autodist_tpu.checkpoint import ShardedSaver  # noqa: E402
+from autodist_tpu.telemetry import spans as tel  # noqa: E402
+
+BUILDERS = {
+    "PartitionedAR": lambda: S.PartitionedAR(),
+    "PartitionedPS": lambda: S.PartitionedPS(),
+}
+
+
+def make_case(seed=7):
+    """Split dim 18 is not divisible by 8 or 4, so every mesh size pads
+    differently — the resume-on-a-smaller-mesh restore must re-pad, not
+    just re-slice (same construction as the in-process flex tests)."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(seed)
+    params = {"emb": jnp.asarray(rng.randn(18, 4).astype(np.float32)),
+              "w": jnp.asarray(rng.randn(4, 2).astype(np.float32))}
+
+    def loss_fn(p, batch):
+        feat = jnp.take(p["emb"], batch["ids"], axis=0)
+        return jnp.mean((feat @ p["w"] - batch["y"]) ** 2)
+
+    batch = {"ids": rng.randint(0, 18, (16,)).astype(np.int32),
+             "y": rng.randn(16, 2).astype(np.float32)}
+    return params, loss_fn, batch
+
+
+def main():
+    spec_yaml, out_path, builder_name, ckpt_dir, steps = sys.argv[1:6]
+    steps = int(steps)
+    ad = adt.AutoDist(resource_spec_file=spec_yaml,
+                      strategy_builder=BUILDERS[builder_name]())
+    params, loss_fn, batch = make_case()
+    runner = ad.build(loss_fn, optax.adam(1e-2), params, batch)
+    runner.init(params)  # ADT_AUTO_RESUME restores the last-good here
+    start = int(np.asarray(jax.device_get(runner.state.step)))
+    saver = ShardedSaver(directory=ckpt_dir)
+    losses = {}
+    for i in range(start + 1, steps + 1):
+        losses[i] = float(runner.run(batch)["loss"])
+        if i % 2 == 0:
+            saver.save(runner)  # the fault plan may SIGKILL us in here
+    counters = {k: v for k, v in tel.counters().items()
+                if k.startswith("ckpt.")}
+    with open(out_path, "w") as f:
+        json.dump({"start": start, "losses": losses,
+                   "device_count": jax.device_count(),
+                   "counters": counters}, f)
+    print("ckpt_chaos_driver done: start=%d devices=%d"
+          % (start, jax.device_count()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

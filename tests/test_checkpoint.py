@@ -470,8 +470,14 @@ def test_sharded_commit_rejects_stale_index(tmp_path):
         json.dump({"pid": 1, "nonce": "nonce-B",
                    "keys": ["P|w|0:4,0:2"]}, f)
     saver = ShardedSaver(directory=str(tmp_path), barrier_timeout=0.5)
-    with pytest.raises(TimeoutError, match="never wrote their index"):
+    # the timeout NAMES the laggards: which pid is missing its index file
+    # outright, and which has a stale (nonce-mismatched) pairing
+    with pytest.raises(TimeoutError) as ei:
         saver._await_indexes(base, 2)
+    msg = str(ei.value)
+    assert "never wrote a valid index" in msg
+    assert "p0: index file ckpt-7.shard-p0.index.json not written" in msg
+    assert "p1: index" in msg and "nonce mismatch" in msg
     # repair the index with the matching nonce: commit proceeds
     with open(base + ".shard-p1.index.json", "w") as f:
         json.dump({"pid": 1, "nonce": "nonce-A",
@@ -646,3 +652,376 @@ def test_flex_ps_provider_copies_shape_coincident_leaves(tmp_path):
     np.testing.assert_array_equal(opt["0/mu/v"], np.zeros((4, 8)))
     # ...the coincidence leaf is copied whole (a slice would read (4,))
     np.testing.assert_array_equal(opt["0/colstats/v"], colstats)
+
+
+# ----------------------------------------------- durability & last-good
+
+
+def _counters():
+    from autodist_tpu.telemetry import spans as tel
+    return tel.counters()
+
+
+def test_saver_atomic_write_checksums_and_latency_hist(tmp_path):
+    """Plain saves go through tmp + os.replace (no .tmp survivors, no
+    torn finals), the meta records per-file crc32+bytes that deep fsck
+    verifies, and the save-latency histogram observes the write."""
+    import os
+    from autodist_tpu.checkpoint import integrity
+    from autodist_tpu.telemetry import spans as tel
+    params, loss_fn, batch = _problem()
+    ad = autodist_tpu.AutoDist(strategy_builder=S.PartitionedAR())
+    runner = ad.build(loss_fn, optax.adam(0.05), params, batch)
+    runner.init(params)
+    runner.run(batch)
+    saver = Saver(directory=str(tmp_path))
+    path = saver.save(runner)
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    import json
+    meta = json.load(open(path + ".meta.json"))
+    assert set(meta["files"]) == {"ckpt-1.params.npz", "ckpt-1.opt.npz"}
+    for fname, digest in meta["files"].items():
+        assert digest["bytes"] == os.path.getsize(tmp_path / fname)
+    status = integrity.validate_plain(str(tmp_path), 1, deep=True)
+    assert status.committed and not status.problems, status.to_dict()
+    hist = tel.histograms().get("ckpt.save_ms")
+    assert hist is not None and hist["count"] >= 1
+
+
+def test_plain_restore_falls_back_past_torn_and_corrupt(tmp_path):
+    """Newest checkpoint truncated (torn write on a non-atomic fs),
+    next-newest missing its meta (crash pre-commit): restore lands on the
+    last GOOD one, counts the fallbacks, and an explicit path to the
+    damaged one is refused."""
+    import os
+    from autodist_tpu.checkpoint import CheckpointDamaged
+    from autodist_tpu.runtime.faultinject import truncate_file
+    params, loss_fn, batch = _problem()
+    ad = autodist_tpu.AutoDist(strategy_builder=S.PartitionedAR())
+    runner = ad.build(loss_fn, optax.adam(0.05), params, batch)
+    runner.init(params)
+    saver = Saver(directory=str(tmp_path))
+    for _ in range(3):
+        runner.run(batch)
+        saver.save(runner)
+    truncate_file(str(tmp_path / "ckpt-3.params.npz"), 100)
+    os.remove(tmp_path / "ckpt-2.meta.json")
+    c0 = _counters()
+    state, step = saver.restore(runner)
+    c1 = _counters()
+    assert step == 1
+    assert c1["ckpt.fallback"] - c0["ckpt.fallback"] >= 2
+    assert c1["ckpt.corrupt_shards"] > c0["ckpt.corrupt_shards"]
+    with pytest.raises(CheckpointDamaged, match="corrupt"):
+        saver.restore(runner, str(tmp_path / "ckpt-3"))
+    # latest() agrees: the damaged/torn steps are not "the latest"
+    assert saver.latest().endswith("ckpt-1")
+
+
+def test_restore_explicit_path_outside_saver_directory(tmp_path):
+    """An explicit restore(path=...) is validated where the PATH lives,
+    not in the saver's own directory — a valid checkpoint from another
+    job's directory restores fine, a damaged one there is still refused,
+    and a non-checkpoint path gets a clear error."""
+    from autodist_tpu.checkpoint import CheckpointDamaged, ShardedSaver
+    from autodist_tpu.checkpoint import integrity
+    from autodist_tpu.runtime.faultinject import flip_bit
+    params, loss_fn, batch = _problem()
+    ad = autodist_tpu.AutoDist(strategy_builder=S.PartitionedAR())
+    runner = ad.build(loss_fn, optax.adam(0.05), params, batch)
+    runner.init(params)
+    runner.run(batch)
+    theirs = tmp_path / "their-job"
+    theirs.mkdir()
+    for saver_cls in (Saver, ShardedSaver):
+        src = saver_cls(directory=str(theirs / saver_cls.__name__))
+        path = src.save(runner)
+        mine = saver_cls(directory=str(tmp_path / "mine"))
+        _, step = mine.restore(runner, path=path)  # validated at `path`
+        assert step == 1
+    # damage the foreign sharded checkpoint (mid-file: entry data):
+    # still refused via the path
+    flip_bit(str(theirs / "ShardedSaver" / "ckpt-1.shard-p0.npz"))
+    with pytest.raises(CheckpointDamaged):
+        ShardedSaver(directory=str(tmp_path / "mine")).restore(
+            runner, path=str(theirs / "ShardedSaver" / "ckpt-1"))
+    with pytest.raises(ValueError, match="ckpt-<step>"):
+        integrity.parse_base(str(tmp_path / "not-a-checkpoint"))
+    assert integrity.parse_base("ckpt-7") == (".", 7)
+
+
+def test_sharded_restore_falls_back_on_truncated_shard(tmp_path):
+    """Truncated shard npz in the newest sharded checkpoint: fast
+    validation classifies it corrupt, restore falls back to the previous
+    committed step, and an explicit path is refused."""
+    from autodist_tpu.checkpoint import CheckpointDamaged, ShardedSaver
+    from autodist_tpu.checkpoint import integrity
+    from autodist_tpu.runtime.faultinject import truncate_file
+    params, loss_fn, batch = _problem()
+    ad = autodist_tpu.AutoDist(strategy_builder=S.PartitionedAR())
+    runner = ad.build(loss_fn, optax.adam(0.05), params, batch)
+    runner.init(params)
+    saver = ShardedSaver(directory=str(tmp_path))
+    for _ in range(2):
+        runner.run(batch)
+        saver.save(runner)
+    truncate_file(str(tmp_path / "ckpt-2.shard-p0.npz"), 200)
+    assert integrity.validate_sharded(str(tmp_path), 2).state == "corrupt"
+    c0 = _counters()
+    state, step = saver.restore(runner)
+    assert step == 1
+    assert _counters()["ckpt.fallback"] - c0["ckpt.fallback"] >= 1
+    with pytest.raises(CheckpointDamaged, match="corrupt"):
+        saver.restore(runner, str(tmp_path / "ckpt-2"))
+    assert saver.latest().endswith("ckpt-1")
+
+
+def test_sharded_restore_falls_back_on_bitflip(tmp_path):
+    """A single flipped bit in a committed shard file — invisible to
+    structural checks — surfaces as a CRC failure while reading and the
+    restore falls back to the previous committed checkpoint instead of
+    loading silently-corrupted state."""
+    from autodist_tpu.checkpoint import ShardedSaver
+    from autodist_tpu.checkpoint import integrity
+    from autodist_tpu.runtime.faultinject import flip_bit
+    params, loss_fn, batch = _problem()
+    ad = autodist_tpu.AutoDist(strategy_builder=S.PartitionedAR())
+    runner = ad.build(loss_fn, optax.adam(0.05), params, batch)
+    runner.init(params)
+    saver = ShardedSaver(directory=str(tmp_path))
+    for _ in range(2):
+        runner.run(batch)
+        saver.save(runner)
+    flip_bit(str(tmp_path / "ckpt-2.shard-p0.npz"), -5000)
+    # deep fsck provably finds the damage even when fast checks pass
+    deep = integrity.validate_sharded(str(tmp_path), 2, deep=True)
+    assert deep.state == "corrupt", deep.to_dict()
+    c0 = _counters()
+    state, step = saver.restore(runner)
+    assert step == 1
+    c1 = _counters()
+    assert c1["ckpt.fallback"] - c0["ckpt.fallback"] >= 1
+    assert c1["ckpt.corrupt_shards"] - c0["ckpt.corrupt_shards"] >= 1
+
+
+def test_gc_removes_failed_attempts(tmp_path):
+    """Failed-attempt debris (meta-less shard files, .tmp leftovers) at
+    steps below the newest commit is GC'd on the next successful save."""
+    import os
+    from autodist_tpu.checkpoint import ShardedSaver
+    from autodist_tpu.checkpoint.sharded import _StreamingNpzWriter
+    params, loss_fn, batch = _problem()
+    ad = autodist_tpu.AutoDist(strategy_builder=S.PartitionedAR())
+    runner = ad.build(loss_fn, optax.adam(0.05), params, batch)
+    runner.init(params)
+    saver = ShardedSaver(directory=str(tmp_path))
+    runner.run(batch)
+    saver.save(runner)  # committed step 1
+    # debris: a torn attempt at step 0 and a .tmp under committed step 1
+    w = _StreamingNpzWriter(str(tmp_path / "ckpt-0.shard-p0.npz"))
+    w.write("__nonce__", np.frombuffer(b"x", np.uint8))
+    w.close()
+    (tmp_path / "ckpt-1.shard-p0.npz.tmp").write_bytes(b"partial")
+    c0 = _counters()
+    runner.run(batch)
+    saver.save(runner)  # committed step 2 -> gc sweeps the debris
+    assert not os.path.exists(tmp_path / "ckpt-0.shard-p0.npz")
+    assert not os.path.exists(tmp_path / "ckpt-1.shard-p0.npz.tmp")
+    assert _counters()["ckpt.gc_orphans"] - c0["ckpt.gc_orphans"] >= 2
+    # the committed checkpoints survived
+    state, step = saver.restore(runner)
+    assert step == 2
+
+
+def test_checkpoint_cli_ls_fsck_gc(tmp_path, capsys):
+    """The lifecycle CLI end to end: ls shows validity states, fsck
+    exits 1 exactly when a committed checkpoint is damaged, gc --orphans
+    clears failed attempts."""
+    import json
+    import os
+    from autodist_tpu.checkpoint import ShardedSaver
+    from autodist_tpu.checkpoint.cli import main
+    from autodist_tpu.checkpoint.sharded import _StreamingNpzWriter
+    from autodist_tpu.runtime.faultinject import flip_bit
+    params, loss_fn, batch = _problem()
+    ad = autodist_tpu.AutoDist(strategy_builder=S.PartitionedAR())
+    runner = ad.build(loss_fn, optax.adam(0.05), params, batch)
+    runner.init(params)
+    saver = ShardedSaver(directory=str(tmp_path))
+    for _ in range(2):
+        runner.run(batch)
+        saver.save(runner)
+    # a torn attempt newer than every commit (crash mid-save of step 9)
+    w = _StreamingNpzWriter(str(tmp_path / "ckpt-9.shard-p0.npz"))
+    w.write("__nonce__", np.frombuffer(b"x", np.uint8))
+    w.close()
+
+    assert main(["--dir", str(tmp_path), "ls", "--json"]) == 0
+    rows = {r["step"]: r for r in json.loads(capsys.readouterr().out)}
+    assert rows[1]["state"] == "committed"
+    assert rows[2]["state"] == "committed"
+    assert rows[9]["state"] == "torn"
+
+    # clean directory (modulo the torn attempt): fsck passes...
+    assert main(["--dir", str(tmp_path), "fsck"]) == 0
+    # ...but --strict flags the torn attempt
+    assert main(["--dir", str(tmp_path), "fsck", "--strict"]) == 1
+    capsys.readouterr()
+
+    # damage a committed checkpoint: fsck exits 1
+    flip_bit(str(tmp_path / "ckpt-2.shard-p0.npz"), -5000)
+    assert main(["--dir", str(tmp_path), "fsck"]) == 1
+    out = capsys.readouterr().out
+    assert "corrupt" in out
+
+    # gc --orphans clears the torn attempt, keeps committed files
+    assert main(["--dir", str(tmp_path), "gc", "--orphans"]) == 0
+    capsys.readouterr()
+    assert not os.path.exists(tmp_path / "ckpt-9.shard-p0.npz")
+    assert os.path.exists(tmp_path / "ckpt-1.shard-meta.json")
+    # gc --keep 1 drops the (damaged) step-2? No: --keep counts committed
+    # checkpoints; step 2 is corrupt so step 1 is retained as the newest
+    # committed. Bad usage is a usage error.
+    assert main(["--dir", str(tmp_path), "gc"]) == 2
+
+    # gc --damaged is the follow-up to the failing fsck: the corrupt
+    # step-2 files go, the committed step-1 stays, and fsck passes again
+    assert main(["--dir", str(tmp_path), "gc", "--damaged"]) == 0
+    capsys.readouterr()
+    assert not os.path.exists(tmp_path / "ckpt-2.shard-p0.npz")
+    assert not os.path.exists(tmp_path / "ckpt-2.shard-meta.json")
+    assert os.path.exists(tmp_path / "ckpt-1.shard-meta.json")
+    assert main(["--dir", str(tmp_path), "fsck", "--strict"]) == 0
+    capsys.readouterr()
+
+
+def test_ckpt_fault_plan_kills_and_damage(tmp_path, monkeypatch):
+    """CheckpointFaultPlan mechanics without a real SIGKILL: nth-phase
+    kill matching, and file damage ops (truncate/bitflip) applied to
+    matching targets."""
+    import json
+    from autodist_tpu.runtime import faultinject as fi
+
+    kills = []
+    monkeypatch.setattr(fi, "_kill_self", lambda: kills.append(True))
+    plan = fi.CheckpointFaultPlan({
+        "kills": [{"phase": "meta", "nth": 2}],
+        "damage": [{"op": "truncate", "phase": "committed",
+                    "file": "shard-p0.npz", "bytes": 10}],
+    })
+    target = tmp_path / "ckpt-4.shard-p0.npz"
+    target.write_bytes(b"A" * 100)
+    plan.fire("write", path=str(target))     # no rule for this phase
+    plan.fire("meta")                        # nth=1 < 2: armed, no fire
+    assert not kills
+    plan.fire("meta")                        # nth=2: fires
+    assert kills == [True]
+    plan.fire("committed", path=str(tmp_path / "ckpt-4"))  # base expansion
+    assert target.stat().st_size == 10
+    assert plan.injected == ["kill:meta", "truncate:ckpt-4.shard-p0.npz"]
+
+    # the env-driven hook: parsed once, re-parsed when the value changes
+    plan_file = tmp_path / "plan.json"
+    plan_file.write_text(json.dumps(
+        {"damage": [{"op": "bitflip", "phase": "committed",
+                     "file": "ckpt-4.shard-p0.npz", "offset": 0}]}))
+    monkeypatch.setenv("ADT_CKPT_FAULT_PLAN", "@%s" % plan_file)
+    before = target.read_bytes()
+    fi.checkpoint_fault("committed", path=str(target))
+    after = target.read_bytes()
+    assert before[0] ^ after[0] == 0x01 and before[1:] == after[1:]
+
+    # probabilistic rules roll against the plan-level seeded rng: prob=0
+    # never fires (and stays armed — not silently consumed), prob=1 always
+    plan = fi.CheckpointFaultPlan({
+        "seed": 7,
+        "damage": [{"op": "truncate", "phase": "committed",
+                    "file": "shard-p0.npz", "prob": 0.0, "bytes": 1},
+                   {"op": "bitflip", "phase": "committed",
+                    "file": "shard-p0.npz", "prob": 1.0, "offset": 0}]})
+    for _ in range(5):
+        plan.fire("committed", path=str(target))
+    assert target.stat().st_size == 10          # prob=0 never truncated
+    assert len(plan.injected) == 1              # prob=1 fired exactly once
+    assert not plan.rules[0]._spent             # still armed
+
+
+def test_validation_and_read_error_hardening(tmp_path):
+    """Three review-hardened edges: a legacy (no recorded file list) meta
+    whose params file is gone is CORRUPT, not committed; a read-path
+    failure surfaces as CheckpointDamaged (never a FileNotFoundError that
+    Runner.init would misread as start-fresh); committed_newest_first is
+    lazy — consuming only the newest entry validates only that step."""
+    import json
+    from autodist_tpu.checkpoint import integrity
+    from autodist_tpu.checkpoint.saver import _read_npz
+
+    # legacy meta, params npz missing -> corrupt (restore must not pick it)
+    (tmp_path / "ckpt-3.meta.json").write_text(json.dumps({"step": 3}))
+    (tmp_path / "ckpt-3.opt.npz").write_bytes(b"not-a-zip")
+    status = integrity.validate_plain(str(tmp_path), 3)
+    assert status.state == integrity.CORRUPT
+    assert any("params.npz missing" in p for p in status.problems)
+
+    with pytest.raises(integrity.CheckpointDamaged, match="unreadable"):
+        _read_npz(str(tmp_path / "ckpt-3.params.npz"))  # vanished file
+    with pytest.raises(integrity.CheckpointDamaged, match="unreadable"):
+        _read_npz(str(tmp_path / "ckpt-3.opt.npz"))     # torn bytes
+
+    gen = integrity.committed_newest_first(str(tmp_path), "plain")
+    assert next(gen).step == 3  # lazy: a generator, newest first
+    assert next(gen, None) is None
+
+
+def test_parallax_host_ps_cross_topology_restore(tmp_path):
+    """Satellite: host-PS strategies across topologies. Parallax routes
+    the sparse embedding to the host-PS store and the dense var to
+    compressed AllReduce — an 8->4 restore must re-slice the PS shards,
+    restore params bit-exact, and reset the per-device compressor state
+    to fresh init (the documented topology-bound-residuals rule), then
+    keep training; 4->8 scales back up."""
+    from autodist_tpu.checkpoint import ShardedSaver
+    make = lambda: S.Parallax(compressor="HorovodCompressorEF")  # noqa: E731
+    params, loss_fn, batch = _problem()
+    ad8 = autodist_tpu.AutoDist(strategy_builder=make())
+    runner8 = ad8.build(loss_fn, optax.adam(0.05), params, batch)
+    assert runner8.distributed_step.ps_store is not None
+    runner8.init(params)
+    for _ in range(3):
+        runner8.run(batch)
+    want = {k: np.asarray(v) for k, v in runner8.gather_params().items()}
+    saver = ShardedSaver(directory=str(tmp_path))
+    base = saver.save(runner8)
+    flat = np.load(base + ".shard-p0.npz")
+    assert any(k.startswith("H|emb") for k in flat.files)  # PS rode along
+    assert any(k.startswith("S|") for k in flat.files)     # EF residuals
+
+    autodist_tpu.reset()
+    ad4 = autodist_tpu.AutoDist(resource_spec=_cpu_spec(4),
+                                strategy_builder=make())
+    runner4 = ad4.build(loss_fn, optax.adam(0.05), params, batch)
+    runner4.init(params)
+    _, step = saver.restore(runner4)
+    assert step == 3
+    got = {k: np.asarray(v) for k, v in runner4.gather_params().items()}
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+    # per-device compressor state reset to fresh init on the new mesh
+    fresh = runner4.distributed_step._sync_state_init()
+    restored = runner4.distributed_step.gather_sync_state(runner4.state)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        fresh, restored)
+    assert np.isfinite(runner4.run(batch)["loss"])
+    saver2 = ShardedSaver(directory=str(tmp_path / "up"))
+    saver2.save(runner4)
+
+    autodist_tpu.reset()
+    ad8b = autodist_tpu.AutoDist(strategy_builder=make())
+    runner8b = ad8b.build(loss_fn, optax.adam(0.05), params, batch)
+    runner8b.init(params)
+    _, step = saver2.restore(runner8b)
+    assert step == 4
+    assert np.isfinite(runner8b.run(batch)["loss"])
+    autodist_tpu.reset()
