@@ -43,10 +43,13 @@ if const.ENV.ADT_PATCH_OPTAX.val:
 from autodist_tpu.autodist import AutoDist, get_default_autodist, reset  # noqa: E402
 from autodist_tpu.model_item import ModelItem  # noqa: E402
 from autodist_tpu.resource_spec import ResourceSpec  # noqa: E402
+from autodist_tpu.runtime.sentinel import (SentinelPolicy,  # noqa: E402
+                                           TrainingDiverged)
 from autodist_tpu.train_state import TrainState  # noqa: E402
 from autodist_tpu import strategy  # noqa: E402
 
 ENV = const.ENV
 
 __all__ = ["AutoDist", "ModelItem", "ResourceSpec", "TrainState", "strategy",
+           "SentinelPolicy", "TrainingDiverged",
            "ENV", "get_default_autodist", "reset", "__version__"]

@@ -186,6 +186,9 @@ CODES = {
     "ADT406": "lowered program transfers to host on the hot path",
     "ADT407": "collective under divergent control flow",
     "ADT408": "host transfer inside a while/scan body (per-iteration cost)",
+    "ADT420": "sentinel requested but the program lowered without health "
+              "guards",
+    "ADT421": "PS apply window larger than the sentinel skip window",
     # ADT5xx — memory footprint & collective schedule (analysis/hlo.py,
     # analysis/memory.py)
     "ADT501": "projected per-device OOM: peak HBM exceeds the budget",

@@ -611,6 +611,47 @@ def _r_ps_load_balance(ctx: Context) -> Iterable[Diagnostic]:
                       "variables")
 
 
+def verify_sentinel(policy, metadata: dict) -> List[Diagnostic]:
+    """ADT42x — health-sentinel configuration hazards, checked against a
+    LOWERED program's metadata (``DistributedStep.metadata``); the Runner
+    runs this whenever a policy is armed (docs/sentinel.md).
+
+    - ``ADT420``: the policy is active but the program carries no
+      in-graph guards (step_fn capture mode) — NaN/Inf detection and the
+      in-graph skip are unavailable; the sentinel degrades to host-side
+      loss monitoring, which can only roll back, never skip.
+    - ``ADT421``: a stale/async PS apply window larger than the
+      sentinel's skip window — a peer's delayed push can land a poisoned
+      gradient AFTER the window that judged those steps closed, so a bad
+      update can slip past the skip budget's accounting.
+    """
+    out: List[Diagnostic] = []
+    if policy is None or not getattr(policy, "enabled", False):
+        return out
+    metadata = metadata or {}
+    if not metadata.get("sentinel_guards", False):
+        out.append(warning(
+            "ADT420",
+            "sentinel policy is active but the lowered program has no "
+            "in-graph health guards — gradient/param NaN detection and "
+            "the in-graph skip are unavailable (loss-only monitoring)",
+            fixit="build with loss_fn mode (AutoDist.build) so the "
+                  "guards compile into the step"))
+    window = int(metadata.get("staleness", 0) or 0)
+    if metadata.get("async"):
+        window = max(window, int(const.ENV.ADT_PS_MAX_LAG.val))
+    if window > int(policy.window_steps):
+        out.append(warning(
+            "ADT421",
+            "PS apply window (%d steps stale/async lag) exceeds the "
+            "sentinel skip window (%d steps) — a delayed poisoned push "
+            "can apply after its window's verdict accounting closed"
+            % (window, policy.window_steps),
+            fixit="raise SentinelPolicy.window_steps above the "
+                  "staleness/lag bound, or tighten the PS window"))
+    return out
+
+
 @rule
 def _r_staleness_topology(ctx: Context) -> Iterable[Diagnostic]:
     if ctx.spec is None or not ctx.spec.is_single_node():

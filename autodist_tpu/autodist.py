@@ -295,12 +295,19 @@ class AutoDist:
     def build(self, loss_fn: Callable, optimizer, params, example_batch,
               has_aux: bool = False, apply_fn: Optional[Callable] = None,
               trainable_filter: Optional[Callable] = None,
-              mp_rules=None, mp_meta=None) -> Runner:
+              mp_rules=None, mp_meta=None, sentinel=None) -> Runner:
         """Capture + compile + lower; returns a Runner (uninitialized).
         ``mp_rules`` (e.g. ``models.tp_lm.tp_rules()``) registers the
         model's model-parallel sharding map so AutoStrategy searches the
         TP/PP/EP space too; ``mp_meta`` carries the search hints
-        (pp_microbatches, pp_schedules, seq_parallel)."""
+        (pp_microbatches, pp_schedules, seq_parallel). ``sentinel``
+        arms the training health sentinel (``runtime/sentinel.py``):
+        ``None`` defers to ``ADT_SENTINEL``, ``True`` uses the default
+        :class:`~autodist_tpu.runtime.sentinel.SentinelPolicy`, a policy
+        instance is used as-is — health guards are then compiled INTO
+        the step program (docs/sentinel.md)."""
+        from autodist_tpu.runtime.sentinel import resolve_policy
+        policy = resolve_policy(sentinel)
         item = ModelItem(loss_fn=loss_fn, optimizer=optimizer, params=params,
                          example_batch=example_batch, has_aux=has_aux,
                          apply_fn=apply_fn,
@@ -379,15 +386,18 @@ class AutoDist:
         else:
             mesh = mesh_lib.mesh_from_strategy(compiled, self._resource_spec,
                                                backend=self._backend)
-        dstep = GraphTransformer(compiled, mesh, item).transform()
+        dstep = GraphTransformer(compiled, mesh, item,
+                                 sentinel=policy).transform()
         if is_async and dstep.ps_store is not None:
             self._wire_async_ps(dstep)
         self._runner = Runner(
             dstep, tracing=self._tracing,
-            hbm_budget_bytes=self._resource_spec.chip_hbm_bytes())
+            hbm_budget_bytes=self._resource_spec.chip_hbm_bytes(),
+            sentinel=policy if policy is not None else False)
         return self._runner
 
-    def build_step(self, step_fn: Callable, state, example_batch) -> Runner:
+    def build_step(self, step_fn: Callable, state, example_batch,
+                   sentinel=None) -> Runner:
         """Opaque-step capture mode: distribute a hand-written
         ``step_fn(state, batch) -> (new_state, metrics)`` by assigning
         strategy-derived shardings (state leaves get their layout's pspec,
@@ -395,7 +405,11 @@ class AutoDist:
         so AllReduce/Partitioned families only (host-PS and compressors
         need :meth:`build`'s loss_fn mode). ``state`` is the user's whole
         training state (params + optimizer state bundled however they
-        like); the framework never looks inside the step."""
+        like); the framework never looks inside the step. A ``sentinel``
+        policy degrades to host-side loss monitoring here (the opaque
+        step hides its gradients — ADT420)."""
+        from autodist_tpu.runtime.sentinel import resolve_policy
+        policy = resolve_policy(sentinel)
         item = ModelItem(step_fn=step_fn, params=state,
                          example_batch=example_batch).prepare()
         strategy = self._build_or_load_strategy(item)
@@ -408,10 +422,12 @@ class AutoDist:
         self._setup(compiled)
         mesh = mesh_lib.mesh_from_strategy(compiled, self._resource_spec,
                                            backend=self._backend)
-        dstep = GraphTransformer(compiled, mesh, item).transform()
+        dstep = GraphTransformer(compiled, mesh, item,
+                                 sentinel=policy).transform()
         self._runner = Runner(
             dstep, tracing=self._tracing,
-            hbm_budget_bytes=self._resource_spec.chip_hbm_bytes())
+            hbm_budget_bytes=self._resource_spec.chip_hbm_bytes(),
+            sentinel=policy if policy is not None else False)
         return self._runner
 
     def _validate_async(self, compiled: Strategy, item: ModelItem) -> bool:

@@ -12,9 +12,13 @@ def latest_checkpoint(directory):
     ``directory`` across BOTH formats (plain Saver and ShardedSaver), or
     (None, None) — ``latest()`` runs the fast integrity validation, so a
     torn or damaged newest step is skipped here, not discovered at
-    restore time. The single authority for "is there something to
-    restore, and through which saver" — auto-resume (Runner.init) and the
-    sync-elastic restart gate (coordinator) must agree on the answer."""
+    restore time; checkpoints stamped ``healthy: false`` (committed under
+    a bad sentinel verdict) are skipped the same way, so auto-resume and
+    sentinel rollback never load a poisoned state. The single authority
+    for "is there something to restore, and through which saver" —
+    auto-resume (Runner.init), sentinel rollback
+    (``runtime/sentinel.py``) and the sync-elastic restart gate
+    (coordinator) must agree on the answer."""
     best = (None, None)
     for saver_cls in (Saver, ShardedSaver):
         try:

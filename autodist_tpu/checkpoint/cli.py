@@ -45,16 +45,21 @@ def _print_table(statuses: List[integrity.CheckpointStatus],
     if not statuses:
         print("(no checkpoints)")
         return
-    print("%6s  %-8s %-10s %5s  %10s  %s"
-          % ("STEP", "FORMAT", "STATE", "FILES", "BYTES", "PROBLEMS"))
+    print("%6s  %-8s %-10s %-7s %5s  %10s  %s"
+          % ("STEP", "FORMAT", "STATE", "HEALTHY", "FILES", "BYTES",
+             "PROBLEMS"))
     for s in statuses:
         problems = "-"
         if s.problems:
             problems = "; ".join(s.problems[:2 if verbose else 1])
             if len(s.problems) > 2:
                 problems += " (+%d more)" % (len(s.problems) - 2)
-        print("%6d  %-8s %-10s %5d  %10s  %s"
-              % (s.step, s.fmt, s.state, len(s.files),
+        # the sentinel's stamp: yes / NO (saved under a bad verdict —
+        # auto-resume skips it) / "?" for pre-stamp checkpoints
+        # (healthy-unknown: resumable)
+        healthy = {True: "yes", False: "NO"}.get(s.healthy, "?")
+        print("%6d  %-8s %-10s %-7s %5d  %10s  %s"
+              % (s.step, s.fmt, s.state, healthy, len(s.files),
                  _human_bytes(s.bytes), problems))
 
 
@@ -83,10 +88,11 @@ def _cmd_fsck(args) -> int:
     torn = [s for s in statuses if s.state == integrity.TORN]
     if not args.json:
         print("fsck: %d checkpoint(s), %d committed, %d torn attempt(s), "
-              "%d corrupt"
+              "%d corrupt, %d stamped unhealthy"
               % (len(statuses),
                  sum(1 for s in statuses if s.committed),
-                 len(torn), len(corrupt)))
+                 len(torn), len(corrupt),
+                 sum(1 for s in statuses if s.healthy is False)))
     if corrupt:
         return 1
     if torn and args.strict:

@@ -68,7 +68,7 @@ class CheckpointStatus:
     """Classification of one checkpoint step in one format."""
 
     __slots__ = ("directory", "step", "fmt", "state", "problems", "files",
-                 "damaged", "bytes")
+                 "damaged", "bytes", "healthy")
 
     def __init__(self, directory: str, step: int, fmt: str):
         self.directory = directory
@@ -79,6 +79,13 @@ class CheckpointStatus:
         self.files: List[str] = []
         self.damaged: List[str] = []
         self.bytes = 0
+        # the sentinel's health stamp from the meta: True (saved while
+        # the run was judged healthy), False (saved despite a bad
+        # verdict — auto-resume and rollback must never load it), or
+        # None for pre-stamp checkpoints (healthy-UNKNOWN: resumable,
+        # logged — an old checkpoint is not rejected for predating the
+        # feature)
+        self.healthy: Optional[bool] = None
 
     @property
     def committed(self) -> bool:
@@ -100,7 +107,7 @@ class CheckpointStatus:
         return {"step": self.step, "format": self.fmt, "state": self.state,
                 "files": list(self.files), "bytes": self.bytes,
                 "problems": list(self.problems),
-                "damaged": list(self.damaged)}
+                "damaged": list(self.damaged), "healthy": self.healthy}
 
     def __repr__(self):
         return ("CheckpointStatus(step=%d, fmt=%r, state=%r, problems=%r)"
@@ -231,6 +238,8 @@ def validate_sharded(directory: str, step: int, deep: bool = False,
     except (OSError, json.JSONDecodeError, KeyError) as e:
         status._flag(CORRUPT, "meta unreadable: %s" % e, meta_name)
         return status
+    if "healthy" in meta:
+        status.healthy = bool(meta["healthy"])
 
     by_pid: Dict[int, List[str]] = {}
     for key, pid in key_owner.items():
@@ -328,6 +337,8 @@ def validate_plain(directory: str, step: int, deep: bool = False,
     except (OSError, json.JSONDecodeError) as e:
         status._flag(CORRUPT, "meta unreadable: %s" % e, meta_name)
         return status
+    if "healthy" in meta:
+        status.healthy = bool(meta["healthy"])
     file_meta = meta.get("files")
     if file_meta is None:
         # legacy (pre-checksum) checkpoint: verify the standard files are

@@ -71,6 +71,25 @@ def _flat_to_tree(template, flat: Dict[str, np.ndarray]):
 import re as _re
 
 
+def _skip_unhealthy(status) -> bool:
+    """Automatic restore paths (``latest()``, fallback ``restore()``,
+    auto-resume) must never load a checkpoint stamped ``healthy: false``
+    — it was committed while the sentinel's verdict was bad, i.e. it IS
+    the poisoned state rollback exists to escape. Pre-stamp checkpoints
+    (``healthy`` absent → None) stay resumable: healthy-unknown, logged."""
+    if status.healthy is False:
+        logging.warning("checkpoint step %d is stamped UNHEALTHY "
+                        "(committed under a bad sentinel verdict); "
+                        "skipping", status.step)
+        tel.counter_add("ckpt.unhealthy_skipped")
+        return True
+    if status.healthy is None:
+        logging.info("checkpoint step %d predates the health stamp "
+                     "(healthy-unknown); treating as resumable",
+                     status.step)
+    return False
+
+
 def scan_checkpoint_metas(directory: str, pattern) -> list:
     """Sorted (step, filename) pairs for meta files matching ``pattern``
     (a compiled regex whose group 1 is the step). Foreign files in a
@@ -83,6 +102,50 @@ def scan_checkpoint_metas(directory: str, pattern) -> list:
         if m:
             out.append((int(m.group(1)), f))
     return sorted(out)
+
+
+def sentinel_save_vetoed(runner_or_step) -> bool:
+    """Quarantine gate shared by both savers: a Runner with an active
+    sentinel vetoes saves while the health verdict is bad — the poisoned
+    state must never become the newest committed checkpoint (it would be
+    exactly what last-good fallback and auto-resume restore).
+
+    The veto returns BEFORE the cross-process gather collectives, so it
+    is only taken when every process provably reaches the same decision:
+    in-graph verdicts are all-reduced, so guarded programs qualify; a
+    LOSS-ONLY sentinel (step_fn mode, ADT420) watches user metrics that
+    need not be replica-uniform, so in a multi-process job it must not
+    veto — a divergent early return would strand the peers inside the
+    gather. There the save proceeds and the ``healthy`` stamp (written
+    by the chief alone, hence consistent) records the suspicion
+    instead."""
+    veto = getattr(runner_or_step, "sentinel_save_veto", None)
+    if not (callable(veto) and veto()):
+        return False
+    if jax.process_count() > 1:
+        dstep = getattr(runner_or_step, "distributed_step", None)
+        metadata = getattr(dstep, "metadata", None) or {}
+        if not metadata.get("sentinel_guards", False):
+            logging.warning(
+                "sentinel quarantine NOT vetoing this save: loss-only "
+                "monitoring is not replica-uniform in a multi-process "
+                "job (a divergent veto would deadlock the gather "
+                "collectives) — the checkpoint will carry its honest "
+                "healthy stamp instead")
+            return False
+    tel.counter_add("sentinel.save_vetoes")
+    logging.warning("checkpoint save vetoed: sentinel quarantine "
+                    "(health verdict is bad)")
+    return True
+
+
+def sentinel_health_stamp(runner_or_step) -> bool:
+    """The ``healthy`` stamp this save should carry. True when no
+    sentinel is armed (an unguarded run has no evidence of ill health —
+    its checkpoints stay resumable); False only when a sentinel judged
+    the state bad yet the save proceeded (quarantine disabled)."""
+    fn = getattr(runner_or_step, "sentinel_healthy", None)
+    return bool(fn()) if callable(fn) else True
 
 
 class BackgroundWriter:
@@ -152,6 +215,9 @@ class Saver:
             dstep = runner_or_step
         if state is None:
             raise ValueError("no state to save")
+        if sentinel_save_vetoed(runner_or_step):
+            return None
+        healthy = sentinel_health_stamp(runner_or_step)
         # cross-process collectives: run on all processes before any gating
         with tel.span("ckpt.gather", "ckpt"):
             params = dstep.gather_params(state)
@@ -164,7 +230,7 @@ class Saver:
             return None
         path = os.path.join(self.directory, "ckpt-%d" % step)
         meta = {"step": step, "format": "autodist_tpu.v1",
-                "strategy_id": dstep.strategy.id}
+                "strategy_id": dstep.strategy.id, "healthy": healthy}
 
         def write():
             t_begin = time.monotonic()
@@ -261,6 +327,8 @@ class Saver:
         for status in integrity.committed_newest_first(self.directory,
                                                        "plain"):
             if status.committed:
+                if _skip_unhealthy(status):
+                    continue
                 return status.base
             logging.warning("checkpoint step %d is %s, skipping: %s",
                             status.step, status.state,
@@ -296,6 +364,11 @@ class Saver:
                 raise CheckpointDamaged(
                     "checkpoint %s is %s: %s" % (
                         path, status.state, "; ".join(status.problems[:5])))
+            if status.healthy is False:
+                # an EXPLICIT path is a human decision — honor it, loudly
+                logging.warning("restoring %s despite its UNHEALTHY stamp "
+                                "(explicit path overrides the quarantine)",
+                                path)
             return self._restore_at(runner, path)
         tried = 0
         for status in integrity.committed_newest_first(self.directory,
@@ -306,6 +379,9 @@ class Saver:
                                 "; ".join(status.problems[:3]))
                 tel.counter_add("ckpt.fallback")
                 tel.counter_add("ckpt.corrupt_shards", len(status.damaged))
+                continue
+            if _skip_unhealthy(status):
+                tel.counter_add("ckpt.fallback")
                 continue
             tried += 1
             try:
@@ -355,6 +431,9 @@ class Saver:
                            params=state.params, opt_state=state.opt_state,
                            sync_state=state.sync_state)
         runner.state = state
+        notify = getattr(runner, "notify_state_restored", None)
+        if callable(notify):
+            notify()  # re-sync process-local sentinel LR scale
         tel.counter_add("ckpt.restores")
         logging.info("restored checkpoint %s (step %d)", path, step)
         return state, step

@@ -219,6 +219,11 @@ class ShardedSaver:
             dstep = runner_or_step
         if state is None:
             raise ValueError("no state to save")
+        from autodist_tpu.checkpoint.saver import (sentinel_health_stamp,
+                                                   sentinel_save_vetoed)
+        if sentinel_save_vetoed(runner_or_step):
+            return None
+        healthy = sentinel_health_stamp(runner_or_step)
         if step is None:
             step = int(jax.device_get(state.step))
         base = os.path.join(self.directory, "ckpt-%d" % step)
@@ -301,7 +306,7 @@ class ShardedSaver:
 
         meta = {
             "format": _FORMAT, "step": int(step),
-            "strategy_id": dstep.strategy.id,
+            "strategy_id": dstep.strategy.id, "healthy": healthy,
             "mesh": {"axes": list(dstep.mesh.axis_names),
                      "shape": [int(dstep.mesh.shape[a])
                                for a in dstep.mesh.axis_names]},
@@ -501,9 +506,12 @@ class ShardedSaver:
         validation (``integrity.validate_sharded``) skips torn attempts
         and structurally damaged steps, with a logged reason."""
         self.wait()
+        from autodist_tpu.checkpoint.saver import _skip_unhealthy
         for status in integrity.committed_newest_first(self.directory,
                                                        "sharded"):
             if status.committed:
+                if _skip_unhealthy(status):
+                    continue
                 return status.base
             logging.warning("sharded checkpoint step %d is %s, skipping: "
                             "%s", status.step, status.state,
@@ -766,7 +774,13 @@ class ShardedSaver:
                 raise CheckpointDamaged(
                     "sharded checkpoint %s is %s: %s" % (
                         path, status.state, "; ".join(status.problems[:5])))
+            if status.healthy is False:
+                # an EXPLICIT path is a human decision — honor it, loudly
+                logging.warning("restoring %s despite its UNHEALTHY stamp "
+                                "(explicit path overrides the quarantine)",
+                                path)
             return self._restore_at(runner, path)
+        from autodist_tpu.checkpoint.saver import _skip_unhealthy
         tried = 0
         for status in integrity.committed_newest_first(self.directory,
                                                        "sharded"):
@@ -777,6 +791,9 @@ class ShardedSaver:
                     "; ".join(status.problems[:3]))
                 tel.counter_add("ckpt.fallback")
                 tel.counter_add("ckpt.corrupt_shards", len(status.damaged))
+                continue
+            if _skip_unhealthy(status):
+                tel.counter_add("ckpt.fallback")
                 continue
             tried += 1
             try:
@@ -875,6 +892,9 @@ class ShardedSaver:
             step=dstep._put(np.asarray(step, np.int32), P()),
             params=params, opt_state=opt_state, sync_state=sync_state)
         runner.state = state
+        notify = getattr(runner, "notify_state_restored", None)
+        if callable(notify):
+            notify()  # re-sync process-local sentinel LR scale
         tel.counter_add("ckpt.restores")
         logging.info("restored sharded checkpoint %s (step %d, local slices "
                      "only)", path, step)

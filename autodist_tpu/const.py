@@ -133,6 +133,18 @@ class ENV(Enum):
     # post-commit file damage) executed by the savers' fault hooks
     # (runtime/faultinject.py CheckpointFaultPlan): JSON, or @/path/plan.json
     ADT_CKPT_FAULT_PLAN = ("ADT_CKPT_FAULT_PLAN", str, "")
+    # declarative gradient fault plan (runtime/faultinject.py
+    # GradFaultPlan): deterministic step-keyed NaN/Inf/bit-flip/scale
+    # injection into a named variable's gradient, COMPILED into the
+    # lowering at transform time. JSON, or @/path/plan.json
+    ADT_GRAD_FAULT_PLAN = ("ADT_GRAD_FAULT_PLAN", str, "")
+    # training health sentinel (runtime/sentinel.py): "" / "0" off,
+    # "1" default policy, or a JSON dict of SentinelPolicy knobs —
+    # compiles in-graph anomaly guards and arms skip/rollback/quarantine
+    ADT_SENTINEL = ("ADT_SENTINEL", str, "")
+    # watchdog grace for a worker that marked itself "compiling": a first
+    # dispatch's XLA compile can legitimately exceed the heartbeat window
+    ADT_COMPILE_GRACE_S = ("ADT_COMPILE_GRACE_S", float, 600.0)
     # host-PS transfer/compute overlap (parallel/ps.py PSPipeline): 1 =
     # background push + prefetched pull (bit-exact for sync PS; with
     # staleness>=1 or async serving the prefetch overlaps compute fully);

@@ -167,12 +167,22 @@ class DistributedStep:
     # back-compat spelling (promoted to the public name above)
     _pull_ps = pull_ps
 
-    def _push_ps(self, ps_grads: dict) -> None:
+    def _push_ps(self, ps_grads: dict, ok=None) -> None:
         """Device -> host transfer of the reduced PS gradients + host-side
-        optimizer apply (the PS update op). Pipelined when overlap is on."""
+        optimizer apply (the PS update op). Pipelined when overlap is on.
+
+        ``ok`` is the sentinel verdict riding the SAME dispatch the
+        gradients came from (a device scalar): a bad verdict suppresses
+        the push entirely — the PS never sees the poisoned gradient, and
+        its optimizer state stays untouched. Reading the scalar costs
+        nothing extra: the push path device_gets the gradients anyway,
+        and the check runs in the pipeline's worker thread."""
         if self.ps_store is not None and ps_grads:
             if self._ps_pipe is not None:
-                self._ps_pipe.submit(ps_grads)
+                self._ps_pipe.submit(ps_grads, ok=ok)
+            elif ok is not None and not bool(np.asarray(jax.device_get(ok))):
+                tel.counter_add("sentinel.ps_suppressed")
+                logging.warning("sentinel: PS push suppressed (bad verdict)")
             else:
                 self.ps_store.push(ps_grads)
 
@@ -357,7 +367,12 @@ class DistributedStep:
         with tel.span("dstep.dispatch", "dstep", fused=False):
             ps_vals = self.pull_ps()
             new_state, ps_grads, metrics = fn(state, ps_vals, batch)
-            self._push_ps(ps_grads)
+            # sentinel-guarded programs ship the verdict in the metrics;
+            # it gates the PS push (the one update that happens host-side)
+            ok = (metrics["sentinel"]["ok"]
+                  if isinstance(metrics, dict) and "sentinel" in metrics
+                  else None)
+            self._push_ps(ps_grads, ok=ok)
             self.dispatches += 1
             tel.counter_add("dstep.dispatches")
             return new_state, metrics
@@ -617,10 +632,18 @@ class GraphTransformer:
     """Builds the DistributedStep from (compiled strategy, mesh, model item)."""
 
     def __init__(self, compiled_strategy: Strategy, mesh: Mesh, model_item,
-                 mesh_axis: str = const.DATA_AXIS, donate: bool = True):
+                 mesh_axis: str = const.DATA_AXIS, donate: bool = True,
+                 sentinel=None):
         self._strategy = compiled_strategy
         self._mesh = mesh
         self._item = model_item
+        # training health sentinel (runtime/sentinel.py SentinelPolicy):
+        # when active, per-step health guards — global grad norm,
+        # any-NaN/Inf over grads and post-update params, loss finiteness
+        # — are compiled INTO the step and a bad verdict discards the
+        # update in-graph; only ``grad_norm_limit`` is consumed here
+        # (a trace-time constant), the rest drives the Runner's policy
+        self._sentinel = sentinel
         # the data axis carries batch dim 0 and partitioned-var shards; any
         # further mesh axes (seq/...) replicate params and also reduce grads
         self._axis = mesh_axis if mesh_axis in mesh.axis_names else mesh.axis_names[0]
@@ -718,6 +741,20 @@ class GraphTransformer:
         machinery (compressors, host-PS, sparse wire, pipeline schedules)
         requires loss_fn mode and is refused loudly below."""
         import dataclasses as _dc
+        from autodist_tpu.runtime import faultinject as fi
+        if self._sentinel is not None:
+            # the opaque step hides the gradients the guards inspect —
+            # the lowered program carries NO health checks (ADT420); the
+            # Runner's sentinel degrades to loss-only monitoring
+            logging.warning(
+                "sentinel requested but step_fn capture mode lowers the "
+                "program WITHOUT in-graph health guards (the opaque step "
+                "hides its gradients) — detection degrades to host-side "
+                "loss monitoring; use loss_fn mode for full guards")
+        if fi.GradFaultPlan.from_env().rules:
+            logging.warning(
+                "ADT_GRAD_FAULT_PLAN ignored in step_fn capture mode — "
+                "no gradient interception on the opaque path")
         item = self._item
         var_infos = item.var_infos
         layouts = VariablePartitioner.apply(
@@ -1018,6 +1055,50 @@ class GraphTransformer:
                     sorted(uncaptured))
         sparse_wire = frozenset(sparse_specs)
 
+        # ----- training health sentinel + gradient fault layer
+        # Guards (and injected faults) are COMPILED INTO the step: both
+        # read their configuration here, at transform time, so the clean
+        # path stays byte-identical when neither is active.
+        from autodist_tpu.runtime import faultinject as fi
+        guard = self._sentinel is not None
+        grad_norm_limit = (getattr(self._sentinel, "grad_norm_limit", None)
+                          if guard else None)
+        grad_plan = fi.GradFaultPlan.from_env()
+        if grad_plan.rules:
+            unknown = sorted({r.var for r in grad_plan.rules
+                              if r.var not in var_infos})
+            if unknown:
+                logging.warning(
+                    "ADT_GRAD_FAULT_PLAN names unknown variables %s — "
+                    "those rules never fire", unknown)
+            on_wire = sorted({r.var for r in grad_plan.rules
+                              if r.var in sparse_wire})
+            if on_wire:
+                logging.warning(
+                    "ADT_GRAD_FAULT_PLAN targets sparse-wire vars %s: the "
+                    "fault lands on the (unused) dense gradient — route "
+                    "those vars dense to observe the fault", on_wire)
+            logging.warning("gradient fault plan compiled into the step: %s",
+                            grad_plan.describe())
+        # per-var squared-norm / nonfinite-count scaling for sharded
+        # storage: a leaf sharded over mesh axes of total size S is
+        # replicated N/S times, so psum(local * S/N) == the global value;
+        # replicated leaves (scale None) are already global on every
+        # device and skip the psum entirely
+        def _shard_frac(lay: VarLayout):
+            axes = []
+            for part in tuple(lay.pspec or ()):
+                if part is None:
+                    continue
+                axes.extend(part if isinstance(part, (tuple, list))
+                            else [part])
+            prod = 1
+            for a in axes:
+                prod *= int(self._mesh.shape[a])
+            return (prod / float(self.total_devices)) if prod > 1 else None
+        shard_frac = {n: f for n, lay in layouts.items()
+                      if (f := _shard_frac(lay)) is not None}
+
         syncs = self._build_synchronizers(layouts, ps_names, sparse_wire)
         # Route unpartitioned AllReduce vars with an *active* compressor into
         # concat buckets (payload transform needs the merged vector).
@@ -1058,6 +1139,14 @@ class GraphTransformer:
                 st.pop("bucket")
             if not st["var"]:
                 st.pop("var")
+            if guard:
+                # effective-LR scale for the sentinel's escalation ladder:
+                # rides the sync_state (same leading-device-axis layout as
+                # the compressor states) so halving it is a host-side
+                # state edit, never a recompile; updates are multiplied by
+                # it in-graph — exact LR semantics for linear-in-lr optax
+                # transforms (sgd, adam, ...)
+                st["sentinel"] = {"lr_scale": np.ones((N,), np.float32)}
             return st
 
         # ----- the local (per-device) step executed under shard_map
@@ -1093,6 +1182,67 @@ class GraphTransformer:
         # int8 quantized rings: one ring per reduced mesh axis, in order
         ring_axes = tuple((a, int(self._mesh.shape[a])) for a in all_axes)
 
+        def _health_verdict(synced, ps_grads, new_params, global_loss):
+            """The in-graph sentinel verdict: global gradient L2 norm,
+            nonfinite counts over the synced gradients (incl. the PS
+            wire) and the post-update device params, and loss
+            finiteness. Replicated quantities are already global on
+            every device; sharded leaves contribute ``local * S/N``
+            through ONE stacked psum (exact — see ``shard_frac``), so a
+            program with no sharded storage pays no extra collective.
+            Every input is replica-identical, so the ``ok`` branch is
+            taken uniformly across the whole (multi-process) program."""
+            zero = jnp.float32(0.0)
+            local_sq, bad_g_local, bad_p_local = zero, zero, zero
+            shared = [zero, zero, zero]  # sharded parts: sq, bad_g, bad_p
+
+            def _stats(arr):
+                a = jnp.asarray(arr).astype(jnp.float32)
+                return (jnp.sum(jnp.square(a)),
+                        jnp.sum(~jnp.isfinite(a)).astype(jnp.float32))
+            for n in sorted(synced):
+                v = synced[n]
+                if not jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact):
+                    continue
+                sq, bad = _stats(v)
+                f = shard_frac.get(n)
+                if f is not None:
+                    shared[0] += sq * f
+                    shared[1] += bad * f
+                else:
+                    local_sq += sq
+                    bad_g_local += bad
+            for n in sorted(ps_grads):
+                gv = ps_grads[n]
+                vals = gv[1] if isinstance(gv, tuple) else gv
+                sq, bad = _stats(vals)
+                local_sq += sq
+                bad_g_local += bad
+            p_names, p_leaves, _ = variable_utils.flatten_named(new_params)
+            for n, leaf in zip(p_names, p_leaves):
+                if (getattr(leaf, "dtype", None) is None
+                        or not jnp.issubdtype(jnp.asarray(leaf).dtype,
+                                              jnp.inexact)):
+                    continue
+                _, bad = _stats(leaf)
+                f = shard_frac.get(n)
+                if f is not None:
+                    shared[2] += bad * f
+                else:
+                    bad_p_local += bad
+            red = jnp.stack(shared)
+            if N > 1 and shard_frac:
+                red = jax.lax.psum(red, all_axes)
+            grad_norm = jnp.sqrt(local_sq + red[0])
+            bad_g = bad_g_local + red[1]
+            bad_p = bad_p_local + red[2]
+            ok = ((bad_g == 0) & (bad_p == 0)
+                  & jnp.isfinite(global_loss) & jnp.isfinite(grad_norm))
+            if grad_norm_limit is not None:
+                ok = ok & (grad_norm <= jnp.float32(grad_norm_limit))
+            return {"ok": ok.astype(jnp.int32), "grad_norm": grad_norm,
+                    "bad_grads": bad_g, "bad_params": bad_p}
+
         def local_step(state: TrainState, ps_vals, batch):
             gathered = _tree_map_layouts(
                 lambda leaf, lay: lay.gather_full(leaf), state.params, layout_tree)
@@ -1111,6 +1261,12 @@ class GraphTransformer:
                 aux = None
             g_names, g_leaves, _ = variable_utils.flatten_named(grads)
             g = dict(zip(g_names, g_leaves))
+            if grad_plan.rules:
+                # chaos harness: deterministic step-keyed corruption of a
+                # named variable's LOCAL gradient, pre-collective — NaN
+                # spreads through the psum so every replica sees (and the
+                # all-reduced verdict judges) the same poisoned value
+                g = fi.apply_grad_faults(grad_plan, state.step, g)
 
             # sparse wire: per-var (ids, values) pairs, all-gathered across
             # the mesh — batch-shaped payload instead of vocab-shaped
@@ -1214,6 +1370,12 @@ class GraphTransformer:
                 h_treedef, [synced[n] for n in h_names])
             updates, new_opt = optimizer.update(
                 grads_storage, state.opt_state, state.params)
+            if guard:
+                # sentinel escalation: effective-LR scale from sync_state
+                # (local slice of the leading-device-axis layout)
+                lr_scale = sync_state["sentinel"]["lr_scale"][0]
+                updates = jax.tree_util.tree_map(
+                    lambda u: (u * lr_scale).astype(u.dtype), updates)
             # mask non-trainable updates (guards vs. weight decay etc.)
             if frozen_names:
                 u_names, u_leaves, u_treedef = variable_utils.flatten_named(updates)
@@ -1222,7 +1384,8 @@ class GraphTransformer:
                 updates = variable_utils.unflatten_named(u_treedef, u)
             new_params = optax.apply_updates(state.params, updates)
 
-            metrics = {"loss": jax.lax.pmean(loss, all_axes)}
+            global_loss = jax.lax.pmean(loss, all_axes)
+            metrics = {"loss": global_loss}
             if aux is not None:
                 metrics["aux"] = jax.tree_util.tree_map(
                     lambda a: (jax.lax.pmean(a, all_axes)
@@ -1233,6 +1396,27 @@ class GraphTransformer:
                 new_sync["bucket"] = new_bucket_state
             if new_var_state:
                 new_sync["var"] = new_var_state
+            if guard:
+                new_sync["sentinel"] = sync_state["sentinel"]
+                verdict = _health_verdict(synced, ps_grads, new_params,
+                                          global_loss)
+                metrics["sentinel"] = verdict
+                # in-graph SKIP: a bad verdict discards the whole update —
+                # params, optimizer state and compressor residuals carry
+                # unchanged through the select, so the step costs its
+                # compute but poisons nothing. The verdict's inputs are
+                # all-reduced, so every replica (and every process in a
+                # multi-process SPMD program) takes the same branch.
+                okb = verdict["ok"].astype(bool)
+
+                def _sel(new, old):
+                    return jax.tree_util.tree_map(
+                        lambda a, b: jnp.where(okb, a, b), new, old)
+                new_params = _sel(new_params, state.params)
+                new_opt = _sel(new_opt, state.opt_state)
+                new_sync = _sel(new_sync, dict(state.sync_state)
+                                if isinstance(state.sync_state, dict)
+                                else state.sync_state)
             new_state = TrainState(step=state.step + 1, params=new_params,
                                    opt_state=new_opt, sync_state=new_sync)
             return new_state, ps_grads, metrics
@@ -1269,6 +1453,11 @@ class GraphTransformer:
             loss_spec = jax.eval_shape(item.loss_fn, item.params,
                                        item.example_batch)
             metric_specs["aux"] = jax.tree_util.tree_map(lambda _: P(), loss_spec[1])
+        if guard:
+            # the verdict rides the existing metrics readback (replicated
+            # scalars): zero extra dispatches, zero extra D2H
+            metric_specs["sentinel"] = {"ok": P(), "grad_norm": P(),
+                                        "bad_grads": P(), "bad_params": P()}
 
         # forward-only metrics (Runner.evaluate): same param gather, no
         # grad/optimizer/collective-sync cost
@@ -1419,7 +1608,7 @@ class GraphTransformer:
             lambda s: P(None, *s), batch_specs,
             is_leaf=lambda x: isinstance(x, P))
 
-        def _ps_apply_device(vals, opts, ps_grads):
+        def _ps_apply_device(vals, opts, ps_grads, lr_scale=None):
             new_vals, new_opts = {}, {}
             for n in sorted(vals):
                 g = ps_grads[n]
@@ -1433,6 +1622,10 @@ class GraphTransformer:
                         tuple(info.shape[1:]))
                 updates, nopt = optimizer.update(
                     {"v": g}, opts[n], {"v": vals[n]})
+                if lr_scale is not None:
+                    # mirror of PSStore.update_scale on the host path
+                    updates = jax.tree_util.tree_map(
+                        lambda u: (u * lr_scale).astype(u.dtype), updates)
                 new_vals[n] = optax.apply_updates({"v": vals[n]}, updates)["v"]
                 new_opts[n] = nopt
             return new_vals, new_opts
@@ -1442,7 +1635,22 @@ class GraphTransformer:
                 st, vals, opts = carry
                 new_st, ps_grads, metrics = local_step(st, vals, batch)
                 if ps_names:
-                    vals, opts = _ps_apply_device(vals, opts, ps_grads)
+                    scale = (st.sync_state["sentinel"]["lr_scale"][0]
+                             if guard else None)
+                    new_vals, new_opts = _ps_apply_device(vals, opts,
+                                                          ps_grads, scale)
+                    if guard:
+                        # the microstep's verdict gates the device-
+                        # emulated PS apply exactly like it gates the
+                        # per-step host push: a bad microstep's PS update
+                        # is discarded, the carry flows on unchanged
+                        okb = metrics["sentinel"]["ok"].astype(bool)
+                        sel = lambda a, b: jnp.where(okb, a, b)  # noqa: E731
+                        new_vals = jax.tree_util.tree_map(sel, new_vals,
+                                                          vals)
+                        new_opts = jax.tree_util.tree_map(sel, new_opts,
+                                                          opts)
+                    vals, opts = new_vals, new_opts
                 return (new_st, vals, opts), metrics
             (st, vals, opts), stacked_metrics = jax.lax.scan(
                 body, (state, ps_vals, ps_opt), batches)
@@ -1477,6 +1685,10 @@ class GraphTransformer:
                 + [ps_store.max_staleness() if ps_store else 0]),
             "async": (any(not s.sync_mode for s in ps_syncs)
                       or (ps_store.any_async() if ps_store else False)),
+            # health guards compiled into the program? (the ADT420 lint
+            # and the Runner's policy both consult this)
+            "sentinel_guards": guard,
+            "grad_fault_plan": grad_plan.describe(),
         }
         logging.info("GraphTransformer: lowered %d vars (%d partitioned, "
                      "%d host-PS-resident, %d buckets) over %d replicas",

@@ -260,6 +260,10 @@ class PSStore:
         self._serve_config = None
         self._my_pushes = 0
         self._warned_sync_fallback = False
+        # effective-LR scale applied to every optimizer update (sentinel
+        # escalation ladder, runtime/sentinel.py): passed into the jitted
+        # apply as an ARRAY argument, so changing it never retraces
+        self.update_scale = 1.0
         # guards value/opt swaps vs concurrent reads: the async apply
         # thread must never expose a var whose shards span two versions
         import threading
@@ -284,19 +288,24 @@ class PSStore:
 
     # ------------------------------------------------------------ lifecycle
 
-    def _apply_impl(self, shard, opt_state, grad):
+    def _apply_impl(self, shard, opt_state, grad, scale=None):
         updates, new_opt = self._optimizer.update(
             {"v": grad}, opt_state, {"v": shard})
+        if scale is not None:
+            # sentinel LR escalation: exact lr semantics for linear-in-lr
+            # transforms; `scale` is a traced array — no retrace on change
+            updates = jax.tree_util.tree_map(
+                lambda u: (u * scale).astype(u.dtype), updates)
         return optax.apply_updates({"v": shard}, updates)["v"], new_opt
 
-    def _apply_batch_impl(self, shards, opt_states, grads):
+    def _apply_batch_impl(self, shards, opt_states, grads, scale):
         """One traced program covering every (var, shard): per-key
         optimizer semantics identical to :meth:`_apply_impl` (each shard
         keeps its own little opt-state tree)."""
         new_vals, new_opts = {}, {}
         for key in shards:
             new_vals[key], new_opts[key] = self._apply_impl(
-                shards[key], opt_states[key], grads[key])
+                shards[key], opt_states[key], grads[key], scale)
         return new_vals, new_opts
 
     def _apply_sharded(self, shards, opts, gshards):
@@ -307,9 +316,10 @@ class PSStore:
         the per-shard math — hence the result — is identical to the
         single-dispatch baseline."""
         keys = sorted(shards)
+        scale = jnp.float32(self.update_scale)
         n = min(self._apply_threads, len(keys))
         if n <= 1:
-            return self._apply_batch(shards, opts, gshards)
+            return self._apply_batch(shards, opts, gshards, scale)
         if self._apply_pool is None:
             import concurrent.futures
             self._apply_pool = concurrent.futures.ThreadPoolExecutor(
@@ -324,7 +334,8 @@ class PSStore:
             with jax.default_device(self._cpu):
                 return self._apply_batch({k: shards[k] for k in group},
                                          {k: opts[k] for k in group},
-                                         {k: gshards[k] for k in group})
+                                         {k: gshards[k] for k in group},
+                                         scale)
         futures = [self._apply_pool.submit(run, g) for g in groups]
         new_vals, new_opts = {}, {}
         for f in futures:
@@ -1280,7 +1291,7 @@ class PSPipeline:
         fut, self._pending = self._pending, None
         return fut.result()
 
-    def submit(self, ps_grads: Dict[str, Any]) -> None:
+    def submit(self, ps_grads: Dict[str, Any], ok=None) -> None:
         """Queue this step's push and the next step's pull.
 
         Exact (sync) mode: one job, get -> apply -> prefetch, and the next
@@ -1291,7 +1302,23 @@ class PSPipeline:
         rather than tree-atomic — the store's per-var lock means a pull
         concurrent with an apply can see var A pre-apply and var B post-
         apply, exactly the per-variable consistency the reference's
-        per-var PS queues gave)."""
+        per-var PS queues gave).
+
+        ``ok`` is the sentinel verdict device scalar riding the same
+        dispatch as ``ps_grads``: the push job reads it (the one D2H a
+        push pays anyway, in the worker thread — never blocking the main
+        thread) and SUPPRESSES the apply when the step was judged
+        unhealthy, so a poisoned gradient never reaches the store."""
+
+        def _push_allowed() -> bool:
+            if ok is None:
+                return True
+            if bool(np.asarray(jax.device_get(ok))):
+                return True
+            tel.counter_add("sentinel.ps_suppressed")
+            logging.warning("sentinel: PS push suppressed (bad verdict)")
+            return False
+
         if self._stale_ok:
             # bounded lag: the prefetched read may trail the newest apply
             # by at most the staleness window — the pull waits for the
@@ -1309,12 +1336,14 @@ class PSPipeline:
             def push_job():
                 if prev is not None:
                     prev.result()        # pushes stay ordered
-                self._store.push(ps_grads)
+                if _push_allowed():
+                    self._store.push(ps_grads)
             self._push_pending = self._exec.submit(push_job)
             self._push_hist.append(self._push_pending)
         else:
             def job():
-                self._store.push(ps_grads)
+                if _push_allowed():
+                    self._store.push(ps_grads)
                 return self._pull_staged()
             self._pending = self._exec.submit(job)
 

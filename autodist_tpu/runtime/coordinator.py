@@ -106,6 +106,34 @@ class Coordinator:
     def enable_sync_elastic(self):
         self._sync_elastic = True
 
+    def _in_compile_grace(self, client, worker: str) -> bool:
+        """True while ``worker`` holds a fresh one-shot ``compiling``
+        mark (written by ``Runner._compile_grace_begin`` just before its
+        first dispatch, cleared when the dispatch returns): heartbeat
+        silence during first-dispatch XLA compilation is honest, not a
+        hang. The mark is wall-clock (cross-process; minutes of grace
+        make clock skew noise) and expires after ``ADT_COMPILE_GRACE_S``
+        or twice the heartbeat window, whichever is larger — a worker
+        that dies MID-compile still gets declared dead, just later."""
+        try:
+            mark = client.get("compiling/%s" % worker)
+        except OSError:
+            return False
+        if not mark:
+            return False
+        try:
+            ts = float(mark)
+        except ValueError:
+            return False
+        grace = max(2 * self._heartbeat_timeout,
+                    const.ENV.ADT_COMPILE_GRACE_S.val)
+        if time.time() - ts < grace:
+            logging.info("watchdog: worker %s missed heartbeats but is "
+                         "inside its compile grace window — not aging it",
+                         worker)
+            return True
+        return False
+
     def start_watchdog(self):
         """Heartbeat-based failure detection via the coordination service
         (augments the process-exit watcher): a worker that stops heartbeating
@@ -172,6 +200,13 @@ class Coordinator:
                 dead = [d for d in dead if d != "chief"
                         and now - self._restart_at.get(d, float("-inf"))
                         > 2 * self._heartbeat_timeout]
+                # first-dispatch compilation grace: a worker that marked
+                # itself "compiling" (Runner._compile_grace_begin) is in
+                # a legitimately silent XLA compile — a long fused-k or
+                # big-bucket lowering easily exceeds the heartbeat
+                # window, and killing it would be a false death
+                dead = [d for d in dead
+                        if not self._in_compile_grace(client, d)]
                 fatal = [d for d in dead
                          if self._max_restarts <= self._restarts.get(d, 0)]
                 for d in dead:

@@ -606,3 +606,162 @@ def checkpoint_fault(phase: str, path: Optional[str] = None,
             _ckpt_plan_raw = raw
         plan = _ckpt_plan
     plan.fire(phase, path=path, step=step)
+
+
+# ======================================================== gradient faults
+#
+# The wire proxy faults the COORDINATION plane and the checkpoint plan
+# faults the DISK; this layer faults the COMPUTATION — the one class the
+# chaos harness could not previously represent: a poisoned gradient
+# flowing into an update. Same declarative grammar, through
+# ``ADT_GRAD_FAULT_PLAN``::
+#
+#     {
+#       "seed": 0,
+#       "faults": [
+#         {"var": "w",   "mode": "nan",     "step": 3},
+#         {"var": "w",   "mode": "bitflip", "step": 5, "until": 7,
+#          "bit": 30, "index": 0},
+#         {"var": "emb", "mode": "scale",   "step": 4, "factor": 1e6},
+#         {"var": "b",   "mode": "inf",     "step": 2, "every": 4,
+#          "until": 100}
+#       ]
+#     }
+#
+# Unlike the wire/checkpoint plans (host-side hooks, re-read per call),
+# gradient faults are COMPILED INTO the lowering: ``GraphTransformer``
+# reads the plan at transform time and traces each rule as a
+# ``jnp.where(step == n, poison, grad)`` branch keyed on the TrainState's
+# own step counter — so injection works identically in the per-step and
+# fused ``lax.scan`` paths, costs zero extra dispatches, and is exactly
+# reproducible (the step counter, not wall time, arms it). Consequence:
+# the plan must be set BEFORE the program is built, and a rollback that
+# replays the faulty step window re-encounters the same faults — which is
+# precisely what the sentinel's escalation ladder is tested against.
+#
+# Rule fields: ``var`` (exact variable name, required), ``mode`` in
+# ``nan | inf | bitflip | scale``, ``step`` (0-based TrainState step the
+# fault arms at), ``until`` (inclusive last step; default = ``step``, so
+# a bare rule is a one-step transient), ``every`` (within [step, until]
+# fire only when (step - rule.step) % every == 0), ``factor`` (scale
+# mode, default 1e6), ``bit``/``index`` (bitflip mode: XOR bit ``bit`` of
+# the flat element at ``index``; bit 30 flips a float32 exponent MSB —
+# the classic silent-data-corruption blowup).
+
+
+class GradFaultRule:
+    """One declarative gradient fault (see the section comment above).
+
+    Unknown fields are REJECTED, not ignored: the wire/ckpt grammars'
+    ``nth``/``repeat``/``prob`` knobs do not exist here (injection is
+    traced, keyed on the step counter, with no runtime roll), and a
+    silently-dropped field would make the chaos run test something other
+    than what the plan declares."""
+
+    _MODES = ("nan", "inf", "bitflip", "scale")
+    _FIELDS = frozenset(("var", "mode", "step", "until", "every",
+                         "factor", "bit", "index"))
+
+    def __init__(self, spec: dict):
+        unknown = sorted(set(spec) - self._FIELDS)
+        if unknown:
+            raise ValueError(
+                "unknown gradient fault field(s) %s — the grad plan is "
+                "step-keyed (fields: %s); nth/repeat/prob belong to the "
+                "wire/checkpoint plans (docs/failure_model.md)"
+                % (unknown, ", ".join(sorted(self._FIELDS))))
+        self.var = spec["var"]
+        self.mode = spec.get("mode", "nan")
+        if self.mode not in self._MODES:
+            raise ValueError("unknown gradient fault mode %r (one of %s)"
+                             % (self.mode, ", ".join(self._MODES)))
+        self.step = int(spec.get("step", 0))
+        self.until = int(spec.get("until", self.step))
+        if self.until < self.step:
+            raise ValueError("gradient fault until=%d precedes step=%d"
+                             % (self.until, self.step))
+        self.every = max(1, int(spec.get("every", 1)))
+        self.factor = float(spec.get("factor", 1e6))
+        self.bit = int(spec.get("bit", 30))
+        self.index = int(spec.get("index", 0))
+
+    def describe(self) -> str:
+        window = ("step %d" % self.step if self.until == self.step
+                  else "steps %d..%d/%d" % (self.step, self.until,
+                                            self.every))
+        return "%s(%s @ %s)" % (self.mode, self.var, window)
+
+
+class GradFaultPlan:
+    """Parsed ``ADT_GRAD_FAULT_PLAN`` — consumed by ``GraphTransformer``
+    at transform time (the traced-injection contract above). A top-level
+    ``seed`` is tolerated for grammar-family symmetry but meaningless:
+    grad injection is fully deterministic (step-keyed, no rng)."""
+
+    def __init__(self, spec: Optional[dict] = None):
+        spec = spec or {}
+        self.rules: List[GradFaultRule] = [GradFaultRule(r)
+                                           for r in spec.get("faults", ())]
+
+    @classmethod
+    def from_env(cls) -> "GradFaultPlan":
+        raw = const.ENV.ADT_GRAD_FAULT_PLAN.val
+        if not raw:
+            return cls()
+        if raw.startswith("@"):
+            with open(raw[1:]) as f:
+                raw = f.read()
+        elif os.path.exists(raw):
+            with open(raw) as f:
+                raw = f.read()
+        return cls(json.loads(raw))
+
+    def describe(self) -> str:
+        return ", ".join(r.describe() for r in self.rules)
+
+
+def _uint_like(dtype):
+    """The same-width unsigned dtype for a bitcast (bitflip mode)."""
+    import numpy as _np
+    return {2: _np.uint16, 4: _np.uint32, 8: _np.uint64}[
+        _np.dtype(dtype).itemsize]
+
+
+def apply_grad_faults(plan: GradFaultPlan, step, grads: dict) -> dict:
+    """TRACED application of a grad-fault plan: ``step`` is the (possibly
+    abstract) TrainState step counter, ``grads`` a name->array dict; every
+    matching rule contributes a data-dependent select, so the compiled
+    program injects at exactly the planned steps with no recompile and no
+    host round-trip. Rules naming absent variables are skipped (the
+    transformer warns about them once at build time)."""
+    import jax
+    import jax.numpy as jnp
+    out = dict(grads)
+    for rule in plan.rules:
+        g = out.get(rule.var)
+        if g is None or not jnp.issubdtype(jnp.asarray(g).dtype,
+                                           jnp.inexact):
+            continue
+        g = jnp.asarray(g)
+        hit = (step >= rule.step) & (step <= rule.until)
+        if rule.every > 1:
+            hit = hit & ((step - rule.step) % rule.every == 0)
+        if rule.mode == "nan":
+            out[rule.var] = g + jnp.where(hit, jnp.nan, 0.0).astype(g.dtype)
+        elif rule.mode == "inf":
+            out[rule.var] = g + jnp.where(hit, jnp.inf, 0.0).astype(g.dtype)
+        elif rule.mode == "scale":
+            out[rule.var] = g * jnp.where(hit, rule.factor, 1.0).astype(
+                g.dtype)
+        else:  # bitflip: XOR one bit of one element — silent corruption
+            flat = g.reshape(-1)
+            size = int(flat.shape[0])
+            idx = rule.index % size
+            udt = _uint_like(g.dtype)
+            bit = rule.bit % (8 * jnp.dtype(udt).itemsize)
+            bits = jax.lax.bitcast_convert_type(flat[idx], udt)
+            flipped = jax.lax.bitcast_convert_type(
+                bits ^ udt(1 << bit), g.dtype)
+            flat = flat.at[idx].set(jnp.where(hit, flipped, flat[idx]))
+            out[rule.var] = flat.reshape(g.shape)
+    return out

@@ -33,13 +33,18 @@ class MetricsHandle:
     round-trips: handles accumulate device-side and one readback
     materializes many steps' metrics at a ``metrics_every`` boundary."""
 
-    __slots__ = ("_device", "_remapper", "_host", "microsteps")
+    __slots__ = ("_device", "_remapper", "_host", "microsteps", "_observer")
 
-    def __init__(self, device_metrics, remapper, microsteps: int = 1):
+    def __init__(self, device_metrics, remapper, microsteps: int = 1,
+                 observer=None):
         self._device = device_metrics
         self._remapper = remapper
         self._host = None
         self.microsteps = microsteps
+        # called once per MICROSTEP (in order) when the handle
+        # materializes — the sentinel's verdict intake; consumed on first
+        # result() so re-reads never replay observations
+        self._observer = observer
 
     @property
     def materialized(self) -> bool:
@@ -57,6 +62,11 @@ class MetricsHandle:
             tel.counter_add("runner.d2h_bytes", sum(
                 getattr(np.asarray(leaf), "nbytes", 0)
                 for leaf in jax.tree_util.tree_leaves(self._host)))
+            if self._observer is not None:
+                # consume BEFORE calling: unstack() re-enters result()
+                obs, self._observer = self._observer, None
+                for m in self.unstack():
+                    obs(m)
         return self._host
 
     def unstack(self) -> list:
@@ -90,7 +100,8 @@ class Runner:
     """Owns a DistributedStep + TrainState and runs steps."""
 
     def __init__(self, distributed_step, tracing: bool = False,
-                 hbm_budget_bytes: Optional[float] = None):
+                 hbm_budget_bytes: Optional[float] = None,
+                 sentinel=None):
         self._dstep = distributed_step
         # per-device HBM budget for memory_report(): AutoDist passes the
         # resource spec's chip capacity; a bare Runner has no budget and
@@ -157,6 +168,26 @@ class Runner:
                     runner.close()
             self._atexit_cb = _close_if_alive
             atexit.register(_close_if_alive)
+        # ---- training health sentinel (runtime/sentinel.py): None
+        # defers to ADT_SENTINEL; an active policy consumes the in-graph
+        # verdicts at readback boundaries and drives skip-budget
+        # accounting, rollback and save quarantine
+        from autodist_tpu.runtime import sentinel as sentinel_lib
+        policy = sentinel_lib.resolve_policy(sentinel)
+        self._sentinel = (sentinel_lib.Sentinel(policy, self)
+                          if policy is not None else None)
+        self._sentinel_diags = []
+        if self._sentinel is not None:
+            from autodist_tpu.analysis import rules as rules_lib
+            self._sentinel_diags = rules_lib.verify_sentinel(
+                policy, distributed_step.metadata)
+            for d in self._sentinel_diags:
+                logging.warning("%s", d)
+        # one-shot "compiling" grace around first-dispatch compilation:
+        # a long XLA compile must not age this worker into a false death
+        # at the chief's heartbeat watchdog
+        self._compile_grace_marked = False
+        self._compile_grace_cleared = False
 
     def _connect_coordination(self, purpose: str = "staleness pacing"):
         from autodist_tpu.runtime.coordination import CoordinationClient
@@ -249,6 +280,7 @@ class Runner:
                                 "checkpoint in %s; starting fresh",
                                 const.ENV.ADT_CKPT_DIR.val)
         self.state = self._dstep.init_state(params, opt_state)
+        self.notify_state_restored()  # fresh init resets the LR scale
         return self.state
 
     _RECENT_WINDOW = 512
@@ -272,11 +304,124 @@ class Runner:
             self._trace_started = False
             self._tracing = False  # trace only the first step, like FULL_TRACE runs
 
+    def _compile_grace_begin(self):
+        """Pre-compile heartbeat + one-shot ``compiling`` grace mark,
+        sent just before the FIRST dispatch (which carries the XLA
+        compile). A fused-k compile of a big bucket can exceed
+        ``ADT_HEARTBEAT_TIMEOUT_S`` between step-driven beats, and the
+        chief's watchdog would age this healthy worker into a false
+        death; the mark (a wall-clock KV record the watchdog checks, see
+        ``Coordinator._in_compile_grace``) buys ``ADT_COMPILE_GRACE_S``
+        of silence, and is cleared the moment the first dispatch
+        returns."""
+        if self._superstep_count > 0 or self._compile_grace_marked:
+            return
+        client = self._async_hb or self._coord
+        if client is None:
+            return
+        worker = const.ENV.ADT_WORKER.val or "chief"
+        try:
+            client.heartbeat(worker)
+            # wall clock, not monotonic: the watchdog runs in ANOTHER
+            # process; the grace window is minutes, so host clock skew
+            # is noise
+            client.put("compiling/%s" % worker, repr(time.time()))
+            self._compile_grace_marked = True
+            self._last_hb = time.monotonic()
+        except (OSError, RuntimeError) as e:
+            # best-effort: a rejected/unreachable mark must never stop
+            # training — worst case the watchdog sees compile silence
+            logging.warning("pre-compile heartbeat failed (%s); the "
+                            "watchdog may see a long first compile as "
+                            "silence", e)
+
+    def _compile_grace_end(self):
+        """Clear the one-shot compiling mark — steady-state silence must
+        age normally again."""
+        if not self._compile_grace_marked or self._compile_grace_cleared:
+            return
+        self._compile_grace_cleared = True
+        client = self._async_hb or self._coord
+        if client is None:
+            return
+        worker = const.ENV.ADT_WORKER.val or "chief"
+        try:
+            # "0" = epoch zero: instantly outside any grace window (the
+            # line protocol needs a non-empty value token)
+            client.put("compiling/%s" % worker, "0")
+        except (OSError, RuntimeError):
+            pass  # mark ages out via the grace window anyway
+
+    def _maybe_sentinel_act(self):
+        """Perform a pending sentinel rollback (or raise the typed
+        ``TrainingDiverged``) at a SAFE point — before a dispatch or
+        after a readback boundary, never from inside a metrics
+        materialization."""
+        if self._sentinel is not None:
+            self._sentinel.maybe_act()
+
+    def _sentinel_observer(self):
+        return self._sentinel.observe if self._sentinel is not None else None
+
+    def sentinel_save_veto(self) -> bool:
+        """Consulted by the checkpoint savers: True while the sentinel
+        quarantines saves (last verdict bad / rollback pending) — a
+        poisoned state must never become the newest committed
+        checkpoint."""
+        return self._sentinel is not None and self._sentinel.quarantined
+
+    def sentinel_healthy(self) -> bool:
+        """The ``healthy`` stamp a checkpoint committed now should carry
+        (True when no sentinel is active — an unguarded run has no
+        evidence of ill health)."""
+        return self._sentinel is None or self._sentinel.healthy()
+
+    @property
+    def sentinel(self):
+        """The active :class:`~autodist_tpu.runtime.sentinel.Sentinel`
+        (None when no policy is armed)."""
+        return self._sentinel
+
+    def notify_state_restored(self):
+        """Re-sync the PROCESS-LOCAL halves of the sentinel's LR scale
+        with the authoritative copy in the (restored or freshly
+        initialized) state's sync_state. The scale lives in three
+        places — in-graph (``sync_state["sentinel"]["lr_scale"]``, what
+        checkpoints persist), ``PSStore.update_scale`` (host applies)
+        and ``Sentinel.lr_scale`` (ladder accounting) — and a restore
+        replaces only the first; without this hook an auto-resume after
+        an escalation would train PS-resident and device-resident vars
+        at DIFFERENT effective learning rates. Called by the savers'
+        restore paths and by :meth:`init`."""
+        scale = 1.0
+        sync = getattr(self.state, "sync_state", None)
+        if isinstance(sync, dict) and "sentinel" in sync:
+            try:
+                leaf = sync["sentinel"]["lr_scale"]
+                shards = getattr(leaf, "addressable_shards", None)
+                if shards:
+                    # every shard carries the same scalar; reading a local
+                    # shard works even when the global array spans
+                    # processes (device_get would refuse it)
+                    leaf = shards[0].data
+                scale = float(np.asarray(jax.device_get(leaf)).ravel()[0])
+            except (KeyError, IndexError, TypeError):
+                pass
+        store = getattr(self._dstep, "ps_store", None)
+        if store is not None:
+            store.update_scale = scale
+        sen = getattr(self, "_sentinel", None)
+        if sen is not None and sen.lr_scale != scale:
+            logging.info("sentinel: lr_scale re-synced to %.4g from the "
+                         "restored state", scale)
+            sen.lr_scale = scale
+
     def _after_dispatch(self, microsteps: int):
         """Shared post-dispatch control plane: step accounting, liveness
         heartbeat, cross-process staleness pacing and mirror checks — all
         counted in MICROSTEPS, so a fused superstep advances the pacing
         protocol by its true k optimizer applies."""
+        self._compile_grace_end()
         self._step_count += microsteps
         self._superstep_count += 1
         tel.counter_add("runner.steps", microsteps)
@@ -313,9 +458,11 @@ class Runner:
         measure dispatch-to-dispatch, not execution (the next forced
         readback re-syncs the clock)."""
         t_begin = time.perf_counter()
+        self._maybe_sentinel_act()  # a pending rollback replaces self.state
         st = state if state is not None else self.state
         if st is None:
             raise RuntimeError("Runner.run before init()")
+        self._compile_grace_begin()
         with tel.span("runner.dispatch", "runner", microsteps=1, sync=sync):
             sharded_batch = self._remapper.remap_feed(batch)
             self._start_trace_if_due()
@@ -328,7 +475,8 @@ class Runner:
                 self.state = new_state
             self._after_dispatch(1)
             self._stop_trace_if_due(metrics)
-            handle = MetricsHandle(metrics, self._remapper, microsteps=1)
+            handle = MetricsHandle(metrics, self._remapper, microsteps=1,
+                                   observer=self._sentinel_observer())
             if sync:
                 # result() pulls the metrics to host, so the step's device
                 # work is complete: this wall time is an honest per-step
@@ -350,8 +498,10 @@ class Runner:
         returning). Heartbeats and staleness pacing advance by the true
         k microsteps."""
         t_begin = time.perf_counter()
+        self._maybe_sentinel_act()  # a pending rollback replaces self.state
         if self.state is None:
             raise RuntimeError("Runner.run_superstep before init()")
+        self._compile_grace_begin()
         placed = self._remapper.remap_feed_stack(stacked_batch)
         leaves = jax.tree_util.tree_leaves(placed)
         k = int(np.shape(leaves[0])[0]) if leaves else 1
@@ -362,7 +512,8 @@ class Runner:
             self.state = new_state
             self._after_dispatch(k)
             self._stop_trace_if_due(metrics)
-            handle = MetricsHandle(metrics, self._remapper, microsteps=k)
+            handle = MetricsHandle(metrics, self._remapper, microsteps=k,
+                                   observer=self._sentinel_observer())
             if sync:
                 handle.result()
             self._record_step_time(t_begin)
@@ -560,6 +711,13 @@ class Runner:
             "prefetch_dropped_batches": c.get("prefetch.dropped_batches",
                                               0.0),
         }
+        # stable sub-dict (same contract as the telemetry merge): every
+        # key exists whether or not a sentinel policy is armed (getattr:
+        # partially-constructed runners must still report stats)
+        sen = getattr(self, "_sentinel", None)
+        out["sentinel"] = (sen.stats() if sen is not None else
+                           {"skips": 0, "rollbacks": 0,
+                            "last_grad_norm": None, "quarantined": False})
         return out
 
     def _check_ps_owner_health(self):
@@ -780,6 +938,9 @@ class Runner:
             from autodist_tpu.checkpoint.saver import Saver
             saver = Saver(directory=const.ENV.ADT_CKPT_DIR.val,
                           async_save=True)
+        if self._sentinel is not None and saver is not None:
+            # rollback restores from where fit checkpoints
+            self._sentinel.attach_saver(saver)
         if fuse_steps > 1 or metrics_every > 1:
             return self._fit_pipelined(batches, steps, callbacks, save_every,
                                        saver, max(1, fuse_steps),
@@ -794,6 +955,9 @@ class Runner:
                     cb(i, metrics)
                 if save_every > 0 and (i + 1) % save_every == 0:
                     saver.save(self)
+            # the LAST step's verdict may have pended a rollback; act
+            # before the trailing save so a hard-fail surfaces from fit
+            self._maybe_sentinel_act()
             if save_every > 0 and history and len(history) % save_every != 0:
                 saver.save(self)  # final partial window
         finally:
@@ -869,6 +1033,7 @@ class Runner:
                 supersteps += 1
                 if supersteps % metrics_every == 0:
                     materialize()
+                    self._maybe_sentinel_act()
                 if save_every > 0 and micro_done - last_save >= save_every:
                     # superstep-boundary rounding: the save covers every
                     # microstep dispatched so far (saver reads through
@@ -876,6 +1041,7 @@ class Runner:
                     saver.save(self)
                     last_save = micro_done
             materialize()
+            self._maybe_sentinel_act()
             if save_every > 0 and micro_done > last_save:
                 saver.save(self)  # final partial window
         finally:

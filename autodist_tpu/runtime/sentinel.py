@@ -1,0 +1,361 @@
+"""Training health sentinel — in-program anomaly guards + recovery policy.
+
+The computation-plane leg of the resilience story: PR 1 guards the wire
+(``runtime/resilience.py``), the checkpoint lifecycle guards the disk
+(``checkpoint/integrity.py``), and this module guards the *update* — the
+NaN/Inf blowups, loss spikes and silent gradient corruption that turn a
+week-long run into garbage while every RPC and every fsync succeeds.
+
+Two halves, split by where the work must happen:
+
+- **In-graph guards** (compiled by ``GraphTransformer`` when a policy is
+  active): the distributed step computes a per-step health verdict —
+  global gradient norm, any-NaN/Inf over the synced gradients and the
+  post-update parameters, loss finiteness — and on a bad verdict the
+  update is DISCARDED inside the program (params/opt/compressor state
+  carry unchanged through a ``jnp.where`` select; the host-PS push is
+  suppressed by the verdict riding the push's own D2H). Detection costs
+  zero extra dispatches and zero extra device→host transfers: the
+  verdict is a handful of scalars in the existing metrics readback, and
+  every input to it is all-reduced, so in a multi-process run every
+  worker takes the same branch. The fused ``lax.scan`` path stacks one
+  verdict per microstep.
+
+- **Host-side policy** (this module's :class:`Sentinel`, driven by the
+  Runner at metrics-readback boundaries): accounts skips against a
+  sliding-window budget, tracks an EWMA z-score of the loss for
+  sustained spikes the finiteness guards cannot see, and escalates —
+
+  1. **skip** — in-graph (already happened by the time the verdict is
+     read); the sentinel only counts it.
+  2. **rollback** — past the skip budget, or on a sustained loss spike:
+     restore the newest *healthy-stamped* checkpoint through the
+     integrity scan, rewind the step counters, and widen the skip budget
+     for the replayed window (a deterministic fault re-fires on replay —
+     the widened budget is what lets the run skip THROUGH a bounded bad
+     region instead of ping-ponging).
+  3. **escalate** — a second rollback landing at the same checkpoint
+     step halves the effective learning rate (update scaling — exact LR
+     semantics for any optax optimizer, applied without recompiling);
+     after ``max_rollbacks_per_step`` rollbacks at one step the run
+     hard-fails with a typed :class:`TrainingDiverged`.
+
+  While the verdict is bad the sentinel also **quarantines** checkpoint
+  saves (the savers consult ``Runner.sentinel_save_veto``), and every
+  committed checkpoint carries a ``healthy`` stamp so auto-resume and
+  rollback never restore a poisoned state.
+
+See docs/sentinel.md for the knob reference and the chaos harness
+(``ADT_GRAD_FAULT_PLAN``) that proves the loop end to end.
+"""
+import collections
+import dataclasses
+import json
+import math
+from typing import Optional
+
+from autodist_tpu import const
+from autodist_tpu.telemetry import spans as tel
+from autodist_tpu.utils import logging
+
+
+class TrainingDiverged(RuntimeError):
+    """Training is unrecoverable under the active :class:`SentinelPolicy`:
+    the escalation ladder (skip → rollback → halve LR) is exhausted, or a
+    rollback was required and no healthy checkpoint exists. Typed so a
+    driver can distinguish a health hard-fail from infrastructure
+    errors."""
+
+
+@dataclasses.dataclass
+class SentinelPolicy:
+    """Declarative health policy. The in-graph half consumes only
+    ``grad_norm_limit`` (a trace-time constant); everything else drives
+    the host-side :class:`Sentinel`."""
+
+    # -- skip budget: bad steps discarded in-graph, counted host-side
+    max_skips_per_window: int = 3
+    window_steps: int = 100          # sliding window, in microsteps
+    # -- in-graph guards: skip also when the global grad norm exceeds
+    #    this (None = only NaN/Inf gate the in-graph select)
+    grad_norm_limit: Optional[float] = None
+    # -- sustained loss-spike detection (EWMA z-score over healthy losses)
+    spike_zscore: float = 8.0
+    ewma_alpha: float = 0.05
+    spike_patience: int = 3          # consecutive spiking steps → rollback
+    min_history: int = 20            # EWMA warm-up before z-scores count
+    # -- escalation ladder
+    max_rollbacks_per_step: int = 3  # at ONE checkpoint step; then diverge
+    # -- quarantine: veto checkpoint saves while the verdict is bad
+    quarantine: bool = True
+    enabled: bool = True
+
+    def __post_init__(self):
+        for name in ("max_skips_per_window", "window_steps",
+                     "spike_patience", "min_history",
+                     "max_rollbacks_per_step"):
+            if int(getattr(self, name)) < 1:
+                raise ValueError("SentinelPolicy.%s must be >= 1, got %r"
+                                 % (name, getattr(self, name)))
+        if not (0.0 < self.ewma_alpha <= 1.0):
+            raise ValueError("SentinelPolicy.ewma_alpha must be in (0, 1], "
+                             "got %r" % (self.ewma_alpha,))
+
+    @classmethod
+    def from_env(cls) -> Optional["SentinelPolicy"]:
+        """Policy from ``ADT_SENTINEL``: unset/"0" → None (off), "1" →
+        defaults, a JSON object → keyword overrides."""
+        raw = const.ENV.ADT_SENTINEL.val.strip()
+        if raw in ("", "0", "off", "false", "False"):
+            return None
+        if raw.startswith("{"):
+            return cls(**json.loads(raw))
+        return cls()
+
+
+def resolve_policy(sentinel) -> Optional[SentinelPolicy]:
+    """One resolution rule shared by AutoDist and Runner: ``None`` defers
+    to the env (``ADT_SENTINEL``), ``False`` forces off, ``True`` is the
+    default policy, a :class:`SentinelPolicy` is used as-is (respecting
+    its own ``enabled`` flag)."""
+    if sentinel is None:
+        policy = SentinelPolicy.from_env()
+    elif sentinel is False:
+        return None
+    elif sentinel is True:
+        policy = SentinelPolicy()
+    elif isinstance(sentinel, SentinelPolicy):
+        policy = sentinel
+    else:
+        raise TypeError("sentinel must be None, a bool, or a "
+                        "SentinelPolicy; got %r" % (sentinel,))
+    if policy is not None and not policy.enabled:
+        return None
+    return policy
+
+
+class Sentinel:
+    """Host-side policy engine. The Runner feeds it one metrics dict per
+    MICROSTEP (at readback boundaries, in step order) via
+    :meth:`observe`, and calls :meth:`maybe_act` at safe points (before a
+    dispatch, after a readback) — ``observe`` only updates state, so a
+    rollback never fires reentrantly from inside a metrics
+    materialization."""
+
+    def __init__(self, policy: SentinelPolicy, runner):
+        self.policy = policy
+        self._runner = runner
+        self._micro = 0                 # microsteps observed
+        self._skip_steps = collections.deque()  # micro indexes of skips
+        self.skips = 0
+        self.rollbacks = 0
+        self.lr_halvings = 0
+        self.last_grad_norm: Optional[float] = None
+        self._verdict_bad = False       # last observed in-graph verdict
+        self._pending_rollback: Optional[str] = None
+        self._rollbacks_at = {}         # restored step -> rollback count
+        self._budget_mult = 1           # widened after each rollback
+        self.lr_scale = 1.0
+        # EWMA of the loss over HEALTHY observations only (a bad step's
+        # loss — possibly NaN — must not poison the baseline)
+        self._ewma_mean: Optional[float] = None
+        self._ewma_var = 0.0
+        self._ewma_n = 0
+        self._spike_streak = 0
+        self._saver = None              # fit() attaches its saver
+
+    # ------------------------------------------------------------ observe
+
+    def observe(self, metrics) -> None:
+        """Ingest one microstep's host metrics (readback boundary)."""
+        self._micro += 1
+        verdict = metrics.get("sentinel") if hasattr(metrics, "get") else None
+        loss = metrics.get("loss") if hasattr(metrics, "get") else None
+        loss = float(loss) if loss is not None else None
+        if verdict is not None:
+            self._observe_guarded(verdict, loss)
+        elif loss is not None:
+            # guards not compiled (step_fn mode / ADT420): loss-only
+            # monitoring — a nonfinite loss cannot be skipped in-graph,
+            # so it goes straight to the rollback ladder
+            if not math.isfinite(loss):
+                tel.counter_add("sentinel.nan_steps")
+                self._verdict_bad = True
+                self._pend("nonfinite loss (unguarded program)")
+            else:
+                self._verdict_bad = False
+                self._observe_loss(loss)
+
+    def _observe_guarded(self, verdict, loss) -> None:
+        ok = bool(int(verdict["ok"]))
+        self.last_grad_norm = float(verdict["grad_norm"])
+        if math.isfinite(self.last_grad_norm):
+            tel.gauge_set("sentinel.grad_norm", self.last_grad_norm)
+        if ok:
+            self._verdict_bad = False
+            if loss is not None and math.isfinite(loss):
+                self._observe_loss(loss)
+            return
+        self._verdict_bad = True
+        self.skips += 1
+        tel.counter_add("sentinel.skips")
+        if float(verdict.get("bad_grads", 0)) > 0 \
+                or float(verdict.get("bad_params", 0)) > 0:
+            tel.counter_add("sentinel.nan_steps")
+        tel.instant("sentinel.skip", "sentinel", micro=self._micro,
+                    grad_norm=self.last_grad_norm)
+        self._skip_steps.append(self._micro)
+        horizon = self._micro - self.policy.window_steps
+        while self._skip_steps and self._skip_steps[0] <= horizon:
+            self._skip_steps.popleft()
+        budget = self.policy.max_skips_per_window * self._budget_mult
+        logging.warning(
+            "sentinel: unhealthy step discarded in-graph (grad_norm=%.3g, "
+            "bad_grads=%s, bad_params=%s) — %d/%d skips in window",
+            self.last_grad_norm, verdict.get("bad_grads"),
+            verdict.get("bad_params"), len(self._skip_steps), budget)
+        if len(self._skip_steps) > budget:
+            self._pend("skip budget exhausted (%d skips in the last %d "
+                       "microsteps, budget %d)"
+                       % (len(self._skip_steps), self.policy.window_steps,
+                          budget))
+
+    def _observe_loss(self, loss: float) -> None:
+        p = self.policy
+        if self._ewma_mean is None:
+            self._ewma_mean, self._ewma_n = loss, 1
+            return
+        std = math.sqrt(max(self._ewma_var, 0.0))
+        z = abs(loss - self._ewma_mean) / (std + 1e-12)
+        if self._ewma_n >= p.min_history and z > p.spike_zscore:
+            self._spike_streak += 1
+            logging.warning("sentinel: loss %.6g is %.1f sigma from the "
+                            "EWMA baseline %.6g (streak %d/%d)", loss, z,
+                            self._ewma_mean, self._spike_streak,
+                            p.spike_patience)
+            if self._spike_streak >= p.spike_patience:
+                self._verdict_bad = True  # quarantine saves too
+                self._pend("sustained loss spike (%d steps > %.1f sigma)"
+                           % (self._spike_streak, p.spike_zscore))
+            return  # a spiking loss must not drag the baseline up
+        self._spike_streak = 0
+        delta = loss - self._ewma_mean
+        self._ewma_mean += p.ewma_alpha * delta
+        self._ewma_var = ((1.0 - p.ewma_alpha)
+                          * (self._ewma_var + p.ewma_alpha * delta * delta))
+        self._ewma_n += 1
+
+    def _pend(self, reason: str) -> None:
+        if self._pending_rollback is None:
+            self._pending_rollback = reason
+
+    # ---------------------------------------------------------------- act
+
+    @property
+    def quarantined(self) -> bool:
+        """True while checkpoint saves must be vetoed: the last verdict
+        was bad, or a rollback is pending."""
+        return self.policy.quarantine and (
+            self._verdict_bad or self._pending_rollback is not None)
+
+    def healthy(self) -> bool:
+        """The stamp a checkpoint committed NOW would carry."""
+        return not (self._verdict_bad or self._pending_rollback is not None)
+
+    def attach_saver(self, saver) -> None:
+        if saver is not None:
+            self._saver = saver
+
+    def maybe_act(self) -> None:
+        """Perform a pending rollback (or raise :class:`TrainingDiverged`
+        when the ladder is exhausted). Called by the Runner at safe
+        points only — never from inside a metrics materialization."""
+        if self._pending_rollback is None:
+            return
+        reason, self._pending_rollback = self._pending_rollback, None
+        self._rollback(reason)
+
+    def _ckpt_dir(self) -> str:
+        if self._saver is not None:
+            return self._saver.directory
+        return const.ENV.ADT_CKPT_DIR.val
+
+    def _rollback(self, reason: str) -> None:
+        from autodist_tpu.checkpoint import latest_checkpoint
+        directory = self._ckpt_dir()
+        with tel.span("sentinel.rollback", "sentinel", reason=reason):
+            if self._saver is not None:
+                # land any in-flight async write so the newest committed
+                # (healthy) checkpoint is visible to the scan
+                self._saver.wait()
+            step, saver = latest_checkpoint(directory)
+            if saver is None:
+                raise TrainingDiverged(
+                    "sentinel rollback required (%s) but no healthy "
+                    "committed checkpoint exists in %s — enable periodic "
+                    "saves (fit(save_every=...)) to make rollback possible"
+                    % (reason, directory))
+            count = self._rollbacks_at.get(step, 0) + 1
+            self._rollbacks_at[step] = count
+            if count > self.policy.max_rollbacks_per_step:
+                raise TrainingDiverged(
+                    "sentinel rolled back to step %d %d times (%s) — the "
+                    "escalation ladder (skip → rollback → halve LR) is "
+                    "exhausted" % (step, count - 1, reason))
+            logging.warning("sentinel: ROLLBACK #%d to checkpoint step %d "
+                            "(%s)", count, step, reason)
+            _, restored_step = saver.restore(self._runner)
+            # rewind the pacing/mirror protocols to the restored step and
+            # widen the skip budget: a deterministic fault re-fires on
+            # replay, and the widened window is what lets the run skip
+            # through a bounded bad region instead of ping-ponging
+            self._runner._step_count = int(restored_step)
+            self._budget_mult = 2 ** count
+            self._skip_steps.clear()
+            self._spike_streak = 0
+            self._verdict_bad = False
+            if count >= 2:
+                self._halve_lr()
+            self.rollbacks += 1
+            tel.counter_add("sentinel.rollbacks")
+
+    def _halve_lr(self) -> None:
+        """Escalation: halve the EFFECTIVE learning rate by scaling the
+        optimizer's updates — exact LR semantics for any optax transform
+        whose update is linear in lr (sgd, adam, ...), applied without
+        recompiling: the scale rides the sync_state (device vars, read
+        in-graph) and ``PSStore.update_scale`` (host-applied PS vars)."""
+        import numpy as np
+
+        self.lr_scale *= 0.5
+        self.lr_halvings += 1
+        tel.counter_add("sentinel.lr_halvings")
+        logging.warning("sentinel: repeated rollback at the same step — "
+                        "halving effective LR to %.4gx", self.lr_scale)
+        runner = self._runner
+        dstep = runner.distributed_step
+        store = getattr(dstep, "ps_store", None)
+        if store is not None:
+            store.update_scale = self.lr_scale
+        state = runner.state
+        sync = dict(state.sync_state) if isinstance(state.sync_state,
+                                                    dict) else None
+        if sync is None or "sentinel" not in sync:
+            if store is None:
+                logging.warning(
+                    "sentinel: lowered program carries no lr_scale input "
+                    "(guards not compiled?) — LR escalation is a no-op")
+            return
+        n = int(getattr(dstep.mesh, "size", 1))
+        placed = dstep.place_sync_state(
+            {"lr_scale": np.full((n,), self.lr_scale, np.float32)})
+        sync["sentinel"] = placed
+        runner.state = state.replace(sync_state=sync)
+
+    # ------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        """The stable ``step_stats()['sentinel']`` sub-dict."""
+        return {"skips": self.skips, "rollbacks": self.rollbacks,
+                "last_grad_norm": self.last_grad_norm,
+                "quarantined": self.quarantined}

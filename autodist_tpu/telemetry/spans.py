@@ -129,7 +129,10 @@ DEFAULT_COUNTERS = (
     "prefetch.dropped_examples",
     "ckpt.saves", "ckpt.barrier_s", "ckpt.gc_removed",
     "ckpt.restores", "ckpt.fallback", "ckpt.corrupt_shards",
-    "ckpt.gc_orphans",
+    "ckpt.gc_orphans", "ckpt.unhealthy_skipped",
+    "sentinel.skips", "sentinel.rollbacks", "sentinel.nan_steps",
+    "sentinel.save_vetoes", "sentinel.ps_suppressed",
+    "sentinel.lr_halvings",
     "search.candidates", "search.pruned",
     "serve.requests", "serve.batches", "serve.compiles",
     "serve.padded_rows", "serve.degraded", "serve.shed",

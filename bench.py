@@ -441,6 +441,13 @@ def smoke_main(fused: bool = False):
         runner.init(params)
         return runner
 
+    # sentinel leg FIRST: its build resets the telemetry recorder, and
+    # the exported smoke trace / phase breakdown must cover the main
+    # plain+fused legs below (the same ordering constraint the serve
+    # bench documents for its per-model resets)
+    sentinel_result = _smoke_sentinel(loss_fn, params, batches,
+                                      len(batches))
+
     t0 = time.perf_counter()
     r1 = build()
     h1 = r1.fit(list(batches))
@@ -474,10 +481,56 @@ def smoke_main(fused: bool = False):
         result.update(fuse_steps=k, dispatches=[d1, d2],
                       fused_vs_per_step=round(tp / max(tf, 1e-9), 4),
                       stats=fused_stats)
+    result["sentinel"] = sentinel_result
     result["search"] = _smoke_search(loss_fn, params, batches[0])
     result.update(_smoke_telemetry())
     adt.reset()
     print(RESULT_TAG + json.dumps(result), flush=True)
+
+
+def _smoke_sentinel(loss_fn, params, batches, plain_steps):
+    """Health-sentinel leg of the smoke bench: train the smoke MLP with
+    in-graph guards armed and a NaN gradient injected at step 3
+    (``ADT_GRAD_FAULT_PLAN``) — the poisoned step must be discarded
+    in-graph (``sentinel.skips == 1``), the final loss must stay finite,
+    and the guarded program must dispatch exactly as often as the
+    unguarded loop beside it (the zero-overhead contract: the verdict
+    rides the existing metrics readback). Gates every PR on the
+    detect-and-skip path actually compiling."""
+    import numpy as np
+    import optax
+    import autodist_tpu as adt
+    from autodist_tpu import strategy
+    from autodist_tpu.telemetry import spans as tel
+
+    plan = json.dumps({"faults": [{"var": "w1", "mode": "nan", "step": 3}]})
+    prev = os.environ.get("ADT_GRAD_FAULT_PLAN")
+    os.environ["ADT_GRAD_FAULT_PLAN"] = plan
+    try:
+        adt.reset()
+        ad = adt.AutoDist(strategy_builder=strategy.AllReduce())
+        runner = ad.build(loss_fn, optax.adam(1e-2), params, batches[0],
+                          sentinel=True)
+        runner.init(params)
+        hist = runner.fit(list(batches))
+        stats = runner.step_stats()["sentinel"]
+        final_loss = float(hist[-1]["loss"])
+        assert np.isfinite(final_loss), "sentinel failed to contain the NaN"
+        assert stats["skips"] == 1, stats
+        assert tel.counters()["sentinel.skips"] == 1
+        assert len(hist) == plain_steps
+        d = runner.distributed_step.dispatches
+        assert d == plain_steps, (
+            "guards changed the dispatch count: %d for %d steps"
+            % (d, plain_steps))
+        return {"skips": stats["skips"], "final_loss": round(final_loss, 6),
+                "dispatches": d,
+                "last_grad_norm": round(stats["last_grad_norm"], 4)}
+    finally:
+        if prev is None:
+            os.environ.pop("ADT_GRAD_FAULT_PLAN", None)
+        else:
+            os.environ["ADT_GRAD_FAULT_PLAN"] = prev
 
 
 def _smoke_search(loss_fn, params, batch):

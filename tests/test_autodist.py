@@ -83,9 +83,13 @@ def test_step_stats_goodput():
     assert (stats0["steps"], stats0["supersteps"], stats0["microsteps"],
             stats0["total_s"], stats0["first_step_s"]) == (0, 0, 0, 0.0, None)
     # the shape is stable: steady percentiles exist (as None) pre-sample,
-    # and the telemetry merge carries the registry counters
+    # the telemetry merge carries the registry counters, and the sentinel
+    # sub-dict exists (zeros/None) even with no policy armed
     assert stats0["steady_median_s"] is None
     assert stats0["telemetry"]["dispatches"] == 0.0
+    assert stats0["sentinel"] == {"skips": 0, "rollbacks": 0,
+                                  "last_grad_norm": None,
+                                  "quarantined": False}
     for _ in range(12):
         runner.run(batch)
     stats = runner.step_stats()

@@ -155,6 +155,8 @@ def test_step_stats_superstep_microstep_accounting():
     assert stats0["steady_median_s"] is None and stats0["goodput"] is None
     assert set(stats0["telemetry"]) >= {"dispatches", "d2h_bytes",
                                         "coord_retries"}
+    assert set(stats0["sentinel"]) == {"skips", "rollbacks",
+                                       "last_grad_norm", "quarantined"}
     # 10 batches at k=4: two fused supersteps + a trailing per-step pair
     hist = runner.fit(iter(batches), fuse_steps=4)
     assert len(hist) == 10
