@@ -67,6 +67,8 @@ def reset():
     patch.clear_captured()
     from autodist_tpu.telemetry import spans as _tspans
     _tspans.reset()  # drop recorded spans/counters, re-read ADT_TRACE
+    from autodist_tpu.telemetry import blackbox as _bb
+    _bb.reset()  # clear the flight recorder's event/log tails
 
 
 class AutoDist:

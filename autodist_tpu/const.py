@@ -14,6 +14,7 @@ DEFAULT_LOG_DIR = os.path.join(DEFAULT_WORKING_DIR, "logs")
 DEFAULT_TRACE_DIR = os.path.join(DEFAULT_WORKING_DIR, "traces")
 DEFAULT_SNAPSHOT_DIR = os.path.join(DEFAULT_WORKING_DIR, "snapshots")
 DEFAULT_CHECKPOINT_DIR = os.path.join(DEFAULT_WORKING_DIR, "checkpoints")
+DEFAULT_BLACKBOX_DIR = os.path.join(DEFAULT_WORKING_DIR, "blackbox")
 
 # Port range for the coordination service (analog of the reference's TF
 # server ports 15000-16000, reference autodist/const.py:36-38).
@@ -168,6 +169,31 @@ class ENV(Enum):
     # log line format: "text" (default) or "json" (structured lines
     # carrying span ids so logs correlate with traces)
     ADT_LOG_FORMAT = ("ADT_LOG_FORMAT", str, "text")
+    # ---- cluster observability plane (telemetry/cluster.py, goodput.py,
+    #      blackbox.py; docs/observability.md)
+    # clock-offset handshake rounds against the chief's ClockSyncResponder
+    # (the min-RTT round wins; more rounds ride out jitter)
+    ADT_CLOCKSYNC_ROUNDS = ("ADT_CLOCKSYNC_ROUNDS", int, 8)
+    # straggler flagging: EWMA z-score threshold and consecutive-dispatch
+    # patience before this worker marks itself slow-but-alive
+    ADT_STRAGGLER_Z = ("ADT_STRAGGLER_Z", float, 4.0)
+    ADT_STRAGGLER_PATIENCE = ("ADT_STRAGGLER_PATIENCE", int, 3)
+    # serviceless fleet profiling: "N:M" captures a jax.profiler trace
+    # for steps N..M (inclusive) on THIS process
+    ADT_PROFILE_STEPS = ("ADT_PROFILE_STEPS", str, "")
+    # how often the Runner polls the coordination service's fleet
+    # profiling flag (seconds; 0 disables the poll)
+    ADT_PROFILE_POLL_S = ("ADT_PROFILE_POLL_S", float, 2.0)
+    # flight recorder: "1" (default) arms dumps + the SIGTERM hook; "0"
+    # keeps recording in memory but never writes a file
+    ADT_BLACKBOX = ("ADT_BLACKBOX", bool, True)
+    ADT_BLACKBOX_DIR = ("ADT_BLACKBOX_DIR", str, DEFAULT_BLACKBOX_DIR)
+    # dump at normal process exit too (postmortems for runs that end
+    # "cleanly" but wrong)
+    ADT_BLACKBOX_DUMP = ("ADT_BLACKBOX_DUMP", bool, False)
+    # bounded retention: events kept in memory, dump files kept on disk
+    ADT_BLACKBOX_EVENTS = ("ADT_BLACKBOX_EVENTS", int, 256)
+    ADT_BLACKBOX_KEEP = ("ADT_BLACKBOX_KEEP", int, 8)
 
     @property
     def val(self):

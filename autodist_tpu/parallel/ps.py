@@ -430,8 +430,12 @@ class PSStore:
         In serving (async) mode, values of groups owned by OTHER processes
         are fetched from the service — the latest published version, no
         barrier (the reference's async read-from-PS)."""
+        # step arg = this store's pull sequence: on a merged cluster
+        # timeline the per-worker PS-wire spans line up per step, so
+        # wire-time skew is visible per step, not just per run
         with tel.span("ps.pull", "ps",
-                      serving=self._serve_groups is not None):
+                      serving=self._serve_groups is not None,
+                      step=self.stats["pulls"]):
             out = self._pull_impl()
         tel.counter_add("ps.pulls")
         return out
@@ -556,7 +560,8 @@ class PSStore:
         and enqueues it on the owner's queue; the owner's apply thread
         applies gradients one at a time (no barrier)."""
         with tel.span("ps.push", "ps",
-                      serving=self._serve_groups is not None):
+                      serving=self._serve_groups is not None,
+                      step=self.stats["pushes"]):
             self._push_impl(grads)
         tel.counter_add("ps.pushes")
 

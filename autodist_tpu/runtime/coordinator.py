@@ -134,6 +134,37 @@ class Coordinator:
             return True
         return False
 
+    def _is_straggling(self, client, worker: str) -> bool:
+        """True while ``worker`` holds a fresh slow-but-alive mark
+        (written by ``Runner._observe_straggler`` when its dispatch
+        wall time EWMA-flags; cleared on recovery). Heartbeats are
+        step-driven, so a straggler's beats slow WITH its steps — this
+        mark is what separates "degraded but progressing" (leave it:
+        the straggler attribution in the goodput report says why it is
+        slow) from "dead" (recycle it). Freshness-bounded like the
+        compile mark: a straggler that then truly dies stops refreshing
+        the mark and is declared dead one grace window later."""
+        try:
+            mark = client.get("straggler/%s" % worker)
+        except OSError:
+            return False
+        if not mark:
+            return False
+        try:
+            ts = float(mark)
+        except ValueError:
+            return False
+        if ts <= 0:
+            return False  # "0" = explicitly cleared
+        if time.time() - ts < 2 * self._heartbeat_timeout:
+            logging.warning(
+                "watchdog: worker %s missed heartbeats but marked itself "
+                "a straggler (slow-but-alive) — not recycling it; see "
+                "`python -m autodist_tpu.telemetry goodput` for the "
+                "attribution", worker)
+            return True
+        return False
+
     def start_watchdog(self):
         """Heartbeat-based failure detection via the coordination service
         (augments the process-exit watcher): a worker that stops heartbeating
@@ -207,6 +238,11 @@ class Coordinator:
                 # window, and killing it would be a false death
                 dead = [d for d in dead
                         if not self._in_compile_grace(client, d)]
+                # slow-but-alive stragglers (fresh straggler/<worker>
+                # mark) are degraded, not dead: recycling one mid-window
+                # would turn a throttled host into a real outage
+                dead = [d for d in dead
+                        if not self._is_straggling(client, d)]
                 fatal = [d for d in dead
                          if self._max_restarts <= self._restarts.get(d, 0)]
                 for d in dead:

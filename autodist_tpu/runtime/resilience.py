@@ -143,6 +143,15 @@ class ResilientCoordinationClient:
                 "transport failures to %s:%d",
                 self._breaker_cooldown_s, self._consecutive_failures,
                 self._host, self._port)
+            # breaker-open is a black-box trigger: the dump preserves the
+            # retry/backoff trail and registry state at the moment the
+            # control plane was declared down (telemetry/blackbox.py)
+            from autodist_tpu.telemetry import blackbox
+            blackbox.record("coord.breaker_open",
+                            target="%s:%d" % (self._host, self._port),
+                            failures=self._consecutive_failures,
+                            cooldown_s=self._breaker_cooldown_s)
+            blackbox.dump("breaker_open")
 
     def _check_breaker(self):
         remaining = self._breaker_open_until - time.monotonic()

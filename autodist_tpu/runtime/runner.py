@@ -188,6 +188,26 @@ class Runner:
         # at the chief's heartbeat watchdog
         self._compile_grace_marked = False
         self._compile_grace_cleared = False
+        # ---- cluster observability plane (telemetry/): arm the flight
+        # recorder (always-on bounded black box; also installs the
+        # SIGTERM/exit dump hooks per ADT_BLACKBOX*), the online
+        # straggler detector, and the fleet-profiling window state
+        from autodist_tpu.telemetry import blackbox as blackbox_lib
+        from autodist_tpu.telemetry import cluster as cluster_lib
+        from autodist_tpu.telemetry import goodput as goodput_lib
+        blackbox_lib.get_flight_recorder()
+        self._straggler = goodput_lib.StragglerEwma()
+        self._straggler_mark_at = 0.0
+        # fleet-profiling window: (seq, first_step, last_step) from the
+        # coordination-service flag (polled at ADT_PROFILE_POLL_S) or the
+        # serviceless ADT_PROFILE_STEPS env; seq 0 = the env window
+        env_window = cluster_lib.parse_profile_env(
+            const.ENV.ADT_PROFILE_STEPS.val)
+        self._profile_window = ((0,) + env_window) if env_window else None
+        self._profile_active = False
+        self._profile_done_seq = -1
+        self._profile_poll_at = 0.0
+        self._profile_coord = None  # lazily shares an existing client
 
     def _connect_coordination(self, purpose: str = "staleness pacing"):
         from autodist_tpu.runtime.coordination import CoordinationClient
@@ -291,7 +311,11 @@ class Runner:
         return max(0.25, const.ENV.ADT_HEARTBEAT_TIMEOUT_S.val / 4.0)
 
     def _start_trace_if_due(self):
-        if self._tracing and not self._trace_started:
+        # _profile_active: a fleet window already owns jax.profiler — a
+        # second start_trace would raise; the first-step trace defers to
+        # a later dispatch (self._tracing stays armed)
+        if self._tracing and not self._trace_started \
+                and not self._profile_active:
             os.makedirs(const.DEFAULT_TRACE_DIR, exist_ok=True)
             jax.profiler.start_trace(os.path.join(
                 const.DEFAULT_TRACE_DIR, time.strftime("%Y%m%d-%H%M%S")))
@@ -303,6 +327,106 @@ class Runner:
             jax.profiler.stop_trace()
             self._trace_started = False
             self._tracing = False  # trace only the first step, like FULL_TRACE runs
+
+    # ------------------------------------------- fleet-coordinated profiling
+
+    def _profile_client(self):
+        """A coordination client to poll the fleet profiling flag with —
+        reuse whatever this runner already opened (pacing, liveness,
+        mirror); never dial a connection just for profiling."""
+        for client in (self._coord, self._async_hb, self._mirror_coord):
+            if client not in (None, False):
+                return client
+        return None
+
+    def _maybe_fleet_profile(self):
+        """The fleet-profiling window machinery (the generalization of
+        the first-step ``tracing=True`` hook above): the chief posts
+        "profile steps N..M" on the coordination service
+        (``telemetry.request_profile`` / ``python -m
+        autodist_tpu.telemetry profile N M``), every worker polls the
+        flag at ``ADT_PROFILE_POLL_S``, and each captures a
+        ``jax.profiler`` trace for the SAME step window — one
+        XLA-level profile per worker, step-aligned with the merged
+        telemetry trace it lands next to. ``ADT_PROFILE_STEPS=N:M``
+        arms the same window locally without a service.
+
+        Touches LOCAL state only — it runs inside the dispatch span and
+        the per-dispatch wall-time sample; the KV poll lives in
+        :meth:`_poll_profile_window` (called from ``_after_dispatch``
+        next to the other control-plane RPCs) so a retrying poll during
+        a service blip neither masquerades as compute time in the
+        goodput decomposition nor feeds the straggler EWMA a false
+        outlier."""
+        if self._profile_window is None:
+            return
+        seq, first, last = self._profile_window
+        step = self._step_count  # the step the NEXT dispatch runs
+        if not self._profile_active:
+            if first <= step <= last and not self._trace_started:
+                worker = const.ENV.ADT_WORKER.val or "chief"
+                out = os.path.join(
+                    const.DEFAULT_TRACE_DIR,
+                    "fleet-%d-%s" % (seq, worker.replace(":", "_")))
+                os.makedirs(out, exist_ok=True)
+                try:
+                    jax.profiler.start_trace(out)
+                except RuntimeError as e:  # another trace in flight
+                    logging.warning("fleet profiling: start_trace failed "
+                                    "(%s) — window #%d skipped", e, seq)
+                    self._profile_done_seq = seq
+                    self._profile_window = None
+                    return
+                self._profile_active = True
+                tel.counter_add("profiler.windows")
+                tel.instant("profiler.window_start", "runner", seq=seq,
+                            step=step, first=first, last=last)
+                logging.info("fleet profiling: capturing steps %d..%d "
+                             "into %s", first, last, out)
+            elif step > last:
+                # the window is already behind this worker (posted too
+                # late, or a rollback rewound past it): never arms
+                self._profile_done_seq = max(self._profile_done_seq, seq)
+                self._profile_window = None
+            return
+
+    def _poll_profile_window(self):
+        """Poll the coordination-service profiling flag (at most every
+        ``ADT_PROFILE_POLL_S``; 0 disables) and arm a fresh window for
+        the NEXT dispatch. Runs in ``_after_dispatch`` with the other
+        control-plane RPCs — see :meth:`_maybe_fleet_profile`."""
+        poll_s = const.ENV.ADT_PROFILE_POLL_S.val
+        if (self._profile_window is not None or self._profile_active
+                or poll_s <= 0
+                or time.monotonic() < self._profile_poll_at):
+            return
+        self._profile_poll_at = time.monotonic() + poll_s
+        client = self._profile_client()
+        if client is None:
+            return
+        from autodist_tpu.telemetry import cluster as cluster_lib
+        with tel.span("runner.profile_poll", "runner"):
+            window = cluster_lib.read_profile_window(client)
+        if window is not None and window[0] > self._profile_done_seq:
+            self._profile_window = window
+            logging.info("fleet profiling window #%d armed: "
+                         "steps %d..%d", *window)
+
+    def _maybe_fleet_profile_stop(self):
+        """Close the window AFTER the dispatch that ran its last step."""
+        if not self._profile_active or self._profile_window is None:
+            return
+        seq, _first, last = self._profile_window
+        if self._step_count > last:
+            try:
+                jax.profiler.stop_trace()
+            except RuntimeError:
+                pass
+            self._profile_active = False
+            self._profile_done_seq = max(self._profile_done_seq, seq)
+            self._profile_window = None
+            tel.instant("profiler.window_stop", "runner", seq=seq,
+                        step=self._step_count)
 
     def _compile_grace_begin(self):
         """Pre-compile heartbeat + one-shot ``compiling`` grace mark,
@@ -426,16 +550,25 @@ class Runner:
         self._superstep_count += 1
         tel.counter_add("runner.steps", microsteps)
         tel.counter_add("runner.supersteps")
+        self._maybe_fleet_profile_stop()
+        self._poll_profile_window()
         self._maybe_heartbeat()
         if self._coord is not None:
             # bounded staleness across processes (the reference's size-s
             # token-queue semantics, ps_synchronizer.py:388-458): report our
             # step, then block while more than `staleness` ahead of the
-            # slowest worker
+            # slowest worker. The wait is a SPAN (collective_wait in the
+            # goodput decomposition) with the global step as arg: time
+            # parked here is skew caused by a slower peer, and the merged
+            # timeline shows exactly which step paid it.
             worker = const.ENV.ADT_WORKER.val or "chief"
             self._coord.report_step(worker, self._step_count)
             self._coord.heartbeat(worker)
-            self._coord.wait_staleness(self._step_count, self._staleness)
+            with tel.span("runner.barrier", "runner",
+                          step=self._step_count,
+                          staleness=self._staleness):
+                self._coord.wait_staleness(self._step_count,
+                                           self._staleness)
         self._maybe_check_mirrors()
 
     def _record_step_time(self, t_begin: float):
@@ -447,6 +580,60 @@ class Runner:
             self._recent_step_s.append(elapsed)
             if len(self._recent_step_s) > self._RECENT_WINDOW:
                 del self._recent_step_s[:len(self._recent_step_s) // 2]
+            self._observe_straggler(elapsed)
+
+    def _observe_straggler(self, elapsed: float):
+        """Online slow-but-alive detection: sustained EWMA z-score
+        outliers in this worker's dispatch wall time flip the
+        ``telemetry.straggler`` gauge, emit an instant, and (multi-
+        process) mark ``straggler/<worker>`` on the coordination
+        service — the chief's watchdog reads the mark to distinguish a
+        degraded-but-progressing worker from a dead one instead of
+        recycling it (``Coordinator._is_straggling``)."""
+        transition = self._straggler.observe(elapsed)
+        if transition is None:
+            # REFRESH the slow-but-alive mark while still flagged: the
+            # watchdog's freshness window (2x heartbeat timeout) must
+            # keep seeing a live mark for as long as the degradation
+            # lasts — a single flag-time mark would age out and the
+            # watchdog would recycle a worker that is still progressing
+            if (self._straggler.flagged
+                    and time.monotonic() - self._straggler_mark_at
+                    > self._heartbeat_every_s):
+                self._write_straggler_mark(repr(time.time()))
+            return
+        if transition == "flag":
+            z = self._straggler.last_z
+            tel.gauge_set("telemetry.straggler", round(z, 3))
+            tel.counter_add("telemetry.straggler_flags")
+            tel.instant("telemetry.straggler", "runner", z=round(z, 3),
+                        step=self._step_count,
+                        dispatch_s=round(elapsed, 6))
+            from autodist_tpu.telemetry import blackbox
+            blackbox.record("runner.straggler", z=round(z, 3),
+                            step=self._step_count,
+                            dispatch_s=round(elapsed, 6))
+            logging.warning(
+                "straggler: dispatch wall time %.4gs is %.1f sigma over "
+                "the EWMA baseline for %d consecutive dispatches — "
+                "flagging this worker slow-but-alive",
+                elapsed, z, self._straggler.patience)
+            self._write_straggler_mark(repr(time.time()))
+        else:  # "clear"
+            tel.gauge_set("telemetry.straggler", 0.0)
+            tel.instant("telemetry.straggler_clear", "runner",
+                        step=self._step_count)
+            self._write_straggler_mark("0")
+
+    def _write_straggler_mark(self, mark: str):
+        self._straggler_mark_at = time.monotonic()
+        client = self._async_hb or self._coord
+        if client is not None:
+            worker = const.ENV.ADT_WORKER.val or "chief"
+            try:  # best-effort: the mark is advisory, never worth a stall
+                client.put("straggler/%s" % worker, mark)
+            except (OSError, RuntimeError):
+                pass
 
     def run(self, batch, state: Optional[TrainState] = None,
             sync: bool = True) -> Any:
@@ -463,8 +650,15 @@ class Runner:
         if st is None:
             raise RuntimeError("Runner.run before init()")
         self._compile_grace_begin()
-        with tel.span("runner.dispatch", "runner", microsteps=1, sync=sync):
-            sharded_batch = self._remapper.remap_feed(batch)
+        # the global step arg is what makes per-step skew visible on a
+        # merged cluster timeline: every worker's dispatch for microstep
+        # N carries step=N, so Perfetto (and cluster.step_alignment)
+        # lines the tracks up per STEP, not just per run
+        with tel.span("runner.dispatch", "runner", microsteps=1, sync=sync,
+                      step=self._step_count):
+            with tel.span("runner.feed", "runner"):
+                sharded_batch = self._remapper.remap_feed(batch)
+            self._maybe_fleet_profile()
             self._start_trace_if_due()
             self._check_ps_owner_health()
             # donate only the Runner-owned state; an explicitly-passed state
@@ -502,10 +696,13 @@ class Runner:
         if self.state is None:
             raise RuntimeError("Runner.run_superstep before init()")
         self._compile_grace_begin()
-        placed = self._remapper.remap_feed_stack(stacked_batch)
+        with tel.span("runner.feed", "runner", stacked=True):
+            placed = self._remapper.remap_feed_stack(stacked_batch)
         leaves = jax.tree_util.tree_leaves(placed)
         k = int(np.shape(leaves[0])[0]) if leaves else 1
-        with tel.span("runner.dispatch", "runner", microsteps=k, sync=sync):
+        with tel.span("runner.dispatch", "runner", microsteps=k, sync=sync,
+                      step=self._step_count):
+            self._maybe_fleet_profile()
             self._start_trace_if_due()
             self._check_ps_owner_health()
             new_state, metrics = self._dstep.run_multi(self.state, placed)
@@ -718,7 +915,37 @@ class Runner:
         out["sentinel"] = (sen.stats() if sen is not None else
                            {"skips": 0, "rollbacks": 0,
                             "last_grad_norm": None, "quarantined": False})
+        # attributed goodput (telemetry/goodput.py): WHERE the wall time
+        # went, not just how much was lost — None with tracing off (the
+        # decomposition needs the span tree). Straggler stats are always
+        # present (the EWMA runs on wall-time samples, no spans needed).
+        straggler = getattr(self, "_straggler", None)
+        out["straggler"] = (straggler.stats() if straggler is not None
+                            else {"flagged": False, "flags": 0,
+                                  "last_z": None, "ewma_s": None})
+        report = self.goodput_report()
+        out["goodput_breakdown"] = (
+            {k: round(v, 6) for k, v in report.buckets.items()}
+            if report is not None else None)
         return out
+
+    def goodput_report(self):
+        """The attributed wall-time decomposition of this process's
+        training thread (:class:`telemetry.goodput.GoodputReport`):
+        compute / collective-wait / PS-wire / host-input / readback /
+        checkpoint / rollback-replay buckets that sum to the recorded
+        wall time by construction. None when tracing is off (the
+        decomposition needs the span tree); under ``ADT_TRACE=sampled``
+        (or after ring-buffer drops) the report is flagged
+        ``approximate`` — bucket *proportions* hold, absolute seconds
+        scale with the stride."""
+        if not tel.tracing_enabled():
+            return None
+        from autodist_tpu.telemetry import goodput as goodput_lib
+        report = goodput_lib.build_report()
+        if report.wall_s <= 0:
+            return None
+        return report
 
     def _check_ps_owner_health(self):
         """Fail LOUDLY when an async-PS owner apply loop of this process

@@ -55,6 +55,7 @@ import math
 from typing import Optional
 
 from autodist_tpu import const
+from autodist_tpu.telemetry import blackbox
 from autodist_tpu.telemetry import spans as tel
 from autodist_tpu.utils import logging
 
@@ -204,6 +205,13 @@ class Sentinel:
             tel.counter_add("sentinel.nan_steps")
         tel.instant("sentinel.skip", "sentinel", micro=self._micro,
                     grad_norm=self.last_grad_norm)
+        # the black box keeps every BAD verdict (bounded deque): a
+        # postmortem reads the health trajectory leading into the fatal
+        # verdict even when tracing was off
+        blackbox.record("sentinel.verdict", ok=False, micro=self._micro,
+                        grad_norm=self.last_grad_norm,
+                        bad_grads=float(verdict.get("bad_grads", 0)),
+                        bad_params=float(verdict.get("bad_params", 0)))
         self._skip_steps.append(self._micro)
         horizon = self._micro - self.policy.window_steps
         while self._skip_steps and self._skip_steps[0] <= horizon:
@@ -248,6 +256,8 @@ class Sentinel:
     def _pend(self, reason: str) -> None:
         if self._pending_rollback is None:
             self._pending_rollback = reason
+            blackbox.record("sentinel.rollback_pending", reason=reason,
+                            micro=self._micro)
 
     # ---------------------------------------------------------------- act
 
@@ -290,20 +300,22 @@ class Sentinel:
                 self._saver.wait()
             step, saver = latest_checkpoint(directory)
             if saver is None:
-                raise TrainingDiverged(
-                    "sentinel rollback required (%s) but no healthy "
-                    "committed checkpoint exists in %s — enable periodic "
-                    "saves (fit(save_every=...)) to make rollback possible"
-                    % (reason, directory))
+                self._diverge("sentinel rollback required (%s) but no "
+                              "healthy committed checkpoint exists in %s "
+                              "— enable periodic saves "
+                              "(fit(save_every=...)) to make rollback "
+                              "possible" % (reason, directory))
             count = self._rollbacks_at.get(step, 0) + 1
             self._rollbacks_at[step] = count
             if count > self.policy.max_rollbacks_per_step:
-                raise TrainingDiverged(
-                    "sentinel rolled back to step %d %d times (%s) — the "
-                    "escalation ladder (skip → rollback → halve LR) is "
-                    "exhausted" % (step, count - 1, reason))
+                self._diverge("sentinel rolled back to step %d %d times "
+                              "(%s) — the escalation ladder (skip → "
+                              "rollback → halve LR) is exhausted"
+                              % (step, count - 1, reason))
             logging.warning("sentinel: ROLLBACK #%d to checkpoint step %d "
                             "(%s)", count, step, reason)
+            blackbox.record("sentinel.rollback", step=int(step),
+                            count=count, reason=reason)
             _, restored_step = saver.restore(self._runner)
             # rewind the pacing/mirror protocols to the restored step and
             # widen the skip budget: a deterministic fault re-fires on
@@ -318,6 +330,19 @@ class Sentinel:
                 self._halve_lr()
             self.rollbacks += 1
             tel.counter_add("sentinel.rollbacks")
+        # the completed rollback IS a black-box trigger: a run that later
+        # dies (or quietly mistrains) leaves the what/when/why on disk
+        blackbox.dump("sentinel rollback #%d" % self.rollbacks)
+
+    def _diverge(self, message: str):
+        """Record the fatal verdict + dump the black box, then raise the
+        typed hard-fail — the dump is the postmortem artifact the run
+        leaves behind (events carry the rollback/verdict trail; the span
+        tail carries the last ``sentinel.rollback`` span when tracing
+        was on)."""
+        blackbox.record("sentinel.diverged", reason=message)
+        blackbox.dump("training_diverged")
+        raise TrainingDiverged(message)
 
     def _halve_lr(self) -> None:
         """Escalation: halve the EFFECTIVE learning rate by scaling the

@@ -143,6 +143,12 @@ class MicroBatcher:
                 break
 
     def _serve_group(self, group):
+        # queue-wait bucket of the per-request goodput decomposition:
+        # submit → group start, per request (the other two buckets —
+        # dispatch and readback — are observed inside the engine)
+        t_start = time.perf_counter()
+        for p in group:
+            tel.hist_observe("serve.queue_ms", (t_start - p.t0) * 1e3)
         try:
             fetched, n = self._engine.run_batch(
                 [p.example for p in group])
@@ -186,6 +192,18 @@ class MicroBatcher:
             recompiles_after_warmup=self._engine.recompiles_after_warmup(),
             p50_ms=tel.hist_quantile("serve.latency_ms", 0.50),
             p99_ms=tel.hist_quantile("serve.latency_ms", 0.99),
+            # per-request goodput buckets: where a request's latency
+            # went — queue wait vs program dispatch vs D2H readback
+            # (p50s; the full distributions ride the registry
+            # histograms / metrics_text)
+            goodput={
+                "queue_p50_ms": tel.hist_quantile("serve.queue_ms", 0.50),
+                "queue_p99_ms": tel.hist_quantile("serve.queue_ms", 0.99),
+                "dispatch_p50_ms": tel.hist_quantile("serve.dispatch_ms",
+                                                     0.50),
+                "readback_p50_ms": tel.hist_quantile("serve.readback_ms",
+                                                     0.50),
+            },
         )
         return out
 

@@ -260,12 +260,22 @@ class InferenceEngine:
             if state is None:
                 raise RuntimeError("InferenceEngine over an uninitialized "
                                    "Runner — call runner.init() first")
+            t0 = time.perf_counter()
             with tel.span("serve.dispatch", "serve", n=n, bucket=bucket):
                 ps_vals = self._snapshot()
                 placed = self._runner.remapper.remap_feed(host)
                 device_out = self._program(state, ps_vals, placed)
+            t1 = time.perf_counter()
             with tel.span("serve.readback", "serve", n=n, bucket=bucket):
                 fetched = self._runner.remapper.remap_fetch(device_out)
+            # per-request goodput buckets: the serving analog of the
+            # training decomposition — dispatch (program + snapshot +
+            # placement) vs readback (D2H) latency distributions, the
+            # third bucket (queue wait) observed by the micro-batcher
+            tel.hist_observe("serve.dispatch_ms",
+                             (t1 - t0) * 1e3)
+            tel.hist_observe("serve.readback_ms",
+                             (time.perf_counter() - t1) * 1e3)
             self.stats["batches"] += 1
         tel.counter_add("serve.batches")
         import jax

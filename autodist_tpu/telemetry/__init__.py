@@ -11,8 +11,18 @@ The runtime observability layer (docs/observability.md):
   the coordination service;
 - :mod:`~autodist_tpu.telemetry.drift` — measured-vs-predicted drift
   reports feeding ``simulator/calibration.py``;
+- :mod:`~autodist_tpu.telemetry.cluster` — NTP-style clock-offset
+  handshake over the coordination service (step-aligned merged
+  timelines) + the fleet-coordinated profiling flag;
+- :mod:`~autodist_tpu.telemetry.goodput` — attributed wall-time
+  decomposition (compute / collective-wait / PS-wire / host-input /
+  checkpoint / rollback-replay), cross-worker skew, straggler flagging;
+- :mod:`~autodist_tpu.telemetry.blackbox` — the always-on bounded
+  flight recorder, dumped atomically on divergence/rollback/breaker-open
+  and fatal signals;
 - ``python -m autodist_tpu.telemetry`` — inspect/merge/diff/validate
-  trace files, print drift tables.
+  trace files, print drift/goodput tables, read blackbox dumps, post
+  fleet profiling windows.
 """
 from autodist_tpu.telemetry.spans import (  # noqa: F401
     TraceRecorder, configure, counter_add, counters, current_span_id,
@@ -22,6 +32,15 @@ from autodist_tpu.telemetry.export import (  # noqa: F401
     scrape_cluster, validate_chrome_trace, write_trace)
 from autodist_tpu.telemetry.drift import (  # noqa: F401
     DriftReport, build_report, fit_calibration, report_for_runner)
+from autodist_tpu.telemetry.cluster import (  # noqa: F401
+    ClockOffset, ClockSyncResponder, estimate_clock_offset,
+    request_profile, step_alignment, sync_recorder_clock)
+from autodist_tpu.telemetry.goodput import (  # noqa: F401
+    GoodputReport, StragglerEwma, cluster_goodput)
+from autodist_tpu.telemetry.goodput import (  # noqa: F401
+    build_report as build_goodput_report)
+from autodist_tpu.telemetry.blackbox import (  # noqa: F401
+    FlightRecorder, get_flight_recorder)
 
 __all__ = [
     "TraceRecorder", "configure", "counter_add", "counters",
@@ -30,4 +49,9 @@ __all__ = [
     "chrome_trace", "merge_traces", "metrics_text", "publish_telemetry",
     "scrape_cluster", "validate_chrome_trace", "write_trace",
     "DriftReport", "build_report", "fit_calibration", "report_for_runner",
+    "ClockOffset", "ClockSyncResponder", "estimate_clock_offset",
+    "request_profile", "step_alignment", "sync_recorder_clock",
+    "GoodputReport", "StragglerEwma", "cluster_goodput",
+    "build_goodput_report",
+    "FlightRecorder", "get_flight_recorder",
 ]

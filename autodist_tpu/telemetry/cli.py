@@ -7,6 +7,12 @@ Subcommands:
 - ``diff A B``                per-span-name total-duration deltas
 - ``validate FILE``           schema check (exit 1 on violations)
 - ``drift REPORT.json``       pretty-print a saved DriftReport table
+- ``goodput FILE``            attributed wall-time buckets per process
+  (+ cross-worker skew/stragglers on a merged trace); FILE may also be
+  a saved GoodputReport json
+- ``blackbox DUMP.json``      pretty-print a flight-recorder dump
+- ``profile FIRST LAST``      post the fleet profiling flag on the
+  coordination service (``--clear`` withdraws it)
 """
 import argparse
 import json
@@ -114,6 +120,51 @@ def cmd_drift(args) -> int:
     return 0
 
 
+def cmd_goodput(args) -> int:
+    from autodist_tpu.telemetry import goodput as goodput_lib
+    with open(args.file) as f:
+        doc = json.load(f)
+    if "buckets" in doc and "traceEvents" not in doc:
+        # a saved GoodputReport json, not a trace
+        print(goodput_lib.GoodputReport.from_dict(doc).format_table())
+        return 0
+    cluster = goodput_lib.cluster_goodput(doc)
+    for pid, row in sorted(cluster["workers"].items()):
+        print("process %s (%s):" % (pid, row["label"]))
+        print(goodput_lib.GoodputReport.from_dict(row).format_table())
+    if len(cluster["workers"]) > 1:
+        print("cluster: skew_ratio=%s stragglers=%s"
+              % (cluster["skew_ratio"],
+                 [s["label"] for s in cluster["stragglers"]] or "none"))
+    return 0
+
+
+def cmd_blackbox(args) -> int:
+    from autodist_tpu.telemetry import blackbox as blackbox_lib
+    print(blackbox_lib.format_dump(blackbox_lib.load_dump(args.file)))
+    return 0
+
+
+def cmd_profile(args) -> int:
+    from autodist_tpu import const
+    from autodist_tpu.runtime.coordination import CoordinationClient
+    from autodist_tpu.telemetry import cluster as cluster_lib
+    client = CoordinationClient(args.host,
+                                args.port or const.ENV.ADT_COORDSVC_PORT.val)
+    try:
+        if args.clear:
+            cluster_lib.clear_profile(client)
+            print("fleet profiling flag cleared")
+            return 0
+        seq = cluster_lib.request_profile(client, args.first, args.last)
+        print("fleet profiling window #%d posted: steps %d..%d "
+              "(every polling worker captures a jax.profiler trace)"
+              % (seq, args.first, args.last))
+        return 0
+    finally:
+        client.close()
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m autodist_tpu.telemetry",
@@ -136,5 +187,26 @@ def main(argv=None) -> int:
     p = sub.add_parser("drift", help="print a saved drift-report table")
     p.add_argument("file")
     p.set_defaults(fn=cmd_drift)
+    p = sub.add_parser("goodput",
+                       help="attributed wall-time buckets of a trace "
+                            "(per process + cluster skew) or a saved "
+                            "goodput report")
+    p.add_argument("file")
+    p.set_defaults(fn=cmd_goodput)
+    p = sub.add_parser("blackbox",
+                       help="pretty-print a flight-recorder dump")
+    p.add_argument("file")
+    p.set_defaults(fn=cmd_blackbox)
+    p = sub.add_parser("profile",
+                       help="post the fleet profiling flag "
+                            "(steps FIRST..LAST) on the coordination "
+                            "service")
+    p.add_argument("first", type=int, nargs="?", default=0)
+    p.add_argument("last", type=int, nargs="?", default=0)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--clear", action="store_true",
+                   help="withdraw the flag instead")
+    p.set_defaults(fn=cmd_profile)
     args = parser.parse_args(argv)
     return args.fn(args)

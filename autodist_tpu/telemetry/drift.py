@@ -92,6 +92,10 @@ class DriftReport:
     collectives: List[CollectiveDrift]
     breakdown: dict                      # CostBreakdown fields, serialized
     counters: Dict[str, float]
+    # attributed wall-time buckets (telemetry/goodput.py) the term rows
+    # were joined against — None when the recorder had no decomposable
+    # spans (tracing off / sampled)
+    goodput: Optional[dict] = None
 
     @property
     def step_ratio(self) -> Optional[float]:
@@ -113,6 +117,7 @@ class DriftReport:
             "collectives": [c.to_dict() for c in self.collectives],
             "breakdown": self.breakdown,
             "counters": self.counters,
+            "goodput": self.goodput,
         }
 
     @classmethod
@@ -132,7 +137,8 @@ class DriftReport:
                                          c["measured_wire_bytes"])
                          for c in d.get("collectives", [])],
             breakdown=d.get("breakdown", {}),
-            counters=d.get("counters", {}))
+            counters=d.get("counters", {}),
+            goodput=d.get("goodput"))
 
     def save(self, path: str) -> str:
         import os
@@ -199,10 +205,25 @@ def build_report(cost_model, strategy,
     ps_total = sum(sum(rec.durations_s(n)) for n in PS_SPANS)
     measured_ps = (ps_total / num_steps) if num_steps and ps_total else None
 
+    # ATTRIBUTED time (telemetry/goodput.py): the self-time decomposition
+    # splits each dispatch into compute vs nested wait/wire buckets, so
+    # calibration consumes per-term measurements instead of fitting every
+    # coefficient against one total — the compute term gets the dispatch
+    # self time, the collective term the barrier/backoff wait
+    from autodist_tpu.telemetry import goodput as goodput_lib
+    gp = goodput_lib.build_report(rec) if num_steps else None
+    if gp is not None and (gp.wall_s <= 0 or gp.approximate):
+        gp = None  # sampled/empty traces cannot be decomposed honestly
+    measured_compute = (gp.buckets["compute"] / num_steps
+                        if gp is not None else None)
+    measured_wait = (gp.buckets["collective_wait"] / num_steps
+                     if gp is not None and gp.buckets["collective_wait"] > 0
+                     else None)
+
     terms = [
         TermDrift("step", breakdown.step_time_s, measured_step),
-        TermDrift("compute", breakdown.compute_s, None),
-        TermDrift("allreduce", breakdown.allreduce_s, None),
+        TermDrift("compute", breakdown.compute_s, measured_compute),
+        TermDrift("allreduce", breakdown.allreduce_s, measured_wait),
         TermDrift("ps", breakdown.ps_s, measured_ps),
         TermDrift("mp", breakdown.mp_s, None),
         TermDrift("latency", breakdown.latency_s, None),
@@ -228,7 +249,8 @@ def build_report(cost_model, strategy,
         collectives=collectives,
         breakdown={f.name: getattr(breakdown, f.name)
                    for f in dataclasses.fields(breakdown)},
-        counters=counters)
+        counters=counters,
+        goodput=gp.to_dict() if gp is not None else None)
     logging.info("drift report [%s]: predicted=%.6gs measured=%s over %d "
                  "dispatches", report.strategy_id, report.predicted_step_s,
                  "%.6gs" % measured_step if measured_step is not None

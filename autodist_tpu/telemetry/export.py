@@ -46,10 +46,14 @@ def chrome_trace(recorder: Optional[spans_lib.TraceRecorder] = None,
     for tid, tname in sorted(rec.thread_names().items()):
         events.append({"ph": "M", "name": "thread_name", "pid": pid,
                        "tid": tid, "args": {"name": tname}})
-    # re-base the monotonic span clocks onto the wall clock so traces
-    # published by different processes/hosts merge onto ONE comparable
-    # timeline (perf_counter_ns origins are arbitrary per process)
-    epoch = getattr(rec, "epoch_offset_ns", 0)
+    # re-base the monotonic span clocks onto the wall clock — PLUS the
+    # cluster clock-offset correction (telemetry/cluster.py handshake)
+    # when one was estimated — so traces published by different
+    # processes/hosts merge onto ONE step-aligned timeline
+    # (perf_counter_ns origins are arbitrary per process; wall clocks
+    # disagree across hosts)
+    epoch = (getattr(rec, "epoch_offset_ns", 0)
+             + getattr(rec, "clock_offset_ns", 0))
     # counters-only export (tracing disabled — the always-on registry
     # mode): the C samples must still land at wall-clock NOW, not 1970,
     # or a merged scrape mixes timebases 56 years apart
@@ -85,6 +89,8 @@ def chrome_trace(recorder: Optional[spans_lib.TraceRecorder] = None,
         "otherData": {
             "host": rec.host, "pid": pid,
             "dropped_events": rec.dropped_events,
+            "clock_offset_ns": getattr(rec, "clock_offset_ns", 0),
+            "clock_error_ns": getattr(rec, "clock_error_ns", None),
             "counters": rec.counters(),
             "gauges": rec.gauges(),
         },
@@ -192,28 +198,54 @@ def _metric_name(name: str) -> str:
     return "adt_" + _METRIC_RE.sub("_", name)
 
 
+def _escape_label_value(value: str) -> str:
+    """Prometheus exposition-format label escaping: backslash, double
+    quote and newline must be escaped or a strict scraper rejects the
+    whole exposition (worker names and host labels are caller data)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _help_text(name: str, kind: str) -> str:
+    """One-line HELP for a registry entry. Metric names are the
+    ``<subsystem>.<operation>`` taxonomy (docs/observability.md), so the
+    help derives from the name — a curated per-metric string registry
+    would drift the moment a counter is added anywhere else."""
+    sub, _, op = name.partition(".")
+    return ("autodist_tpu %s %r of subsystem %r (registry key %r)"
+            % (kind, op or sub, sub, name))
+
+
 def metrics_text(recorder: Optional[spans_lib.TraceRecorder] = None,
                  labels: Optional[Dict[str, str]] = None) -> str:
-    """Prometheus-style text exposition of the registry: counters as
-    ``adt_<name>_total``, gauges as ``adt_<name>``; ``labels`` (e.g.
-    ``{"worker": "w0"}``) attach to every sample — the scrape merge uses
-    them to keep per-worker series distinct."""
+    """Prometheus text exposition of the registry: counters as
+    ``adt_<name>_total``, gauges as ``adt_<name>``, each with ``# HELP``
+    + ``# TYPE`` headers; ``labels`` (e.g. ``{"worker": "w0"}``) attach
+    to every sample — the scrape merge uses them to keep per-worker
+    series distinct. Label values are escaped per the exposition format
+    (backslash/quote/newline), so arbitrary worker/host names survive a
+    strict scraper."""
     rec = recorder if recorder is not None else spans_lib.get_recorder()
     lbl = ""
     if labels:
-        lbl = "{%s}" % ",".join('%s="%s"' % (k, v)
-                                for k, v in sorted(labels.items()))
+        lbl = "{%s}" % ",".join(
+            '%s="%s"' % (k, _escape_label_value(v))
+            for k, v in sorted(labels.items()))
     lines: List[str] = []
     for name, val in sorted(rec.counters().items()):
         mname = _metric_name(name) + "_total"
+        lines.append("# HELP %s %s" % (mname, _help_text(name, "counter")))
         lines.append("# TYPE %s counter" % mname)
         lines.append("%s%s %s" % (mname, lbl, _fmt_value(val)))
     for name, val in sorted(rec.gauges().items()):
         mname = _metric_name(name)
+        lines.append("# HELP %s %s" % (mname, _help_text(name, "gauge")))
         lines.append("# TYPE %s gauge" % mname)
         lines.append("%s%s %s" % (mname, lbl, _fmt_value(val)))
     for name, h in sorted(rec.histograms().items()):
         mname = _metric_name(name)
+        lines.append("# HELP %s %s" % (mname,
+                                       _help_text(name, "histogram")))
         lines.append("# TYPE %s histogram" % mname)
         # Prometheus histogram exposition: cumulative bucket counts with
         # an ``le`` label (the extra label merges with the caller's), a
@@ -253,6 +285,14 @@ def publish_telemetry(client, worker: str,
         version = next(rec._publish_seq)
     payload = {
         "worker": worker, "host": rec.host, "pid": rec.pid,
+        # reference-corrected publish stamp: the scraper derives per-
+        # worker scrape AGE from it, so the clock offset must already be
+        # applied or a skewed host reads permanently stale (or from the
+        # future)
+        "published_at": (time.time()
+                         + getattr(rec, "clock_offset_ns", 0) / 1e9),
+        "clock": {"offset_ns": getattr(rec, "clock_offset_ns", 0),
+                  "error_ns": getattr(rec, "clock_error_ns", None)},
         "trace": chrome_trace(rec, label="%s (%s:%d)"
                               % (worker, rec.host, rec.pid)),
         "metrics": rec.counters(),
@@ -277,7 +317,12 @@ def scrape_cluster(client, workers: Iterable[str]) -> dict:
     """Coordinator-side scrape: fetch every worker's published blob,
     merge the traces into one multi-track timeline and the registries
     into one labeled exposition. Workers that have not published are
-    listed in ``missing`` (a scrape must not block on a dead worker)."""
+    listed in ``missing`` — and counted in the ``cluster.workers_missing``
+    gauge (set on the local registry AND emitted in the returned
+    exposition) so a dashboard can alert on silent workers instead of
+    diffing lists. ``scrape_age_s`` carries each worker's publish age
+    (reference-clock corrected), the freshness signal per worker; a
+    scrape never blocks on a dead worker."""
     blobs, missing = {}, []
     for w in workers:
         payload = fetch_telemetry(client, w)
@@ -286,6 +331,11 @@ def scrape_cluster(client, workers: Iterable[str]) -> dict:
         else:
             blobs[w] = payload
     trace = merge_traces([p["trace"] for p in blobs.values()])
+    now = time.time()
+    ages = {w: (round(max(now - p["published_at"], 0.0), 3)
+                if p.get("published_at") else None)
+            for w, p in blobs.items()}
+    clocks = {w: p.get("clock", {}) for w, p in blobs.items()}
     texts = []
     for w, p in sorted(blobs.items()):
         shadow = spans_lib.TraceRecorder(capacity=1, pid=p["pid"],
@@ -296,5 +346,29 @@ def scrape_cluster(client, workers: Iterable[str]) -> dict:
             n: spans_lib.Histogram.from_dict(d)
             for n, d in p.get("histograms", {}).items()}
         texts.append(metrics_text(shadow, labels={"worker": w}))
+    # coordinator-side cluster gauges: appended to the exposition (a
+    # scraper sees them next to the per-worker series) AND set on the
+    # local registry (step_stats/bench readers see them without parsing
+    # text)
+    spans_lib.gauge_set("cluster.workers_missing", float(len(missing)))
+    spans_lib.counter_add("cluster.scrapes")
+    cluster_lines = [
+        "# HELP adt_cluster_workers_missing workers that never published "
+        "a telemetry blob this scrape",
+        "# TYPE adt_cluster_workers_missing gauge",
+        "adt_cluster_workers_missing %d" % len(missing)]
+    age_samples = [
+        'adt_cluster_scrape_age_seconds{worker="%s"} %s'
+        % (_escape_label_value(w), _fmt_value(ages[w]))
+        for w in sorted(ages) if ages[w] is not None]
+    if age_samples:
+        cluster_lines.append(
+            "# HELP adt_cluster_scrape_age_seconds age of each "
+            "worker's latest published blob (reference clock)")
+        cluster_lines.append(
+            "# TYPE adt_cluster_scrape_age_seconds gauge")
+        cluster_lines.extend(age_samples)
+    texts.append("\n".join(cluster_lines) + "\n")
     return {"trace": trace, "metrics_text": "".join(texts),
-            "workers": sorted(blobs), "missing": missing}
+            "workers": sorted(blobs), "missing": missing,
+            "scrape_age_s": ages, "clocks": clocks}

@@ -136,6 +136,8 @@ DEFAULT_COUNTERS = (
     "search.candidates", "search.pruned",
     "serve.requests", "serve.batches", "serve.compiles",
     "serve.padded_rows", "serve.degraded", "serve.shed",
+    "telemetry.straggler_flags", "blackbox.dumps", "profiler.windows",
+    "cluster.scrapes",
 )
 
 
@@ -244,6 +246,14 @@ class TraceRecorder:
         # from different hosts/processes can only merge onto one timeline
         # after re-basing onto the wall clock (export adds this offset)
         self.epoch_offset_ns = time.time_ns() - time.perf_counter_ns()
+        # cross-host correction on TOP of the wall clock: hosts disagree
+        # by ms (NTP) to seconds (unsynced fleets), the same order as a
+        # training step. telemetry/cluster.py's NTP-style handshake fills
+        # these in (offset ADDS local→reference; error is the ± bound the
+        # estimator reports), and export applies them so a merged scrape
+        # is step-aligned across workers.
+        self.clock_offset_ns = 0
+        self.clock_error_ns: Optional[int] = None
         self._counters: Dict[str, float] = dict.fromkeys(DEFAULT_COUNTERS,
                                                          0.0)
         self._gauges: Dict[str, float] = {}

@@ -571,10 +571,14 @@ def _smoke_search(loss_fn, params, batch):
 def _smoke_telemetry():
     """Trace export + phase breakdown for the smoke result (ADT_TRACE=1).
     Per-subsystem total seconds come from the recorded span categories,
-    so a BENCH reader sees WHERE the smoke wall time went (dispatch vs
-    PS vs readback vs checkpoint) instead of one opaque loop time."""
+    and the ATTRIBUTED goodput buckets (telemetry/goodput.py self-time
+    decomposition: compute / collective-wait / PS-wire / host-input /
+    readback / checkpoint / rollback-replay) ride beside them, so a
+    BENCH reader sees WHERE the smoke wall time went — per bucket, with
+    the buckets summing to the recorded wall time — plus the straggler
+    summary (EWMA flags + last z), not just ex/s and MFU."""
     from autodist_tpu import const
-    from autodist_tpu.telemetry import export, spans
+    from autodist_tpu.telemetry import export, goodput, spans
     if not spans.tracing_enabled():
         return {}
     rec = spans.get_recorder()
@@ -585,8 +589,19 @@ def _smoke_telemetry():
         agg["total_s"] = round(agg["total_s"] + row["total_s"], 6)
     path = (const.ENV.ADT_TRACE_FILE.val
             or os.path.join(const.DEFAULT_TRACE_DIR, "smoke-trace.json"))
+    gp = goodput.build_report(rec)
+    # attributed buckets land INSIDE phase_breakdown (the r06+ trajectory
+    # key) plus the full report (wall/coverage/dispatch stats) beside it
+    by_cat["attributed"] = {k: round(v, 6) for k, v in gp.buckets.items()}
+    counters = rec.counters()
+    gauges = rec.gauges()
     out = {"phase_breakdown": by_cat,
-           "telemetry_counters": {k: v for k, v in rec.counters().items()
+           "goodput": gp.to_dict(),
+           "straggler": {
+               "flags": counters.get("telemetry.straggler_flags", 0.0),
+               "gauge_z": gauges.get("telemetry.straggler"),
+           },
+           "telemetry_counters": {k: v for k, v in counters.items()
                                   if v}}
     try:
         export.write_trace(path)

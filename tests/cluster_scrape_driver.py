@@ -1,0 +1,142 @@
+"""CI driver: 2-process scrape -> ONE step-aligned merged cluster trace.
+
+Usage (the per-PR CI leg)::
+
+    python tests/cluster_scrape_driver.py /tmp/cluster-trace.json [port]
+
+The chief starts the real coordination service + a ClockSyncResponder,
+then spawns two WORKER processes (this same file with ``--worker``).
+Each worker simulates a skewed host clock (worker w1 runs 2s ahead:
+its recorder's wall-clock anchor AND its handshake clock both carry the
+skew), estimates its offset over the real wire, records barrier-aligned
+``runner.dispatch`` spans with global ``step`` args, and publishes its
+telemetry blob. The chief scrapes, merges, validates, and ASSERTS:
+
+- no worker missing, per-worker scrape ages present;
+- the merged trace is schema-valid;
+- ``step_alignment``: every step's cross-worker start spread is within
+  tolerance — i.e. the 2s injected skew was corrected by the handshake
+  (uncorrected, the spread would BE the 2s skew);
+- per-process goodput reports decompose (buckets sum to wall).
+
+Exit 0 = all assertions hold; the merged trace lands at argv[1] for the
+artifact upload.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+SKEWS_NS = {"w0": 0, "w1": 2_000_000_000}
+STEPS = 4
+ALIGN_TOLERANCE_US = 300_000  # 300ms >> rpc latency, << the 2s skew
+
+
+def worker_main(name: str, port: int, skew_ns: int) -> int:
+    from autodist_tpu.runtime.coordination import CoordinationClient
+    from autodist_tpu.telemetry import cluster, export
+    from autodist_tpu.telemetry import spans as tel
+
+    tel.configure("1")
+    rec = tel.get_recorder()
+    # simulate a host whose wall clock runs `skew_ns` ahead: the
+    # recorder's wall anchor and the handshake's clock source must agree
+    rec.epoch_offset_ns += skew_ns
+    client = CoordinationClient("127.0.0.1", port)
+    est = cluster.sync_recorder_clock(
+        client, name, clock=lambda: time.time_ns() + skew_ns)
+    # the estimator must have seen (and cancelled) the skew
+    assert abs(est.offset_ns + skew_ns) <= max(est.error_ns, 100_000_000), \
+        "worker %s: offset %d did not cancel skew %d (err %d)" \
+        % (name, est.offset_ns, skew_ns, est.error_ns)
+    for step in range(STEPS):
+        # the barrier aligns both workers in TRUE time, so the merged
+        # trace's per-step spread measures clock correction, not drift
+        client.barrier("clockstep-%d" % step, 2)
+        with tel.span("runner.dispatch", "runner", step=step,
+                      microsteps=1):
+            time.sleep(0.02)
+    export.publish_telemetry(client, name)
+    client.close()
+    return 0
+
+
+def chief_main(out_path: str, port: int) -> int:
+    from autodist_tpu.runtime.coordination import (CoordinationClient,
+                                                   CoordinationServer)
+    from autodist_tpu.telemetry import cluster, export, goodput
+
+    srv = CoordinationServer(port=port)
+    srv.start()
+    responder_client = CoordinationClient("127.0.0.1", port)
+    responder = cluster.ClockSyncResponder(responder_client).start()
+    procs = []
+    try:
+        for name, skew in SKEWS_NS.items():
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--worker",
+                 name, str(port), str(skew)],
+                env=dict(os.environ, JAX_PLATFORMS="cpu")))
+        for p in procs:
+            assert p.wait(timeout=120) == 0, "worker exited %d" % p.returncode
+        client = CoordinationClient("127.0.0.1", port)
+        scraped = export.scrape_cluster(client, list(SKEWS_NS))
+        client.close()
+        assert scraped["missing"] == [], scraped["missing"]
+        assert scraped["workers"] == sorted(SKEWS_NS)
+        for w in SKEWS_NS:
+            assert scraped["scrape_age_s"][w] is not None
+        # w1's published clock metadata must carry its estimated offset
+        assert abs(scraped["clocks"]["w1"]["offset_ns"]
+                   + SKEWS_NS["w1"]) <= 100_000_000
+        trace = scraped["trace"]
+        errors = export.validate_chrome_trace(trace)
+        assert not errors, errors
+        align = cluster.step_alignment(trace)
+        assert align["aligned_steps"] == STEPS, align
+        assert align["max_spread_us"] < ALIGN_TOLERANCE_US, (
+            "steps NOT aligned: max spread %.1fms (injected skew was "
+            "%.1fms — the offset correction failed)"
+            % (align["max_spread_us"] / 1e3, SKEWS_NS["w1"] / 1e6))
+        # per-process goodput decomposes on the merged trace
+        for pid, report in goodput.report_from_trace(trace).items():
+            assert report.num_dispatches == STEPS, (pid, report.to_dict())
+            assert abs(report.coverage - 1.0) < 0.02, report.to_dict()
+        os.makedirs(os.path.dirname(os.path.abspath(out_path)),
+                    exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(trace, f)
+        print("cluster scrape OK: %d workers, %d aligned steps, max "
+              "spread %.2fms (injected skew %.0fms), trace -> %s"
+              % (len(SKEWS_NS), align["aligned_steps"],
+                 align["max_spread_us"] / 1e3, SKEWS_NS["w1"] / 1e6,
+                 out_path))
+        print("metrics exposition tail:\n"
+              + "\n".join(scraped["metrics_text"].splitlines()[-6:]))
+        return 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        responder.stop()
+        try:
+            responder_client.close()
+        except OSError:
+            pass
+        srv.stop()
+
+
+def main(argv) -> int:
+    if argv and argv[0] == "--worker":
+        return worker_main(argv[1], int(argv[2]), int(argv[3]))
+    out = argv[0] if argv else "/tmp/adt-cluster-trace.json"
+    port = int(argv[1]) if len(argv) > 1 else 15909
+    return chief_main(out, port)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

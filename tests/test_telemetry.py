@@ -306,6 +306,43 @@ def test_metrics_text_prometheus_shape():
     assert 'adt_g{worker="w9"} 1.5' in text
 
 
+def test_metrics_text_emits_help_lines():
+    """Strict scrapers want # HELP before # TYPE for every metric —
+    counters, gauges AND histograms."""
+    rec = tel.TraceRecorder(capacity=4, sample=1, pid=1, host="h")
+    rec.gauge_set("prefetch.queue_depth", 2)
+    rec.hist_observe("serve.latency_ms", 1.0)
+    lines = export.metrics_text(rec).splitlines()
+    assert "# HELP adt_runner_steps_total" \
+        in {ln.rsplit(" autodist_tpu", 1)[0] for ln in lines
+            if ln.startswith("# HELP")}
+    # every TYPE line is immediately preceded by its HELP line
+    for i, ln in enumerate(lines):
+        if ln.startswith("# TYPE "):
+            mname = ln.split()[2]
+            assert lines[i - 1].startswith("# HELP %s " % mname), ln
+    assert any(ln.startswith("# HELP adt_serve_latency_ms ")
+               for ln in lines)
+    assert any(ln.startswith("# HELP adt_prefetch_queue_depth ")
+               for ln in lines)
+
+
+def test_metrics_text_escapes_label_values():
+    """Label values with backslash/quote/newline must escape per the
+    exposition format or a strict scraper rejects the whole page."""
+    rec = tel.TraceRecorder(capacity=4, sample=1, pid=1, host="h")
+    rec.counter_add("a.b", 1)
+    text = export.metrics_text(rec, labels={"worker": 'w"1\\x\nend'})
+    assert 'worker="w\\"1\\\\x\\nend"' in text
+    assert "\nadt_a_b_total{" in text  # the raw newline never leaked
+    sample = next(ln for ln in text.splitlines()
+                  if ln.startswith("adt_a_b_total"))
+    # one line, and every quote inside the value is escaped: exactly the
+    # two delimiter quotes remain unescaped
+    import re
+    assert len(re.findall(r'(?<!\\)"', sample)) == 2
+
+
 # --------------------------------------------------- instrumented runtime
 
 
