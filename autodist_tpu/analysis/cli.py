@@ -142,6 +142,8 @@ def _builders(mp_rules):
         "PartitionedPS": lambda: S.PartitionedPS(),
         "UnevenPartitionedPS": lambda: S.UnevenPartitionedPS(),
         "AllReduce": lambda: S.AllReduce(),
+        "AllReduceInt8Wire": lambda: S.AllReduce(wire_dtype="int8"),
+        "PSInt8Wire": lambda: S.PS(wire_dtype="int8"),
         "PartitionedAR": lambda: S.PartitionedAR(),
         "RandomAxisPartitionAR": lambda: S.RandomAxisPartitionAR(),
         "Parallax": lambda: S.Parallax(),

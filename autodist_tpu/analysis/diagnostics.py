@@ -177,6 +177,8 @@ CODES = {
     "ADT307": "async PS plan is not all-or-nothing",
     "ADT308": "PowerSGD on a sub-matrix tensor passes through",
     "ADT309": "sparse variable on a dense-only synchronization path",
+    "ADT310": "wire_dtype quantization on an incompatible variable or path",
+    "ADT311": "quantized variable smaller than one scale block",
     # ADT4xx — runtime hazards
     "ADT401": "pipeline bubble dominates the schedule",
     "ADT402": "invalid pipeline schedule configuration",

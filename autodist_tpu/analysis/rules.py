@@ -532,6 +532,90 @@ def _r_sparse_dense_path(ctx: Context) -> Iterable[Diagnostic]:
                       "engages")
 
 
+@rule
+def _r_wire_dtype(ctx: Context) -> Iterable[Diagnostic]:
+    """Quantized-wire (``wire_dtype="int8"``) validity: the blockwise
+    int8 codec only exists for dense float payloads on wires the lowering
+    actually quantizes — sparse (ids, values) pairs have no absmax
+    blocks, integer values no scale, and the partitioned / proxied /
+    model-parallel paths never cross the quantized wire. A variable
+    smaller than one scale block pays more sidecar than it saves
+    (ADT311)."""
+    from autodist_tpu.parallel.collectives import wire_block_size
+    block = wire_block_size()
+    for node in ctx.strategy.node_config:
+        info_ = ctx.var_infos.get(node.var_name)
+        for owner, sync in ctx.synchronizers(node):
+            wd = getattr(sync, "wire_dtype", "fp32") or "fp32"
+            if wd == "fp32":
+                continue
+            if wd != "int8":
+                yield error(
+                    "ADT310",
+                    "unknown wire_dtype %r (allowed: fp32, int8)" % wd,
+                    var=owner, fixit="use wire_dtype='int8' or drop it")
+                continue
+            if info_ is not None and getattr(info_, "sparse", False):
+                yield error(
+                    "ADT310",
+                    "wire_dtype=int8 on a sparse variable — its gradient "
+                    "ships as (ids, values) pairs, which the blockwise "
+                    "codec cannot quantize", var=owner,
+                    fixit="drop wire_dtype; the sparse wire is already "
+                          "batch-sized")
+                continue
+            if info_ is not None and not str(
+                    getattr(info_, "dtype", "float32")).startswith(
+                        ("float", "bfloat")):
+                yield error(
+                    "ADT310",
+                    "wire_dtype=int8 on dtype %s — absmax scaling only "
+                    "exists for float payloads" % info_.dtype, var=owner,
+                    fixit="drop wire_dtype on integer variables")
+                continue
+            comp = getattr(sync, "compressor", "") or ""
+            if _is_ar(sync) and comp and comp != "NoneCompressor":
+                yield error(
+                    "ADT310",
+                    "wire_dtype=int8 conflicts with compressor %s — the "
+                    "wire codec and the gradient compressor both own the "
+                    "payload transform" % comp, var=owner,
+                    fixit="keep one: wire_dtype='int8' (blockwise wire "
+                          "codec) or the compressor")
+                continue
+            if _is_ar(sync) and node.partitioner:
+                yield warning(
+                    "ADT310",
+                    "wire_dtype=int8 is ignored — partitioned variables "
+                    "sync via reduce-scatter, which the wire codec does "
+                    "not cover", var=owner,
+                    fixit="drop the partitioner or the wire_dtype")
+                continue
+            if node.mp_axes:
+                yield warning(
+                    "ADT310",
+                    "wire_dtype=int8 is ignored — model-parallel "
+                    "gradients reduce uncompressed over the complement "
+                    "axes", var=owner)
+                continue
+            if _is_ps(sync) and sync.local_replication:
+                yield warning(
+                    "ADT310",
+                    "wire_dtype=int8 is ignored — a proxied PS variable "
+                    "is device-resident, no host wire exists", var=owner,
+                    fixit="set local_replication=False for the host wire")
+                continue
+            if info_ is not None and info_.num_elements < block:
+                yield warning(
+                    "ADT311",
+                    "quantizing a %d-element variable with %d-element "
+                    "scale blocks: the padded block + f32 sidecar "
+                    "outweighs the int8 saving"
+                    % (info_.num_elements, block), var=owner,
+                    fixit="keep variables smaller than one block "
+                          "(ADT_WIRE_BLOCK=%d) on the fp32 wire" % block)
+
+
 # ------------------------------------------------------------- ADT4xx rules
 
 

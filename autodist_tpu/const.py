@@ -156,6 +156,12 @@ class ENV(Enum):
     # (0 = auto: min(4, cpu_count); 1 = the single-dispatch baseline).
     # Bit-exact either way — grouping never changes per-shard math.
     ADT_PS_APPLY_THREADS = ("ADT_PS_APPLY_THREADS", int, 0)
+    # quantized-wire scale-block size (parallel/collectives.py): elements
+    # per absmax-scale block for the int8 wire codec (wire_dtype="int8" /
+    # Int8 compressors). Smaller blocks = tighter scales but a bigger f32
+    # sidecar: payload bytes per element = 1 + 4/block. 256 keeps the
+    # sidecar under 2% while bounding each block's quantization range.
+    ADT_WIRE_BLOCK = ("ADT_WIRE_BLOCK", int, 256)
     # ---- runtime telemetry (telemetry/spans.py; docs/observability.md)
     # span tracing mode: "0" off (counters still collected), "1" record
     # every span, "sampled" record 1/ADT_TRACE_SAMPLE spans

@@ -129,6 +129,21 @@ class DistributedStep:
         # honest "host round-trips per training job" number bench and the
         # fused-parity tests assert on
         self.dispatches = 0
+        # static per-microstep quantized-AR wire bytes (int8 payload +
+        # scale sidecar, and their fp32 equivalent) from the lowering —
+        # credited to the wire.* counters at each dispatch
+        self._wire_q_step = float(
+            self.metadata.get("wire_quant_bytes_per_step", 0.0))
+        self._wire_fp_step = float(
+            self.metadata.get("wire_fp32_bytes_per_step", 0.0))
+
+    def _count_wire(self, microsteps: int = 1) -> None:
+        if self._wire_q_step:
+            tel.counter_add("wire.bytes_quantized",
+                            self._wire_q_step * microsteps)
+            tel.counter_add("wire.bytes_saved",
+                            (self._wire_fp_step - self._wire_q_step)
+                            * microsteps)
 
     # ---------------------------------------------------------- ps data path
 
@@ -234,8 +249,11 @@ class DistributedStep:
                 tel.counter_add("dstep.ps_pulls")
                 self.flush_ps()
                 from autodist_tpu.parallel.mesh import tree_to_mesh
+                # raw (unquantized) carry: the scan body applies the wire
+                # codec per microstep itself, so the fused numerics match
+                # the per-step quantized loop
                 self._fused_ps_vals = tree_to_mesh(
-                    self.mesh, self.ps_store.pull(), P())
+                    self.mesh, self.ps_store.pull(wire=False), P())
                 self._fused_ps_opt = tree_to_mesh(
                     self.mesh,
                     {n: self.ps_store.full_little_opt(n)
@@ -341,6 +359,7 @@ class DistributedStep:
                 self._fused_ps_dirty = True
             self.dispatches += 1
             tel.counter_add("dstep.dispatches")
+            self._count_wire(next(iter(lead), 1))
             return new_state, metrics
 
     def close_ps(self) -> None:
@@ -375,6 +394,7 @@ class DistributedStep:
             self._push_ps(ps_grads, ok=ok)
             self.dispatches += 1
             tel.counter_add("dstep.dispatches")
+            self._count_wire()
             return new_state, metrics
 
     def evaluate(self, state: TrainState, batch, ps_vals=None):
@@ -445,23 +465,34 @@ class DistributedStep:
         except Exception as e:  # noqa: BLE001 — diagnostics must not break runs
             logging.warning("snapshot_lowered failed: %s", e)
 
-    def _ps_avals(self, with_opt: bool = False):
+    def _ps_avals(self, with_opt: bool = False, wire: bool = True):
         """(value avals, little-tree optimizer-state avals) for the
         host-resident PS vars — lowering inputs that must not cost a real
         pull. The opt avals (one ``optimizer.init`` trace per var) are
         only materialized when asked for — the per-step lowering path
-        never consumes them."""
+        never consumes them. ``wire=True`` mirrors the step path's entry
+        structure (quantized vars enter as their {"q", "s"} containers);
+        the fused program's carry is raw f32 (``wire=False``)."""
         if self.ps_store is None:
             return {}, {}
         infos = self.model_item.var_infos
-        ps_avals = {n: jax.ShapeDtypeStruct(tuple(infos[n].shape),
-                                            np.dtype(infos[n].dtype))
-                    for n in self.ps_store.var_names}
+        raw_avals = {n: jax.ShapeDtypeStruct(tuple(infos[n].shape),
+                                             np.dtype(infos[n].dtype))
+                     for n in self.ps_store.var_names}
         opt_avals = {}
         if with_opt:
             opt_avals = {n: jax.eval_shape(
                 lambda a: self.model_item.optimizer.init({"v": a}), aval)
-                for n, aval in ps_avals.items()}
+                for n, aval in raw_avals.items()}
+        ps_avals = raw_avals
+        if wire:
+            quant = set(self.metadata.get("ps_wire_int8", ()))
+            if quant:
+                from autodist_tpu.parallel import collectives
+                ps_avals = {
+                    n: (collectives.wire_avals(tuple(infos[n].shape))
+                        if n in quant else a)
+                    for n, a in raw_avals.items()}
         return ps_avals, opt_avals
 
     def lowered_text(self, state: TrainState, batch, fuse_steps: int = 1,
@@ -488,7 +519,7 @@ class DistributedStep:
                   else self._step_fn_nodonate)
             return fn.lower(state, ps_avals, batch).as_text()
         if fuse_steps > 1:
-            ps_avals, opt_avals = self._ps_avals(with_opt=True)
+            ps_avals, opt_avals = self._ps_avals(with_opt=True, wire=False)
             return self._fused_fn(donate=donate).lower(
                 state, ps_avals, opt_avals, batch).as_text()
         ps_avals, _ = self._ps_avals()
@@ -890,6 +921,13 @@ class GraphTransformer:
         # (possibly uneven) shard sizes instead of the padded device split.
         ps_plans = ps_lib.plan_host_ps(self._strategy, var_infos)
         ps_names = frozenset(ps_plans)
+        # host-PS vars on the quantized wire (PSVarPlan.wire_dtype, guarded
+        # to dense float by plan_host_ps): their pulled values enter the
+        # step as {"q", "s"} int8+scales containers (dequantized in-graph)
+        # and their reduced gradients exit the same way (dequantized at the
+        # store boundary) — the PCIe wire carries ~1/4 the bytes
+        ps_quant = frozenset(n for n, p in ps_plans.items()
+                             if p.wire_dtype == "int8")
         if ps_plans:
             # the host store applies the optimizer PER VARIABLE (one
             # little {"v": shard} tree each). A structure-sensitive
@@ -1214,7 +1252,15 @@ class GraphTransformer:
                     bad_g_local += bad
             for n in sorted(ps_grads):
                 gv = ps_grads[n]
-                vals = gv[1] if isinstance(gv, tuple) else gv
+                if isinstance(gv, dict):
+                    # wire-quantized PS grad: judge the dequantized image
+                    # (what the store will apply). A NaN gradient poisons
+                    # its block scales by construction, so the nonfinite
+                    # count still fires.
+                    vals = collectives.dequant_wire(
+                        gv, tuple(var_infos[n].shape))
+                else:
+                    vals = gv[1] if isinstance(gv, tuple) else gv
                 sq, bad = _stats(vals)
                 local_sq += sq
                 bad_g_local += bad
@@ -1243,7 +1289,22 @@ class GraphTransformer:
             return {"ok": ok.astype(jnp.int32), "grad_norm": grad_norm,
                     "bad_grads": bad_g, "bad_params": bad_p}
 
+        def _ps_dewire(ps_vals):
+            """Quantized PS values arrive as {"q", "s"} wire containers
+            (that is what crossed PCIe); dequantize in-graph before the
+            loss sees them — the device-side half of the store-boundary
+            codec."""
+            if not ps_quant:
+                return ps_vals
+            out = dict(ps_vals)
+            for n in ps_quant:
+                info = var_infos[n]
+                out[n] = collectives.dequant_wire(
+                    out[n], tuple(info.shape), np.dtype(info.dtype))
+            return out
+
         def local_step(state: TrainState, ps_vals, batch):
+            ps_vals = _ps_dewire(ps_vals)
             gathered = _tree_map_layouts(
                 lambda leaf, lay: lay.gather_full(leaf), state.params, layout_tree)
             # host-resident PS values arrive pulled + replicated; fill the
@@ -1292,6 +1353,11 @@ class GraphTransformer:
                     ps_grads[n] = g[n]
                 else:
                     ps_grads[n] = jax.lax.psum(g[n], all_axes) / N
+                if n in ps_quant:
+                    # quantize ON DEVICE: the D2H transfer (the PS push
+                    # wire) carries int8 + scales; the store dequantizes
+                    # at its boundary before the optimizer apply
+                    ps_grads[n] = collectives.quant_wire(ps_grads[n])
 
             sync_state = dict(state.sync_state) if isinstance(state.sync_state, dict) else {}
             new_bucket_state = dict(sync_state.get("bucket", {}))
@@ -1426,9 +1492,13 @@ class GraphTransformer:
                                         holed_params, layout_tree)
         opt_state_spec = (jax.eval_shape(item.optimizer.init, holed_params)
                           if ps_names else item.opt_state_spec)
-        ps_specs = {n: P() for n in sorted(ps_names)}
+        # quantized-wire PS values enter (and their grads leave) as the
+        # {"q", "s"} container — both replicated, like the f32 values
+        ps_specs = {n: ({"q": P(), "s": P()} if n in ps_quant else P())
+                    for n in sorted(ps_names)}
         # sparse PS grads leave as (ids, values) pairs, both replicated
-        ps_out_specs = {n: ((P(), P()) if n in sparse_wire else P())
+        ps_out_specs = {n: ((P(), P()) if n in sparse_wire else
+                            {"q": P(), "s": P()} if n in ps_quant else P())
                         for n in sorted(ps_names)}
         opt_layout_tree = variable_utils.map_state_layouts(
             opt_state_spec, var_infos, layouts, VarLayout(name=""))
@@ -1462,6 +1532,7 @@ class GraphTransformer:
         # forward-only metrics (Runner.evaluate): same param gather, no
         # grad/optimizer/collective-sync cost
         def local_eval(state: TrainState, ps_vals, batch):
+            ps_vals = _ps_dewire(ps_vals)
             gathered = _tree_map_layouts(
                 lambda leaf, lay: lay.gather_full(leaf), state.params,
                 layout_tree)
@@ -1551,6 +1622,7 @@ class GraphTransformer:
                                                      flat_specs)
 
             def local_predict(state: TrainState, ps_vals, batch):
+                ps_vals = _ps_dewire(ps_vals)
                 gathered = _tree_map_layouts(
                     lambda leaf, lay: lay.gather_full(leaf), state.params,
                     layout_tree)
@@ -1633,7 +1705,25 @@ class GraphTransformer:
         def local_multi(state: TrainState, ps_vals, ps_opt, batches):
             def body(carry, batch):
                 st, vals, opts = carry
-                new_st, ps_grads, metrics = local_step(st, vals, batch)
+                # quantized-wire emulation: the carry holds EXACT f32
+                # values (like the host store), so each microstep applies
+                # the same codec the per-step wire pays — values round-trip
+                # quantize->dequantize before the loss (the pull wire) and
+                # the reduced gradient round-trips before the emulated
+                # apply (the push wire). Fused numerics therefore match
+                # the per-step quantized loop, while the actual host wire
+                # is crossed once per superstep instead of once per step.
+                wire_vals = {n: (collectives.quant_wire(v)
+                                 if n in ps_quant else v)
+                             for n, v in vals.items()}
+                new_st, ps_grads, metrics = local_step(st, wire_vals, batch)
+                if ps_quant:
+                    ps_grads = {
+                        n: (collectives.dequant_wire(
+                            g, tuple(var_infos[n].shape),
+                            np.dtype(var_infos[n].dtype))
+                            if isinstance(g, dict) else g)
+                        for n, g in ps_grads.items()}
                 if ps_names:
                     scale = (st.sync_state["sentinel"]["lr_scale"][0]
                              if guard else None)
@@ -1656,12 +1746,16 @@ class GraphTransformer:
                 body, (state, ps_vals, ps_opt), batches)
             return st, vals, opts, stacked_metrics
 
+        # the fused carry holds RAW f32 PS values (the store's exact
+        # copy); only the per-step path's entry values are wire-form
+        ps_raw_specs = {n: P() for n in sorted(ps_names)}
+
         def fused_builder(donate: bool):
             sharded_multi = jax.shard_map(
                 local_multi, mesh=self._mesh,
-                in_specs=(state_specs, ps_specs, ps_opt_specs,
+                in_specs=(state_specs, ps_raw_specs, ps_opt_specs,
                           stacked_batch_specs),
-                out_specs=(state_specs, ps_specs, ps_opt_specs,
+                out_specs=(state_specs, ps_raw_specs, ps_opt_specs,
                            metric_specs),
                 check_vma=False)
             return jax.jit(sharded_multi,
@@ -1669,6 +1763,22 @@ class GraphTransformer:
 
         ps_syncs = [s for s in syncs.values()
                     if s.__class__.__name__ == "PSSynchronizer"]
+        # static per-microstep AR wire accounting for the quantized
+        # buckets: payload bytes (int8 body + f32 scale sidecar) vs the
+        # full-width bytes the same payload would have cost — bumped into
+        # the wire.* telemetry counters once per dispatch (x k fused), so
+        # the measured reduction is visible without any D2H. The SAME
+        # formula prices the cost model and the drift tests
+        # (collectives.int8_wire_payload_bytes).
+        wire_q_step = wire_fp_step = 0.0
+        if N > 1:
+            for b in buckets:
+                if b.compressor_name in ("Int8Compressor",
+                                         "Int8CompressorEF"):
+                    q_b, f_b = collectives.int8_wire_payload_bytes(
+                        b.total_size, np.dtype(b.dtype).itemsize)
+                    wire_q_step += q_b
+                    wire_fp_step += f_b
         metadata = {
             # proxied (device-cached) PS vars keep a single destination;
             # host-resident plans carry one owner per shard
@@ -1676,9 +1786,12 @@ class GraphTransformer:
                 {s.var_name: s.reduction_destination for s in ps_syncs},
                 **{n: list(p.destinations) for n, p in ps_plans.items()}),
             "ps_host_resident": sorted(ps_names),
+            "ps_wire_int8": sorted(ps_quant),
             "sparse_wire": sorted(sparse_wire),
             "buckets": [b.key for b in buckets],
             "per_var_compressors": per_var_comp,
+            "wire_quant_bytes_per_step": wire_q_step,
+            "wire_fp32_bytes_per_step": wire_fp_step,
             # staleness window for the runner's cross-process pacing
             "staleness": max(
                 [s.staleness for s in ps_syncs]

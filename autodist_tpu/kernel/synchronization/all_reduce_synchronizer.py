@@ -29,7 +29,19 @@ class AllReduceSynchronizer(Synchronizer):
                          extra_axes, dcn_axes)
         self.compressor = compressor_lib.create(
             getattr(config, "compressor", None), var_name)
-        # NOTE: int8 ring arming happens in bucket_reduce — every
+        # wire_dtype="int8" lowers the collective itself to the blockwise
+        # two-phase quantized all-reduce: implemented by substituting the
+        # Int8CompressorEF wire codec (error feedback keeps training
+        # honest), which the bucketing layer then arms with the mesh axes.
+        # A var that also names an explicit compressor keeps it (the
+        # conflict is the linter's ADT310 error).
+        self.wire_dtype = getattr(config, "wire_dtype", "fp32") or "fp32"
+        if (self.wire_dtype == "int8"
+                and self.compressor.name == "NoneCompressor"
+                and not (layout is not None and layout.partitioned)):
+            self.compressor = compressor_lib.create("Int8CompressorEF",
+                                                    var_name)
+        # NOTE: int8 wire arming happens in bucket_reduce — every
         # unpartitioned int8 var is concatable and routed into a bucket;
         # this per-var compressor only serves the psum fallback paths
         self.group = getattr(config, "group", 0)
@@ -39,6 +51,11 @@ class AllReduceSynchronizer(Synchronizer):
             logging.warning("var %s: compressor %s is ignored on the "
                             "partitioned (reduce-scatter) path", var_name,
                             self.compressor.name)
+        if (layout is not None and layout.partitioned
+                and self.wire_dtype == "int8"):
+            logging.warning("var %s: wire_dtype=int8 is ignored on the "
+                            "partitioned (reduce-scatter) path (ADT310)",
+                            var_name)
 
     def psum(self, x):
         """The ``spec`` hint is consumed here: ``DCN`` lowers the reduction

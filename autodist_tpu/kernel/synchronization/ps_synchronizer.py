@@ -44,6 +44,17 @@ class PSSynchronizer(Synchronizer):
         self.local_replication = getattr(config, "local_replication", False)
         self.sync_mode = getattr(config, "sync", True)
         self.staleness = getattr(config, "staleness", 0)
+        # host<->device wire format of the no-proxy PS path (consumed by
+        # plan_host_ps -> PSVarPlan; this kernel only lowers the PROXIED
+        # case, where there is no host wire to quantize)
+        self.wire_dtype = getattr(config, "wire_dtype", "fp32") or "fp32"
+        if self.wire_dtype == "int8" and self.local_replication:
+            from autodist_tpu.utils import logging
+            logging.warning(
+                "var %s: wire_dtype=int8 with local_replication=True is "
+                "ignored — a proxied PS var is device-resident and its "
+                "sync is an on-device psum, no host wire exists (ADT310)",
+                var_name)
         if not self.sync_mode:
             from autodist_tpu.utils import logging
             logging.warning(

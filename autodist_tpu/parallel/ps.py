@@ -143,7 +143,13 @@ class PSVarPlan:
 
     ``destinations`` has one owner device string per shard (length 1 for
     unpartitioned vars); ``shard_sizes`` are the TRUE sizes along ``axis``
-    (uneven allowed — host storage is ragged, never padded)."""
+    (uneven allowed — host storage is ragged, never padded).
+
+    ``wire_dtype="int8"`` quantizes the host<->device step wire: pulls
+    ship the value as blockwise int8 + f32 scales (dequantized in-graph),
+    pushes ship the reduced gradient the same way (dequantized at the
+    store boundary before the optimizer apply). The store itself always
+    holds exact fp32 — only the wire is lossy."""
     var_name: str
     destinations: Tuple[str, ...]
     shard_sizes: Optional[Tuple[int, ...]] = None   # None = unpartitioned
@@ -151,6 +157,7 @@ class PSVarPlan:
     sync: bool = True
     staleness: int = 0
     sparse: bool = False
+    wire_dtype: str = "fp32"
 
     @property
     def partitioned(self) -> bool:
@@ -192,6 +199,21 @@ def plan_host_ps(strategy, var_infos) -> Dict[str, PSVarPlan]:
     def cached(cfg) -> bool:
         return ProxyVariable.plan("", cfg, None).cached
 
+    def wire_for(info, syncs) -> str:
+        """The plan's host-wire format: int8 only when EVERY shard config
+        asks for it AND the variable is dense float — the same guard the
+        linter enforces as ADT310 (sparse grads ship (ids, values) pairs,
+        integer values have no absmax scale). No block-size floor here:
+        the planner does what the plan says; ADT311 is the linter's
+        advisory."""
+        from autodist_tpu.parallel.collectives import wire_quantizable
+        if not wire_quantizable(info):
+            return "fp32"
+        if all((getattr(s, "wire_dtype", "fp32") or "fp32") == "int8"
+               for s in syncs):
+            return "int8"
+        return "fp32"
+
     plans: Dict[str, PSVarPlan] = {}
     for node in strategy.node_config:
         info = var_infos.get(node.var_name)
@@ -215,7 +237,8 @@ def plan_host_ps(strategy, var_infos) -> Dict[str, PSVarPlan]:
                 axis=node.partition_axis or 0,
                 sync=all(s.sync for s in part_syncs),
                 staleness=max(s.staleness for s in part_syncs),
-                sparse=info.sparse)
+                sparse=info.sparse,
+                wire_dtype=wire_for(info, part_syncs))
         elif isinstance(sync_cfg, PSConfig):
             if cached(sync_cfg):
                 continue  # proxied: device-resident (cached) path
@@ -224,7 +247,8 @@ def plan_host_ps(strategy, var_infos) -> Dict[str, PSVarPlan]:
                 destinations=(sync_cfg.reduction_destination,),
                 sync=sync_cfg.sync,
                 staleness=sync_cfg.staleness,
-                sparse=info.sparse)
+                sparse=info.sparse,
+                wire_dtype=wire_for(info, [sync_cfg]))
     return plans
 
 
@@ -250,6 +274,11 @@ class PSStore:
         self.plans = dict(plans)
         self._var_infos = var_infos
         self._optimizer = optimizer
+        # vars whose host<->device step wire ships blockwise int8 + scales
+        # (PSVarPlan.wire_dtype): quantized at this store's boundary on
+        # pull, dequantized at it on push — resident values stay exact f32
+        self.wire_quant = sorted(n for n, p in self.plans.items()
+                                 if p.wire_dtype == "int8")
         self._values: Dict[str, List[np.ndarray]] = {}
         self._opt: Dict[str, List[Any]] = {}
         self._cpu = jax.local_devices(backend="cpu")[0]
@@ -425,26 +454,57 @@ class PSStore:
                                              axis=plan.axis))
         return out
 
-    def pull(self) -> Dict[str, np.ndarray]:
+    def pull(self, wire: bool = True) -> Dict[str, np.ndarray]:
         """Current full values, host-side (the workers' per-step PS read).
         In serving (async) mode, values of groups owned by OTHER processes
         are fetched from the service — the latest published version, no
-        barrier (the reference's async read-from-PS)."""
+        barrier (the reference's async read-from-PS).
+
+        ``wire=True`` (the step path) ships ``wire_dtype="int8"`` vars as
+        their quantized wire container ``{"q", "s"}`` — the H2D transfer
+        carries int8 + scales; the lowering dequantizes in-graph.
+        ``wire=False`` (fused carry pull, checkpoints) returns exact f32;
+        the fused scan body applies the codec per microstep itself, so
+        its numerics still match the per-step wire exactly."""
         # step arg = this store's pull sequence: on a merged cluster
         # timeline the per-worker PS-wire spans line up per step, so
         # wire-time skew is visible per step, not just per run
         with tel.span("ps.pull", "ps",
                       serving=self._serve_groups is not None,
                       step=self.stats["pulls"]):
-            out = self._pull_impl()
+            out = self._pull_impl(wire=wire)
         tel.counter_add("ps.pulls")
         return out
 
-    def _pull_impl(self) -> Dict[str, np.ndarray]:
+    def _quantize_pull(self, out: Dict[str, np.ndarray],
+                       count_bytes: bool) -> Dict[str, Any]:
+        """Swap wire-quantized vars' values for their int8+scales wire
+        containers, crediting the telemetry wire counters (and, on the
+        mirror path, counting the TRUE wire bytes into ``bytes_pulled``
+        — the serving path already counted its network blobs). Runs on
+        whatever values the pull assembled — including a degraded pull's
+        last-good snapshot, which therefore dequantizes on device exactly
+        like a healthy one."""
+        from autodist_tpu.parallel import collectives
+        for name in self.wire_quant:
+            full = np.asarray(out[name])
+            w = collectives.quant_wire_np(full)
+            qb = int(w["q"].nbytes + w["s"].nbytes)
+            if count_bytes:
+                self.stats["bytes_pulled"] += qb
+            tel.counter_add("wire.bytes_quantized", qb)
+            tel.counter_add("wire.bytes_saved", full.nbytes - qb)
+            out[name] = w
+        return out
+
+    def _pull_impl(self, wire: bool = False) -> Dict[str, np.ndarray]:
         bytes0 = self.stats["bytes_pulled"]
+        quant = frozenset(self.wire_quant) if wire else frozenset()
         if self._serve_groups is None:
             out = self._local_full()
             for name in out:
+                if name in quant:
+                    continue  # counted at its true wire width below
                 self.stats["bytes_pulled"] += out[name].nbytes
         else:
             shard_vals: Dict[str, Dict[int, np.ndarray]] = {}
@@ -494,6 +554,9 @@ class PSStore:
                     name, si = key.rsplit("::", 1)
                     shard_vals.setdefault(name, {})[int(si)] = arr
             out = self._assemble(shard_vals)
+        if wire and self.wire_quant:
+            out = self._quantize_pull(out,
+                                      count_bytes=self._serve_groups is None)
         self.stats["pulls"] += 1
         tel.counter_add("ps.bytes_pulled",
                         self.stats["bytes_pulled"] - bytes0)
@@ -565,6 +628,34 @@ class PSStore:
             self._push_impl(grads)
         tel.counter_add("ps.pushes")
 
+    def _grad_to_host(self, name: str, g, count_bytes: bool = True):
+        """D2H one pushed gradient at the store boundary. Dense arrays and
+        sparse (ids, values) pairs pass through; a wire-quantized gradient
+        arrives as its ``{"q", "s"}`` container (int8 + scales — the D2H
+        transfer the push actually paid), is counted at its true wire
+        width, and dequantizes HERE — the store never sees int8."""
+        if isinstance(g, dict):
+            from autodist_tpu.parallel import collectives
+            w = {k: np.asarray(jax.device_get(v)) for k, v in g.items()}
+            qb = int(w["q"].nbytes + w["s"].nbytes)
+            info = self._var_infos[name]
+            host = collectives.dequant_wire_np(w, tuple(info.shape),
+                                               np.dtype(info.dtype))
+            if count_bytes:
+                self.stats["bytes_pushed"] += qb
+            tel.counter_add("wire.bytes_quantized", qb)
+            tel.counter_add("wire.bytes_saved", host.nbytes - qb)
+            return host
+        if isinstance(g, tuple):
+            pair = tuple(np.asarray(jax.device_get(x)) for x in g)
+            if count_bytes:
+                self.stats["bytes_pushed"] += sum(x.nbytes for x in pair)
+            return pair
+        arr = np.asarray(jax.device_get(g))
+        if count_bytes:
+            self.stats["bytes_pushed"] += arr.nbytes
+        return arr
+
     def _push_impl(self, grads: Dict[str, Any]) -> None:
         bytes0 = self.stats["bytes_pushed"]
         drops0 = self.stats.get("dropped_pushes", 0)
@@ -574,16 +665,8 @@ class PSStore:
                 logging.warning(
                     "async PS (sync=False) requested but serving is not "
                     "wired (no AutoDist async build); applying synchronously")
-            host_grads = {}
-            for name, g in grads.items():
-                if isinstance(g, tuple):
-                    host_grads[name] = tuple(np.asarray(jax.device_get(x))
-                                             for x in g)
-                    self.stats["bytes_pushed"] += sum(
-                        x.nbytes for x in host_grads[name])
-                else:
-                    host_grads[name] = np.asarray(jax.device_get(g))
-                    self.stats["bytes_pushed"] += host_grads[name].nbytes
+            host_grads = {name: self._grad_to_host(name, g)
+                          for name, g in grads.items()}
             self.apply_local(host_grads)
         else:
             from autodist_tpu.runtime import ps_service as pss
@@ -591,11 +674,10 @@ class PSStore:
 
             def fetch(name):
                 if name not in host_grads:
-                    g = grads[name]
-                    host_grads[name] = (
-                        tuple(np.asarray(jax.device_get(x)) for x in g)
-                        if isinstance(g, tuple)
-                        else np.asarray(jax.device_get(g)))
+                    # serving counts its network blobs below; the D2H leg
+                    # only credits the wire counters
+                    host_grads[name] = self._grad_to_host(
+                        name, grads[name], count_bytes=False)
                 return host_grads[name]
 
             for host, grp in self._serve_groups.items():

@@ -45,10 +45,14 @@ STALENESS_CHOICES = (0, 2)
 # plan-level remat policies (None = store all activations)
 REMAT_CHOICES = (None, "dots")
 # compressors the search offers on dense float AllReduce wires; PowerSGD
-# additionally requires rank >= 2 (ADT308)
-_DENSE_COMPRESSORS = ("NoneCompressor", "HorovodCompressor",
-                      "Int8CompressorEF")
+# additionally requires rank >= 2 (ADT308). The int8 wire rides its own
+# ``wire_dtype`` axis below (the blockwise codec is a property of the
+# collective, not a gradient compressor), so it composes with PS too.
+_DENSE_COMPRESSORS = ("NoneCompressor", "HorovodCompressor")
 _MATRIX_COMPRESSORS = _DENSE_COMPRESSORS + ("PowerSGDCompressor:2",)
+# wire formats the search offers per variable (dense float, >= one scale
+# block — ADT310/311 are excluded BY CONSTRUCTION, never emitted)
+WIRE_DTYPES = ("fp32", "int8")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,12 +62,16 @@ class VarChoice:
     ``shards``/``axis`` describe ZeRO-style storage partitioning (the
     ``partitioner`` string of the strategy IR); ``shards == 1`` means
     unpartitioned. ``compressor`` only applies to unpartitioned dense
-    AllReduce wires; ``ps_proxy`` only to PS."""
+    AllReduce wires; ``ps_proxy`` only to PS. ``wire_dtype`` ("fp32" |
+    "int8") selects the blockwise-quantized collective/PS wire — dense
+    float variables of at least one scale block, mutually exclusive with
+    ``compressor`` (canon resolves conflicts compressor-first)."""
     sync: str = "AllReduce"               # "AllReduce" | "PS"
     compressor: str = "NoneCompressor"
     shards: int = 1
     axis: int = 0
     ps_proxy: bool = False
+    wire_dtype: str = "fp32"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,9 +101,12 @@ class PlanSpec:
         comp = sum(1 for _, c in self.choices
                    if c.compressor != "NoneCompressor")
         sharded = sum(1 for _, c in self.choices if c.shards > 1)
+        wired = sum(1 for _, c in self.choices if c.wire_dtype == "int8")
         bits = ["ar=%d" % ar, "ps=%d" % ps]
         if comp:
             bits.append("comp=%d" % comp)
+        if wired:
+            bits.append("int8w=%d" % wired)
         if sharded:
             bits.append("sharded=%d" % sharded)
         bits.append("chunk=%d" % self.chunk_size)
@@ -139,6 +150,8 @@ class PlanSpace:
             n: _partition_options(self.infos[n].shape, cap)
             for n in self.var_names}
         self.compressor_options: Dict[str, Tuple[str, ...]] = {}
+        self.wire_options: Dict[str, Tuple[str, ...]] = {}
+        from autodist_tpu.parallel.collectives import wire_quantizable
         for n in self.var_names:
             info = self.infos[n]
             dtype = str(getattr(info, "dtype", "float32"))
@@ -150,6 +163,11 @@ class PlanSpace:
                 self.compressor_options[n] = _MATRIX_COMPRESSORS
             else:
                 self.compressor_options[n] = _DENSE_COMPRESSORS
+            # int8 wire: dense float, at least one scale block (ADT310 /
+            # ADT311 excluded from the space by construction)
+            self.wire_options[n] = (
+                WIRE_DTYPES if wire_quantizable(info, min_block=True)
+                else ("fp32",))
 
     # ------------------------------------------------------------- validity
 
@@ -170,8 +188,18 @@ class PlanSpace:
                 or compressor not in self.compressor_options[name]):
             compressor = "NoneCompressor"
         proxy = bool(choice.ps_proxy) if sync == "PS" else False
+        # wire codec: dense float >= one block only (ADT310/311), never on
+        # the AR reduce-scatter path (shards > 1), never on a proxied PS
+        # var (no host wire), and compressor-first on conflicts
+        wire = choice.wire_dtype if choice.wire_dtype in WIRE_DTYPES else "fp32"
+        if wire == "int8":
+            if ("int8" not in self.wire_options[name]
+                    or compressor != "NoneCompressor"
+                    or (sync == "AllReduce" and shards > 1)
+                    or (sync == "PS" and proxy)):
+                wire = "fp32"
         return VarChoice(sync=sync, compressor=compressor, shards=shards,
-                         axis=axis, ps_proxy=proxy)
+                         axis=axis, ps_proxy=proxy, wire_dtype=wire)
 
     def make_plan(self, choices: Dict[str, VarChoice], chunk_size: int = 128,
                   staleness: int = 0, remat: Optional[str] = None) -> PlanSpec:
@@ -215,23 +243,31 @@ class PlanSpace:
             k = (smallest_divisor_shards(dim0, self.n_replicas)
                  if dim0 > 1 and not self.infos[n].sparse else 1)
             zero[n] = (VarChoice(shards=k, axis=0) if k > 1 else VarChoice())
+        def wired(base=None, sync="AllReduce"):
+            """``base`` (or all-``sync``) with the int8 wire on every
+            variable whose sub-space allows it (canon strips the rest) —
+            the quantized-wire analog of the compressor seed families."""
+            base = base or {}
+            return {n: base.get(n) or VarChoice(sync=sync,
+                                                wire_dtype="int8")
+                    for n in self.var_names}
+
         out = [
             ("seed:ar", self.make_plan(ar)),
             ("seed:ar512", self.make_plan(ar, chunk_size=512)),
             ("seed:ar-bf16", self.make_plan(
                 compressed("HorovodCompressor"))),
-            ("seed:ar-int8", self.make_plan(
-                compressed("Int8CompressorEF"))),
+            ("seed:ar-int8w", self.make_plan(wired())),
             ("seed:ar-psgd2", self.make_plan(
                 compressed("PowerSGDCompressor:2"))),
             ("seed:host-ps", self.make_plan(host_ps)),
+            ("seed:ps-int8w", self.make_plan(wired(sync="PS"))),
             ("seed:ps-stale2", self.make_plan(host_ps, staleness=2)),
             ("seed:proxy-ps", self.make_plan(proxy_ps)),
             ("seed:parallax", self.make_plan(parallax)),
             ("seed:parallax-bf16", self.make_plan(
                 compressed("HorovodCompressor", base=sparse_ps))),
-            ("seed:parallax-int8", self.make_plan(
-                compressed("Int8CompressorEF", base=sparse_ps))),
+            ("seed:parallax-int8w", self.make_plan(wired(base=sparse_ps))),
             ("seed:part-ps", self.make_plan(part_ps)),
             ("seed:zero", self.make_plan(zero)),
             ("seed:ar-remat", self.make_plan(ar, chunk_size=512,
@@ -262,15 +298,24 @@ class PlanSpace:
             shards = node.num_shards if node.partitioner else 1
             axis = (node.partition_axis or 0) if node.partitioner else 0
             if isinstance(first, AllReduceSynchronizer):
-                choice = VarChoice(compressor=first.compressor or
-                                   "NoneCompressor",
-                                   shards=shards, axis=axis)
+                comp = first.compressor or "NoneCompressor"
+                wire = first.wire_dtype or "fp32"
+                if comp.split(":")[0] in ("Int8Compressor",
+                                          "Int8CompressorEF"):
+                    # the compressor axis no longer carries int8 (the
+                    # wire axis owns it, and the kernels are identical):
+                    # convert instead of silently stripping the ~4x
+                    # compression the zoo strategy configured
+                    comp, wire = "NoneCompressor", "int8"
+                choice = VarChoice(compressor=comp, shards=shards,
+                                   axis=axis, wire_dtype=wire)
             elif isinstance(first, PSSynchronizer):
                 if not first.sync:
                     return None  # async PS is outside the search space
                 staleness = max(staleness, int(first.staleness or 0))
                 choice = VarChoice(sync="PS", shards=shards, axis=axis,
-                                   ps_proxy=bool(first.local_replication))
+                                   ps_proxy=bool(first.local_replication),
+                                   wire_dtype=first.wire_dtype or "fp32")
             else:
                 return None
             canon = self.canon(choice, name)
@@ -316,6 +361,26 @@ class PlanSpace:
                 return (plan.replace_choice(n, new),
                         "compressor[%s]=%s" % (n, comp))
             ops.append(set_compressor)
+
+        wire_vars = [n for n in names
+                     if len(self.wire_options[n]) > 1
+                     and not (cm[n].sync == "AllReduce"
+                              and cm[n].shards > 1)
+                     and not (cm[n].sync == "PS" and cm[n].ps_proxy)]
+        if wire_vars:
+            def set_wire_dtype():
+                n = wire_vars[rng.randrange(len(wire_vars))]
+                target = "int8" if cm[n].wire_dtype == "fp32" else "fp32"
+                # setting the wire codec clears any compressor (they are
+                # mutually exclusive — ADT310; canon resolves
+                # compressor-first, so the operator states its intent)
+                new = self.canon(dataclasses.replace(
+                    cm[n], wire_dtype=target,
+                    compressor=("NoneCompressor" if target == "int8"
+                                else cm[n].compressor)), n)
+                return (plan.replace_choice(n, new),
+                        "wire[%s]=%s" % (n, target))
+            ops.append(set_wire_dtype)
 
         ps_vars = [n for n in names if cm[n].sync == "PS"]
         if ps_vars:
@@ -412,7 +477,8 @@ class PlanSpace:
                     nodes.append(VarConfig(
                         var_name=name,
                         synchronizer=AllReduceSynchronizer(
-                            compressor=c.compressor, group=group)))
+                            compressor=c.compressor, group=group,
+                            wire_dtype=c.wire_dtype)))
                 continue
             staleness = 0 if c.ps_proxy else plan.staleness
             if c.shards > 1:
@@ -424,7 +490,8 @@ class PlanSpace:
                             reduction_destination=self.destinations[
                                 rr % n_ps],
                             local_replication=c.ps_proxy,
-                            sync=True, staleness=staleness)))
+                            sync=True, staleness=staleness,
+                            wire_dtype=c.wire_dtype)))
                     rr += 1
                 nodes.append(VarConfig(
                     var_name=name,
@@ -436,7 +503,8 @@ class PlanSpace:
                     synchronizer=PSSynchronizer(
                         reduction_destination=assignment[name],
                         local_replication=c.ps_proxy,
-                        sync=True, staleness=staleness)))
+                        sync=True, staleness=staleness,
+                        wire_dtype=c.wire_dtype)))
         return Strategy(node_config=nodes,
                         graph_config=GraphConfig(replicas=list(self.replicas),
                                                  remat=plan.remat))

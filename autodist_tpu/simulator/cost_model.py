@@ -511,14 +511,42 @@ class CostModel:
             act *= pp / m
         return device_params + opt_bytes + grad_bytes + act
 
-    def _wire_bytes(self, info, sync, compressed: bool = True) -> float:
+    @staticmethod
+    def _int8_payload(num_elements: int) -> float:
+        """Quantized wire payload at its TRUE byte width: int8 body padded
+        to scale blocks PLUS the f32 scale sidecar — the same formula the
+        lowering's telemetry counters use
+        (``collectives.int8_wire_payload_bytes``), so predicted and
+        measured bytes can only drift by padding, never by formula."""
+        from autodist_tpu.parallel.collectives import int8_wire_payload_bytes
+        q, _ = int8_wire_payload_bytes(num_elements, WIRE_DTYPE_BYTES)
+        return float(q)
+
+    def _wire_bytes(self, info, sync, compressed: bool = True,
+                    wire_ok: bool = True) -> float:
         from autodist_tpu.kernel.synchronization import compressor as compressor_lib
+        from autodist_tpu.parallel.collectives import wire_quantizable
         if getattr(info, "sparse", False):
             # sparse (gather-indexed) gradients ship as (ids, values)
             # pairs and the lowering IGNORES compressors on them (the
             # linter's ADT306) — pricing them compressed let whole-graph
             # compressor candidates win on bytes they never save
             compressed = False
+        if (getattr(sync, "wire_dtype", "fp32") or "fp32") == "int8" \
+                and wire_ok and wire_quantizable(info):
+            # wire_dtype=int8: blockwise int8 + scale sidecar. On the PS
+            # path the host wire quantizes regardless of partitioning
+            # (shards split host-side after dequant); on AllReduce only
+            # the unpartitioned collective honors it (the reduce-scatter
+            # path ignores wire codecs — ADT310 warns). Callers pass
+            # ``wire_ok=False`` on paths the runtime never quantizes
+            # (proxied PS, model-parallel complement reductions) so a
+            # mispinned plan is not priced 4x cheaper than it runs.
+            if getattr(sync, "kind", "") == "PS" or compressed:
+                comp = getattr(sync, "compressor", "") or "NoneCompressor"
+                if getattr(sync, "kind", "") == "PS" \
+                        or comp == "NoneCompressor":
+                    return self._int8_payload(info.num_elements)
         if not compressed:
             # partitioned/reduce-scatter syncs ignore compressors entirely
             return info.num_elements * WIRE_DTYPE_BYTES
@@ -535,6 +563,11 @@ class CostModel:
                 return float(rank or 1) * (n + m) * WIRE_DTYPE_BYTES
             # rank-0/1 tensors pass through PowerSGD uncompressed
             return info.num_elements * WIRE_DTYPE_BYTES
+        if name in ("Int8Compressor", "Int8CompressorEF"):
+            # int8 compressors ride the same blockwise wire codec: the
+            # scale sidecar is part of the payload, not free (the byte
+            # accounting the drift tests assert on)
+            return self._int8_payload(info.num_elements)
         factor = COMPRESSED_BYTES.get(name, None)
         if factor is None:
             factor = WIRE_DTYPE_BYTES
@@ -586,15 +619,16 @@ class CostModel:
                     if node.mp_axes and complement == 1:
                         continue  # whole mesh is model axes: no grad sync
                     ar_bytes += mp_share * self._wire_bytes(
-                        info, sync,
-                        compressed=not partitioned) / max(len(syncs), 1)
+                        info, sync, compressed=not partitioned,
+                        wire_ok=not node.mp_axes) / max(len(syncs), 1)
                     groups.add(sync.group)
                 elif isinstance(sync, PSSynchronizer):
                     if sync.local_replication:
                         # proxied PS is device-resident: its sync is an
-                        # on-device psum — ICI traffic, no PCIe
+                        # on-device psum — ICI traffic, no PCIe (and no
+                        # host wire for wire_dtype to quantize)
                         ar_bytes += (self._wire_bytes(
-                            info, sync, compressed=False)
+                            info, sync, compressed=False, wire_ok=False)
                             / max(len(syncs), 1))
                         num_ps_transfers += 1
                         continue

@@ -40,7 +40,10 @@ _LOSSY_PREMIUM = {
 
 
 def _risk_premium(strategy: Strategy) -> float:
-    """Max lossy-compression premium across the strategy's synchronizers."""
+    """Max lossy-compression premium across the strategy's synchronizers.
+    The ``wire_dtype="int8"`` quantized wire carries the same premium as
+    the Int8 compressors (blockwise int8 + error feedback): it wins only
+    when the wire saving is decisive — i.e. when bandwidth-bound."""
     worst = 1.0
     for node in strategy.node_config:
         syncs = ([node.synchronizer] if node.synchronizer else
@@ -49,6 +52,8 @@ def _risk_premium(strategy: Strategy) -> float:
             name = getattr(sync, "compressor", "") or ""
             name = name.split(":")[0]
             worst = max(worst, _LOSSY_PREMIUM.get(name, 1.0))
+            if (getattr(sync, "wire_dtype", "fp32") or "fp32") == "int8":
+                worst = max(worst, _LOSSY_PREMIUM["Int8CompressorEF"])
     return worst
 
 

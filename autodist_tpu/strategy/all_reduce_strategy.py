@@ -19,21 +19,31 @@ from autodist_tpu.strategy.ps_strategy import replica_devices
 
 class AllReduce(StrategyBuilder):
     def __init__(self, chunk_size: int = 128, all_reduce_spec: str = "AUTO",
-                 compressor: str = "NoneCompressor"):
+                 compressor: str = "NoneCompressor",
+                 wire_dtype: str = "fp32"):
         if chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
         self.chunk_size = chunk_size
         self.all_reduce_spec = all_reduce_spec
         self.compressor = compressor
+        # "int8": blockwise-quantized two-phase all-reduce wire (dense
+        # float vars only; sparse/integer vars keep fp32 — ADT310)
+        self.wire_dtype = wire_dtype
 
     def build(self, model_item, resource_spec) -> Strategy:
+        from autodist_tpu.parallel.collectives import wire_quantizable
         nodes = []
         for idx, name in enumerate(model_item.trainable_var_names):
+            info = model_item.var_infos.get(name)
+            # dense float, >= one scale block (ADT310/311 stay un-emitted
+            # by construction — same gate as the searcher's canon)
+            quantizable = wire_quantizable(info, min_block=True)
             nodes.append(VarConfig(
                 var_name=name,
                 synchronizer=AllReduceSynchronizer(
                     spec=self.all_reduce_spec,
                     compressor=self.compressor,
-                    group=idx // self.chunk_size)))
+                    group=idx // self.chunk_size,
+                    wire_dtype=(self.wire_dtype if quantizable else "fp32"))))
         return Strategy(node_config=nodes,
                         graph_config=GraphConfig(replicas=replica_devices(resource_spec)))

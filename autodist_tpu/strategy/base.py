@@ -40,18 +40,25 @@ class PSSynchronizer:
     On TPU, ``reduction_destination`` names the device that *owns* the
     variable's update computation; gradients are reduced to the owner and the
     updated value is re-broadcast (or cached via proxy, see
-    ``parallel/ps.py``)."""
+    ``parallel/ps.py``).
+
+    ``wire_dtype`` ("fp32" | "int8") sets the host<->device wire format of
+    the no-proxy (host-resident) PS path: "int8" ships values and pushed
+    gradients as blockwise-scaled int8 + f32 scales
+    (``parallel/collectives.py`` codec) with dequantization at the store
+    boundary — dense float variables only (the linter's ADT310)."""
     reduction_destination: str = ""
     local_replication: bool = False
     sync: bool = True
     staleness: int = 0
+    wire_dtype: str = "fp32"
 
     kind = "PS"
 
     def to_dict(self):
         return {"kind": self.kind, "reduction_destination": self.reduction_destination,
                 "local_replication": self.local_replication, "sync": self.sync,
-                "staleness": self.staleness}
+                "staleness": self.staleness, "wire_dtype": self.wire_dtype}
 
 
 @dataclasses.dataclass
@@ -64,10 +71,18 @@ class AllReduceSynchronizer:
     ``compressor`` names a class in ``parallel/compression.py``. ``group``
     buckets small all-reduces together (the reference feeds this to the
     ScopedAllocator grappler pass, ``all_reduce_strategy.py:60-67``; we feed
-    it to our own gradient bucketing in ``parallel/collectives.py``)."""
+    it to our own gradient bucketing in ``parallel/collectives.py``).
+
+    ``wire_dtype`` ("fp32" | "int8") sets the collective's wire format:
+    "int8" lowers the gradient all-reduce to the blockwise-scaled
+    two-phase quantized shape (quantize -> reduce-scatter int8 -> local
+    dequant-accumulate -> quantize -> all-gather; EQuARX, arXiv
+    2506.17615) with error feedback. Dense float unpartitioned wires only,
+    and mutually exclusive with ``compressor`` (the linter's ADT310)."""
     spec: str = "AUTO"        # AUTO | ICI | DCN (NCCL/RING accepted as aliases)
     compressor: str = "NoneCompressor"
     group: int = 0
+    wire_dtype: str = "fp32"
 
     kind = "AllReduce"
 
@@ -78,7 +93,8 @@ class AllReduceSynchronizer:
 
     def to_dict(self):
         return {"kind": self.kind, "spec": self.spec,
-                "compressor": self.compressor, "group": self.group}
+                "compressor": self.compressor, "group": self.group,
+                "wire_dtype": self.wire_dtype}
 
 
 Synchronizer = Union[PSSynchronizer, AllReduceSynchronizer]

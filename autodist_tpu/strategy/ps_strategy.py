@@ -20,16 +20,31 @@ def replica_devices(resource_spec):
 
 class PS(StrategyBuilder):
     def __init__(self, local_proxy_variable: bool = False, sync: bool = True,
-                 staleness: int = 0, require_sparse: bool = False):
+                 staleness: int = 0, require_sparse: bool = False,
+                 wire_dtype: str = "fp32"):
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
         self._require_sparse = require_sparse
+        # "int8": host<->device PS wire ships blockwise int8 + scales
+        # (no-proxy dense float vars only; others keep fp32 — ADT310)
+        self._wire_dtype = wire_dtype
         if staleness > 0:
             assert sync, "staleness is only meaningful for sync training"
 
     def build(self, model_item, resource_spec) -> Strategy:
+        from autodist_tpu.parallel.collectives import wire_quantizable
         destination = reduction_devices(resource_spec)[0]
+
+        def wire_for(name):
+            # dense float, no proxy, >= one scale block (ADT310/311 stay
+            # un-emitted by construction — the searcher's canon gate)
+            info = model_item.var_infos.get(name)
+            if self._local_proxy_variable or not wire_quantizable(
+                    info, min_block=True):
+                return "fp32"
+            return self._wire_dtype
+
         nodes = [
             VarConfig(
                 var_name=name,
@@ -37,7 +52,8 @@ class PS(StrategyBuilder):
                     reduction_destination=destination,
                     local_replication=self._local_proxy_variable,
                     sync=self._sync,
-                    staleness=self._staleness))
+                    staleness=self._staleness,
+                    wire_dtype=wire_for(name)))
             for name in model_item.trainable_var_names
         ]
         return Strategy(node_config=nodes,

@@ -96,6 +96,11 @@ class DriftReport:
     # were joined against — None when the recorder had no decomposable
     # spans (tracing off / sampled)
     goodput: Optional[dict] = None
+    # quantized-wire accounting (wire.* counters): quantized payload
+    # bytes, bytes saved vs full width, the resulting reduction factor,
+    # and the per-step quantized payload — None when no quantized wire
+    # crossed during the window
+    wire: Optional[dict] = None
 
     @property
     def step_ratio(self) -> Optional[float]:
@@ -118,6 +123,7 @@ class DriftReport:
             "breakdown": self.breakdown,
             "counters": self.counters,
             "goodput": self.goodput,
+            "wire": self.wire,
         }
 
     @classmethod
@@ -138,7 +144,8 @@ class DriftReport:
                          for c in d.get("collectives", [])],
             breakdown=d.get("breakdown", {}),
             counters=d.get("counters", {}),
-            goodput=d.get("goodput"))
+            goodput=d.get("goodput"),
+            wire=d.get("wire"))
 
     def save(self, path: str) -> str:
         import os
@@ -171,6 +178,14 @@ class DriftReport:
                          % (c["kind"], c["predicted_wire_bytes"],
                             c["measured_wire_bytes"],
                             c["ratio"] if c["ratio"] is not None else "inf"))
+        if self.wire:
+            lines.append(
+                "  quantized wire: %d B on the wire, %d B saved "
+                "(%.2fx reduction, %.0f B/step)"
+                % (self.wire.get("bytes_quantized", 0),
+                   self.wire.get("bytes_saved", 0),
+                   self.wire.get("reduction_x") or 1.0,
+                   self.wire.get("per_step_quantized") or 0.0))
         return "\n".join(lines)
 
 
@@ -240,6 +255,20 @@ def build_report(cost_model, strategy,
             collectives.append(CollectiveDrift(
                 kind, heur.get(kind, 0.0), measured.get(kind, 0.0)))
 
+    # quantized-wire rows (wire.* counters are credited by the lowering's
+    # per-dispatch static accounting AND the PS store's boundary codec,
+    # both via collectives.int8_wire_payload_bytes — the same formula the
+    # cost model prices, so these rows expose measured-vs-priced drift)
+    wq = counters.get("wire.bytes_quantized", 0.0)
+    ws = counters.get("wire.bytes_saved", 0.0)
+    wire = None
+    if wq > 0:
+        wire = {"bytes_quantized": round(wq),
+                "bytes_saved": round(ws),
+                "reduction_x": round((wq + ws) / wq, 4),
+                "per_step_quantized": (round(wq / num_steps, 1)
+                                       if num_steps else None)}
+
     report = DriftReport(
         strategy_id=getattr(strategy, "id", "?"),
         num_steps=num_steps,
@@ -250,7 +279,8 @@ def build_report(cost_model, strategy,
         breakdown={f.name: getattr(breakdown, f.name)
                    for f in dataclasses.fields(breakdown)},
         counters=counters,
-        goodput=gp.to_dict() if gp is not None else None)
+        goodput=gp.to_dict() if gp is not None else None,
+        wire=wire)
     logging.info("drift report [%s]: predicted=%.6gs measured=%s over %d "
                  "dispatches", report.strategy_id, report.predicted_step_s,
                  "%.6gs" % measured_step if measured_step is not None

@@ -217,8 +217,8 @@ def test_int8_multi_axis_ring_matches_sum():
 
 def test_int8_bucket_armed_on_two_axis_mesh():
     """Through the full stack on a dp x seq mesh, the int8 bucket must run
-    the explicit ring (ppermute in the lowered program), not the bf16
-    psum fallback."""
+    the explicit two-phase quantized all-reduce (all_to_all + all_gather
+    in the lowered program), not the bf16 psum fallback."""
     import autodist_tpu as adt
     rng = np.random.RandomState(0)
     params = {"w": jnp.asarray(rng.randn(8, 4) * 0.1, jnp.float32)}
@@ -248,8 +248,8 @@ def test_int8_bucket_armed_on_two_axis_mesh():
     runner.init(params)
     sharded = runner.remapper.remap_feed(batch)
     hlo = runner.distributed_step.lowered_text(runner.state, sharded)
-    assert "collective_permute" in hlo or "ppermute" in hlo, \
-        "int8 ring not armed on 2-axis mesh"
+    assert "all_to_all" in hlo and "all_gather" in hlo, \
+        "int8 two-phase wire not armed on 2-axis mesh"
     # and it trains
     losses = [float(runner.run(batch)["loss"]) for _ in range(10)]
     assert losses[-1] < losses[0]
