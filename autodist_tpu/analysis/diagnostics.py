@@ -191,6 +191,10 @@ CODES = {
     "ADT420": "sentinel requested but the program lowered without health "
               "guards",
     "ADT421": "PS apply window larger than the sentinel skip window",
+    "ADT430": "in-run elastic shrink requested on a topology that cannot "
+              "shrink",
+    "ADT431": "in-run elastic shrink loses a PS owner (checkpoint "
+              "fallback required)",
     # ADT5xx — memory footprint & collective schedule (analysis/hlo.py,
     # analysis/memory.py)
     "ADT501": "projected per-device OOM: peak HBM exceeds the budget",

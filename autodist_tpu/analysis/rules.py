@@ -736,6 +736,54 @@ def verify_sentinel(policy, metadata: dict) -> List[Diagnostic]:
     return out
 
 
+def verify_elastic(strategy, dead_worker: str = "") -> List[Diagnostic]:
+    """ADT43x — can this job's topology survive an IN-RUN elastic shrink
+    (``runtime/elastic.py``)? Shared by the pre-compile lint and the
+    coordinator's runtime shrink decision (``_shrink_unsound_reason``), so
+    the two can never disagree.
+
+    - ``ADT430`` (error-strength for the shrink path): the strategy pins
+      model-parallel mesh axes — a tensor/pipeline/expert-partitioned
+      program spans the full mesh, and removing a process removes shards
+      no survivor replicates. Recovery must go through the cross-topology
+      checkpoint re-shard (whole-job restart) instead.
+    - ``ADT431``: a PS group's ``reduction_destination`` lives on the dead
+      worker — its authoritative host-resident state died with it, so the
+      in-memory re-shard cannot cover it; the shrink is sound only with a
+      committed checkpoint to fall back to for that state.
+    """
+    out: List[Diagnostic] = []
+    mesh_shape = strategy.graph_config.mesh_shape or {}
+    model_axes = {ax: n for ax, n in mesh_shape.items()
+                  if ax != const.DATA_AXIS and int(n) > 1}
+    if model_axes:
+        out.append(warning(
+            "ADT430",
+            "strategy partitions state over model-parallel mesh axes %s — "
+            "removing a process removes shards no survivor replicates, so "
+            "the job cannot shrink in-run" % (model_axes,),
+            fixit="rely on the whole-job checkpoint restart "
+                  "(ADT_ELASTIC_SYNC without ADT_ELASTIC_INRUN), or use a "
+                  "data-parallel strategy for in-run elasticity"))
+    dead_host = (dead_worker or "").split(":")[0]
+    for node in strategy.node_config:
+        for leaf in (node.part_configs or [node]):
+            sync = leaf.synchronizer or node.synchronizer
+            dest = getattr(sync, "reduction_destination", "") or ""
+            if dead_host and dest.split(":")[0] == dead_host:
+                out.append(warning(
+                    "ADT431",
+                    "PS group of %r is owned by dying worker %s — its "
+                    "host-resident state has no live replica; the shrink "
+                    "must re-shard that state from the last-good "
+                    "checkpoint" % (node.var_name, dead_worker),
+                    var=node.var_name,
+                    fixit="keep PS destinations on the chief, or "
+                          "checkpoint at least once per restart window"))
+                break
+    return out
+
+
 @rule
 def _r_staleness_topology(ctx: Context) -> Iterable[Diagnostic]:
     if ctx.spec is None or not ctx.spec.is_single_node():

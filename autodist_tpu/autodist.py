@@ -69,6 +69,8 @@ def reset():
     _tspans.reset()  # drop recorded spans/counters, re-read ADT_TRACE
     from autodist_tpu.telemetry import blackbox as _bb
     _bb.reset()  # clear the flight recorder's event/log tails
+    from autodist_tpu.runtime import elastic as _elastic
+    _elastic.clear()  # drop the epoch-fenced membership (and its socket)
 
 
 class AutoDist:
@@ -392,11 +394,137 @@ class AutoDist:
                                  sentinel=policy).transform()
         if is_async and dstep.ps_store is not None:
             self._wire_async_ps(dstep)
+        # in-run elastic (runtime/elastic.py): install the epoch-fenced
+        # membership BEFORE the Runner exists (it binds to it at
+        # construction) and keep the build inputs for the reconfigure
+        # handler's mesh/program rebuild
+        inrun = const.ENV.ADT_ELASTIC_INRUN.val and not is_async
+        if inrun:
+            self._arm_inrun_elastic(compiled)
         self._runner = Runner(
             dstep, tracing=self._tracing,
             hbm_budget_bytes=self._resource_spec.chip_hbm_bytes(),
             sentinel=policy if policy is not None else False)
+        if inrun:
+            self._last_build = {"strategy": compiled, "item": item,
+                                "policy": policy}
+            self._runner.set_reconfigure_handler(self._elastic_reconfigure)
         return self._runner
+
+    def _arm_inrun_elastic(self, strategy):
+        """Install this process's epoch-fenced membership (chief publishes
+        the launch epoch; workers read it — or already carry one from the
+        grow-on-join admission). Also lints the topology up front: an
+        ADT430 job can never shrink in-run, so say so at build time, not
+        at the first death."""
+        from autodist_tpu.analysis import rules as rules_lib
+        from autodist_tpu.runtime import elastic
+        # single-node jobs never construct a Coordinator, so the loud
+        # knob validation must also run here
+        elastic.validate_elastic_knobs()
+        for d in rules_lib.verify_elastic(strategy):
+            logging.warning("elastic: %s", d.format())
+        if elastic.current() is not None:
+            return  # admitted via grow-on-join: membership already live
+        self._orig_spec = self._resource_spec
+        roster = elastic.roster_layout(
+            list(self._resource_spec.node_addresses),
+            self._resource_spec.chief)
+        worker = const.ENV.ADT_WORKER.val or self._resource_spec.chief
+        epoch = 1
+        membership = elastic.Membership(worker, epoch, roster)
+        try:
+            if const.is_chief():
+                info = membership._with_client(elastic.read_epoch)
+                if info is None:
+                    membership._with_client(
+                        lambda c: elastic.publish_epoch(c, 1, roster))
+                else:
+                    membership.adopt(*info)
+            else:
+                info = membership.peek()
+                if info is not None:
+                    membership.adopt(*info)
+        except OSError as e:
+            logging.warning("elastic: coordination service unreachable "
+                            "(%s); membership starts at the launch epoch",
+                            e)
+        elastic.install(membership)
+        logging.info("elastic: in-run membership armed — %s at epoch %d "
+                     "(roster %s)", worker, membership.epoch,
+                     ",".join(membership.roster))
+
+    def _elastic_reconfigure(self, runner, epoch, roster, snapshot):
+        """The rebuild half of an in-run reconfiguration (the Runner's
+        ``_maybe_reconfigure`` drives the protocol half): re-join the
+        process set as the epoch's roster, rebuild mesh + programs for the
+        new world, and re-place the state — from the in-memory snapshot
+        when every shard had a live local replica, else from the last-good
+        checkpoint (PR 8's re-shard path). On a grow, the chief broadcasts
+        the snapshot so the joiner adopts the run's truth."""
+        from autodist_tpu.runtime import elastic
+        membership = elastic.current()
+        grew = (membership is not None
+                and len(roster) > len(membership.roster))
+        orig = getattr(self, "_orig_spec", self._resource_spec)
+        excluded = [a for a in orig.node_addresses if a not in roster]
+        spec = orig.without_nodes(excluded) if excluded else orig
+        info = self._last_build
+        # topology gate BEFORE any teardown, with EXACTLY verify_elastic's
+        # rule (size-1 model axes are degenerate data-parallel and fine):
+        # the coordinator's shrink decision and this handler must never
+        # disagree, and a refusal here must leave the old process set
+        # intact so the whole-job escalation can still run
+        mesh_shape = dict(info["strategy"].graph_config.mesh_shape or {})
+        if any(ax != const.DATA_AXIS and int(n) > 1
+               for ax, n in mesh_shape.items()):
+            raise RuntimeError(
+                "in-run reconfigure reached a model-parallel strategy "
+                "(ADT430 should have refused the shrink): mesh axes %s"
+                % mesh_shape)
+        self._resource_spec = spec
+        # tear down + re-join jax.distributed as the new process set
+        if self._coordinator is not None:
+            self._coordinator._cluster.reconfigure(roster, epoch)
+        else:
+            elastic.rejoin_process_set(roster, epoch, chief=orig.chief)
+        # rebuild mesh and programs over the survivors' devices: the data
+        # axis resizes to whatever the NEW world exposes (the strategy's
+        # recorded replica list names the launch world's devices);
+        # degenerate size-1 model axes are preserved so the programs'
+        # axis names keep resolving
+        if mesh_shape:
+            import jax as _jax
+            mesh_shape[const.DATA_AXIS] = len(_jax.devices(self._backend)
+                                              if self._backend
+                                              else _jax.devices())
+            mesh = mesh_lib.build_mesh(axes=mesh_shape,
+                                       backend=self._backend)
+        else:
+            mesh = mesh_lib.build_mesh(backend=self._backend)
+        dstep = GraphTransformer(info["strategy"], mesh, info["item"],
+                                 sentinel=info["policy"]).transform()
+        runner.adopt_distributed_step(dstep)
+        if snapshot is None:
+            # some shard had no live local replica (dead PS owner /
+            # cross-process sharding): fall back to the last-good
+            # checkpoint's cross-topology re-shard
+            from autodist_tpu.checkpoint import latest_checkpoint
+            found, saver = latest_checkpoint(const.ENV.ADT_CKPT_DIR.val)
+            if saver is None:
+                raise RuntimeError(
+                    "elastic reconfigure: state is not locally "
+                    "reconstructible and no committed checkpoint exists "
+                    "in %s" % const.ENV.ADT_CKPT_DIR.val)
+            saver.restore(runner)
+            logging.warning("elastic: re-sharded from checkpoint step %s "
+                            "(no live replica for some state)", found)
+            if grew:
+                snapshot = elastic.snapshot_runner_state(runner)
+        if grew and len(roster) > 1:
+            snapshot = elastic.broadcast_state(snapshot)
+        if snapshot is not None:
+            elastic.adopt_snapshot(runner, snapshot)
 
     def build_step(self, step_fn: Callable, state, example_batch,
                    sentinel=None) -> Runner:

@@ -215,6 +215,11 @@ class Saver:
             dstep = runner_or_step
         if state is None:
             raise ValueError("no state to save")
+        # epoch fence BEFORE any work (and any file): a zombie worker's
+        # late save must leave the checkpoint directory byte-identical to
+        # a run where it never woke (runtime/elastic.py)
+        from autodist_tpu.runtime import elastic
+        elastic.maybe_fence("ckpt.save")
         if sentinel_save_vetoed(runner_or_step):
             return None
         healthy = sentinel_health_stamp(runner_or_step)
@@ -265,7 +270,10 @@ class Saver:
                     os.replace(tmp, final)
                 meta["files"] = file_meta
                 # meta last, atomically: a checkpoint only becomes visible
-                # to _own_metas / latest() once all its data files exist
+                # to _own_metas / latest() once all its data files exist.
+                # Re-fenced at the COMMIT point: an epoch can change
+                # between an async save's submit and its write landing
+                elastic.maybe_fence("ckpt.commit")
                 checkpoint_fault("meta", path=path, step=int(step))
                 with open(path + ".meta.json.tmp", "w") as f:
                     json.dump(meta, f)

@@ -221,6 +221,10 @@ class ShardedSaver:
             raise ValueError("no state to save")
         from autodist_tpu.checkpoint.saver import (sentinel_health_stamp,
                                                    sentinel_save_vetoed)
+        # epoch fence BEFORE any file write: a zombie's late shard save
+        # must leave the checkpoint directory untouched (runtime/elastic.py)
+        from autodist_tpu.runtime import elastic
+        elastic.maybe_fence("ckpt.save")
         if sentinel_save_vetoed(runner_or_step):
             return None
         healthy = sentinel_health_stamp(runner_or_step)
@@ -360,6 +364,10 @@ class ShardedSaver:
                               kind="index-files"):
                     key_owner = self._await_indexes(base, nproc)
                 tel.counter_add("ckpt.barrier_s", time.monotonic() - t_bar)
+                # re-fence at the COMMIT point: an epoch can change
+                # between an async save's submit and the meta landing —
+                # the shard debris stays un-committed (torn-attempt GC)
+                elastic.maybe_fence("ckpt.commit")
                 meta["keys"] = key_owner
                 tmp = base + ".shard-meta.json.tmp"
                 with open(tmp, "w") as f:

@@ -93,6 +93,22 @@ class ENV(Enum):
     # collectives need the global mesh; recovery is whole-job re-exec with
     # a fresh process set, not per-worker rejoin)
     ADT_ELASTIC_SYNC = ("ADT_ELASTIC_SYNC", bool, False)
+    # in-run elastic reconfiguration (runtime/elastic.py): with
+    # ADT_ELASTIC_SYNC, a confirmed sync-worker death shrinks the job to
+    # the survivors IN-RUN (epoch-fenced membership, jax.distributed
+    # rejoin, in-memory re-shard) instead of the whole-job re-exec; a
+    # relaunched worker grows it back. Validated loudly at bring-up
+    # (elastic.validate_elastic_knobs).
+    ADT_ELASTIC_INRUN = ("ADT_ELASTIC_INRUN", bool, False)
+    # chief-side escalation: how long to wait for every survivor's
+    # elastic/ack/<epoch> after publishing a shrink before falling back
+    # to the whole-job checkpoint-restore restart (a survivor wedged in a
+    # collective the dead worker will never re-enter cannot reach its
+    # reconfiguration boundary)
+    ADT_ELASTIC_ACK_TIMEOUT_S = ("ADT_ELASTIC_ACK_TIMEOUT_S", float, 120.0)
+    # how often the Runner polls the membership epoch at readback
+    # boundaries (seconds; bounds reconfiguration downtime from above)
+    ADT_ELASTIC_POLL_S = ("ADT_ELASTIC_POLL_S", float, 0.5)
     # sync-elastic recovery (runtime/coordinator.py _restart_whole_job):
     # set on the re-exec'd job so Runner.init restores the latest
     # checkpoint from ADT_CKPT_DIR instead of starting fresh. Users can

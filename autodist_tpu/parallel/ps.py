@@ -622,6 +622,11 @@ class PSStore:
         Serving (async) mode packs each owner group's gradients into a blob
         and enqueues it on the owner's queue; the owner's apply thread
         applies gradients one at a time (no barrier)."""
+        # epoch fence at the STORE boundary (runtime/elastic.py) — before
+        # any D2H work, so a zombie's push is rejected at zero cost and
+        # never reaches an owner queue its replacement is draining
+        from autodist_tpu.runtime import elastic
+        elastic.maybe_fence("ps.push")
         with tel.span("ps.push", "ps",
                       serving=self._serve_groups is not None,
                       step=self.stats["pushes"]):

@@ -82,6 +82,21 @@ class Cluster:
             return const.is_chief()
         return address == self._spec.chief
 
+    def reconfigure(self, roster: List[str], epoch: int):
+        """Adopt an elastic epoch's roster as THIS job's process set (the
+        chief-side half of ``elastic.rejoin_process_set``): update the
+        deterministic layout, then tear down and re-join jax.distributed
+        as the smaller (shrink) or larger (grow-on-join) world. Workers
+        never hold a Cluster — they call ``rejoin_process_set`` directly
+        from the Runner's reconfigure path with the same layout rule, so
+        every member computes identical process ids."""
+        from autodist_tpu.runtime import elastic
+        layout = elastic.roster_layout(roster, self._spec.chief)
+        self._process_addresses = layout
+        self.epoch = epoch
+        os.environ[const.ENV.ADT_NUM_PROCESSES.name_str] = str(len(layout))
+        elastic.rejoin_process_set(layout, epoch, chief=self._spec.chief)
+
     def worker_env(self, address: str) -> Dict[str, str]:
         """Env vars that turn a launched script into worker ``address``."""
         return {

@@ -20,6 +20,7 @@ from typing import List
 
 from autodist_tpu import const
 from autodist_tpu.runtime.cluster import Cluster
+from autodist_tpu.telemetry import spans as tel
 from autodist_tpu.utils import logging
 
 def _ere_escape(text: str) -> str:
@@ -85,9 +86,13 @@ class Coordinator:
         # jobs (no collective lockstep to re-join; a relaunched worker
         # pulls current values from the parameter service on its first
         # step). _restart_unsound_reason() re-checks the strategy and the
-        # elastic bring-up before the budget is ever used.
-        self._max_restarts = (const.ENV.ADT_ELASTIC.val
-                              if max_restarts is None else max_restarts)
+        # elastic bring-up before the budget is ever used. The knobs are
+        # validated LOUDLY (typed ElasticConfigError naming the knob) —
+        # a typo'd budget must never silently disable elasticity.
+        from autodist_tpu.runtime import elastic
+        env_budget, _sync, self._inrun = elastic.validate_elastic_knobs()
+        self._max_restarts = (env_budget if max_restarts is None
+                              else max_restarts)
         self._restarts: dict = {}          # address -> restarts used
         self._restart_at: dict = {}        # address -> last relaunch time
         self._launch_cmds: dict = {}       # address -> (command, env)
@@ -215,6 +220,13 @@ class Coordinator:
                     logging.info("watchdog: coordination service client "
                                  "re-established; supervision resumed")
                     continue
+                # grow-on-join: a relaunched/hot-spare worker announced
+                # itself — publish the grown roster at the next epoch so
+                # the survivors (and the joiner) expand the job back
+                try:
+                    self._maybe_admit_joiners(client)
+                except OSError:
+                    pass  # service blip: the next tick retries
                 # elastic-aware: a worker with restart budget left may be
                 # mid-relaunch (import + trace + compile easily exceeds the
                 # heartbeat window) — skip anything inside a fresh
@@ -330,6 +342,9 @@ class Coordinator:
                       const.ENV.ADT_PATCH_OPTAX, const.ENV.ADT_ELASTIC,
                       const.ENV.ADT_ELASTIC_SYNC, const.ENV.ADT_AUTO_RESUME,
                       const.ENV.ADT_CKPT_DIR, const.ENV.ADT_ELASTIC_EXCLUDE,
+                      const.ENV.ADT_ELASTIC_INRUN,
+                      const.ENV.ADT_ELASTIC_POLL_S,
+                      const.ENV.ADT_ELASTIC_ACK_TIMEOUT_S,
                       const.ENV.ADT_HEARTBEAT_TIMEOUT_S):
                 raw = os.environ.get(e.name_str)
                 if raw is not None:
@@ -379,10 +394,21 @@ class Coordinator:
         (b) the job's strategy makes a restart SOUND. Returns True when a
         relaunch happened (the new process is supervised like the first).
 
-        Sync-elastic jobs take the whole-job path instead: lockstep peers
-        are wedged in a collective the dead worker will never re-enter, so
-        the only sound recovery is tear-down + relaunch-from-checkpoint."""
+        Sync-elastic jobs prefer the IN-RUN shrink (ADT_ELASTIC_INRUN):
+        publish the survivor roster at epoch+1 and let the survivors
+        re-form a smaller mesh at their next readback boundary — no
+        re-exec, no disk round-trip. When the topology cannot shrink (or
+        the survivors never ack — wedged in a collective the dead worker
+        will never re-enter), fall back to the whole-job path: tear-down +
+        relaunch-from-checkpoint."""
         if self._sync_elastic:
+            try:
+                if self._shrink_to_survivors(address, code):
+                    return True
+            except Exception as e:  # noqa: BLE001 — a broken shrink path
+                # must degrade to the proven whole-job restart
+                logging.error("in-run elastic shrink failed (%s); falling "
+                              "back to whole-job restart", e)
             return self._restart_whole_job(address, code)
         used = self._restarts.get(address, 0)
         if self._max_restarts <= used or address not in self._launch_cmds:
@@ -400,13 +426,16 @@ class Coordinator:
             return False
         self._restarts[address] = used + 1
         self._restart_at[address] = time.monotonic()
-        # deregister the dead incarnation's liveness records (a crashed or
+        # scrub the dead incarnation's liveness records (a crashed or
         # SIGKILLed worker never said GOODBYE): its stale heartbeat must
-        # not age against the replacement while it compiles
+        # not age against the replacement while it compiles, and its
+        # compiling/straggler marks must not satisfy (or poison) the
+        # watchdog's freshness checks against the NEXT incarnation
         try:
+            from autodist_tpu.runtime import elastic
             from autodist_tpu.runtime.coordination import CoordinationClient
             c = CoordinationClient("127.0.0.1", self._coordsvc_port)
-            c.goodbye(address)
+            elastic.gc_worker_marks(c, address)
             c.close()
         except OSError:
             pass  # no service (or unreachable): the bring-up grace covers it
@@ -419,6 +448,219 @@ class Coordinator:
         self._live_procs[address] = proc
         self._proc_wait_async(proc, address)
         return True
+
+    # -------------------------------------------- in-run elastic (epoch-fenced)
+
+    def _coordsvc_client(self):
+        from autodist_tpu.runtime.coordination import CoordinationClient
+        return CoordinationClient("127.0.0.1", self._coordsvc_port,
+                                  timeout=max(5.0,
+                                              self._heartbeat_timeout / 2))
+
+    def _shrink_unsound_reason(self, address: str):
+        """None when the survivors can re-form a smaller mesh in-run after
+        ``address`` dies; otherwise why not (the caller then degrades to
+        the whole-job checkpoint restart). Mirrors the analysis plane's
+        ADT430/431 rules (``analysis/rules.py verify_elastic``) so the
+        pre-compile lint and the runtime decision can never disagree."""
+        from autodist_tpu.strategy.base import Strategy
+        try:
+            strategy = Strategy.deserialize(self._strategy_id)
+        except (OSError, ValueError) as e:
+            return "strategy %s unreadable (%s)" % (self._strategy_id, e)
+        from autodist_tpu.analysis import rules as rules_lib
+        diags = rules_lib.verify_elastic(strategy, dead_worker=address)
+        errors = [d for d in diags if d.code == "ADT430"]
+        if errors:
+            return errors[0].message
+        if any(d.code == "ADT431" for d in diags):
+            # dead PS-owner groups: the in-memory path cannot reassemble
+            # state that died with its sole owner — in-run shrink is still
+            # sound IF a committed checkpoint exists for the fallback
+            from autodist_tpu.checkpoint import latest_checkpoint
+            found, _ = latest_checkpoint(const.ENV.ADT_CKPT_DIR.val)
+            if found is None:
+                return ("worker %s owns PS state (ADT431) and no committed "
+                        "checkpoint exists for the fallback re-shard"
+                        % address)
+        return None
+
+    def _shrink_to_survivors(self, address: str, code) -> bool:
+        """In-run shrink: publish ``epoch+1`` with the survivor roster so
+        every survivor re-forms the smaller process set at its next
+        readback boundary (Runner._maybe_reconfigure). Spends one restart
+        from the elastic budget per reconfiguration. Returns False when
+        in-run elasticity is off / unsound — the caller falls back to the
+        whole-job restart. The dead worker is relaunched afterwards (if
+        budget remains) so it can re-join via the admission protocol and
+        grow the job back."""
+        from autodist_tpu.runtime import elastic
+        if not self._inrun:
+            return False
+        used = self._restarts.get(address, 0)
+        if self._max_restarts <= used:
+            logging.error("in-run elastic: worker %s died (code %s) but "
+                          "its restart budget (%d) is spent", address,
+                          code, self._max_restarts)
+            return False
+        reason = self._shrink_unsound_reason(address)
+        if reason is not None:
+            logging.error("in-run elastic: cannot shrink past worker %s: "
+                          "%s — falling back to whole-job restart",
+                          address, reason)
+            return False
+        # the dead incarnation must be REALLY gone before its peers adopt
+        # a roster without it — a half-dead straggler would be exactly the
+        # zombie the epoch fence exists for, but reaping first shrinks
+        # the window in which the fence is the only defense
+        command_env = self._launch_cmds.get(address)
+        if command_env is not None:
+            self._reap_incarnation(address, command_env[0],
+                                   self._live_procs.get(address))
+        client = self._coordsvc_client()
+        try:
+            elastic.gc_worker_marks(client, address)
+            info = elastic.read_epoch(client)
+            if info is None:
+                logging.error("in-run elastic: no epoch was ever published "
+                              "(arm_inrun_elastic not called?) — falling "
+                              "back to whole-job restart")
+                return False
+            epoch, roster = info
+            if address not in roster:
+                # already shrunk away (this is its relaunch-for-rejoin
+                # dying before admission): the roster is correct as-is —
+                # burn a restart on another relaunch attempt, no epoch
+                self._restarts[address] = used + 1
+                self._restart_at[address] = time.monotonic()
+                if command_env is not None:
+                    command, env = command_env
+                    proc = self._cluster.remote_exec(command, address,
+                                                     env=env)
+                    if proc is not None:
+                        self._live_procs[address] = proc
+                        self._proc_wait_async(proc, address)
+                    logging.warning(
+                        "in-run elastic: pre-admission relaunch of %s died "
+                        "(code %s) — relaunching again (restart %d/%d)",
+                        address, code, used + 1, self._max_restarts)
+                return True
+            survivors = [a for a in roster if a != address]
+            if not survivors:
+                return False
+            elastic.publish_epoch(client, epoch + 1, survivors)
+        finally:
+            try:
+                client.close()
+            except OSError:
+                pass
+        self._restarts[address] = used + 1
+        self._restart_at[address] = time.monotonic()
+        tel.counter_add("elastic.shrinks")
+        logging.warning(
+            "in-run elastic: worker %s died (code %s) — published epoch %d "
+            "with %d survivor(s); the job shrinks at the next readback "
+            "boundary (restart %d/%d)", address, code, epoch + 1,
+            len(survivors), self._restarts[address], self._max_restarts)
+        # escalation: survivors that never ack (wedged in a collective the
+        # dead worker will never re-enter) get the whole-job restart
+        t = threading.Thread(target=self._watch_acks,
+                             args=(epoch + 1, survivors, address, code),
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        # relaunch the dead worker so it can announce itself and grow the
+        # job back (admission is the watchdog's _maybe_admit_joiners)
+        if command_env is not None:
+            command, env = command_env
+            proc = self._cluster.remote_exec(command, address, env=env)
+            if proc is not None:
+                self._live_procs[address] = proc
+                self._proc_wait_async(proc, address)
+            logging.info("in-run elastic: relaunched %s for grow-on-join",
+                         address)
+        return True
+
+    def _watch_acks(self, epoch: int, roster, address: str, code):
+        """Wait for every survivor's ``elastic/ack/<epoch>/<worker>``;
+        escalate to the whole-job checkpoint restart when the shrink never
+        completes (ADT_ELASTIC_ACK_TIMEOUT_S)."""
+        deadline = time.monotonic() + const.ENV.ADT_ELASTIC_ACK_TIMEOUT_S.val
+        pending = set(roster)
+        client = None
+        while not self._stop_watchdog.is_set():
+            if client is None:
+                try:
+                    client = self._coordsvc_client()
+                except OSError:
+                    if self._stop_watchdog.wait(1.0):
+                        return
+                    continue
+            try:
+                for w in sorted(pending):
+                    if client.get("elastic/ack/%d/%s" % (epoch, w)):
+                        pending.discard(w)
+            except OSError:
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                client = None
+            if not pending:
+                logging.info("in-run elastic: every survivor acked "
+                             "epoch %d", epoch)
+                tel.counter_add("elastic.reconfigs_acked")
+                if client is not None:
+                    client.close()
+                return
+            if time.monotonic() > deadline:
+                logging.error(
+                    "in-run elastic: survivors %s never acked epoch %d "
+                    "within %.0fs — escalating to the whole-job restart",
+                    sorted(pending), epoch,
+                    const.ENV.ADT_ELASTIC_ACK_TIMEOUT_S.val)
+                if client is not None:
+                    client.close()
+                self._restart_whole_job(address, code)
+                return
+            if self._stop_watchdog.wait(0.25):
+                return
+
+    def _maybe_admit_joiners(self, client):
+        """Grow-on-join: admit relaunched/hot-spare workers that announced
+        themselves (``elastic/join/<worker>``) by publishing the grown
+        roster at the next epoch. Candidates are the addresses this chief
+        launched that the current roster excludes. Also GCs non-roster
+        workers' stale liveness marks so a dead incarnation can never
+        satisfy a freshness check across epochs."""
+        from autodist_tpu.runtime import elastic
+        if not self._inrun:
+            return
+        info = elastic.read_epoch(client)
+        if info is None:
+            return
+        epoch, roster = info
+        outsiders = [a for a in self._launch_cmds if a not in roster]
+        joiners = []
+        for a in outsiders:
+            if elastic.pending_join(client, a):
+                joiners.append(a)
+            else:
+                # roster hygiene: a worker outside the roster must hold no
+                # live heartbeat/compiling/straggler records
+                elastic.gc_worker_marks(client, a)
+        if not joiners:
+            return
+        for a in joiners:
+            elastic.clear_join(client, a)
+            elastic.gc_worker_marks(client, a)
+        grown = roster + sorted(joiners)
+        elastic.publish_epoch(client, epoch + 1, grown)
+        tel.counter_add("elastic.grows")
+        logging.warning(
+            "in-run elastic: admitted %s — published epoch %d with %d "
+            "member(s); the job grows at the next readback boundary",
+            ",".join(joiners), epoch + 1, len(grown))
 
     def _restart_whole_job(self, address: str, code) -> bool:
         """Sync-elastic recovery: a worker died mid-lockstep, so the

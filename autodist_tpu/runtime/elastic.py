@@ -1,0 +1,613 @@
+"""Elastic membership plane: epoch-fenced rosters for in-run shrink/grow.
+
+AutoDist's supervision ladder so far: fail-fast (the reference), per-worker
+relaunch for async PS (PR 1, ``ADT_ELASTIC``), and whole-job
+checkpoint-restore re-exec for sync jobs (PR 8, ``ADT_ELASTIC_SYNC``). This
+module adds the missing half — **live** reconfiguration: when a sync worker
+dies, the survivors re-form a smaller ``jax.distributed`` process set
+in-run and keep training (shrink-to-survivors), then re-absorb a
+relaunched or hot-spare worker the same way (grow-on-join). No re-exec, no
+disk round-trip when every shard of the training state has a live replica
+on a survivor.
+
+The safety core is the **cluster epoch**: a monotonically increasing
+integer the chief publishes to the coordination service together with the
+membership roster. Every epoch bump is a membership change; every process
+carries the epoch it joined under. Fencing then closes the classic
+split-brain hole of failure detectors: a worker that was *declared* dead
+but is merely slow (GC pause, network partition, SIGSTOP) wakes up holding
+a stale epoch — and every mutating control-plane or PS-wire write it
+attempts (gradient push, value publish, barrier arrival, checkpoint
+commit, KV liveness marks) is rejected with the typed :class:`FencedOut`
+before it can corrupt state its replacement now owns. The check is
+one KV read against the service's authoritative ``elastic/epoch``:
+
+- same epoch (or no elastic plane installed) → write proceeds;
+- newer epoch, but this worker is **in** the new roster → write proceeds
+  (it is a lagging survivor mid-superstep; it will reconfigure at its
+  next readback boundary);
+- newer epoch and this worker is **not** in the roster → ``FencedOut``
+  (it is a zombie: evicted, possibly replaced).
+
+Protocol keys (all on the native coordination service):
+
+====================================  =======================================
+``elastic/epoch``                      authoritative epoch (int as str)
+``elastic/roster``                     comma-joined member addresses for it
+``elastic/reconf/<epoch>``             the survivors' reconfiguration barrier
+``elastic/ack/<epoch>/<worker>``       per-survivor "reconfigured" ack
+``elastic/join/<worker>``              a joiner's admission announcement
+====================================  =======================================
+
+The roster is written BEFORE the epoch: readers key on the epoch, so the
+pair is consistent the moment the epoch lands (the service serializes
+requests on one thread).
+"""
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from autodist_tpu import const
+from autodist_tpu.telemetry import spans as tel
+from autodist_tpu.utils import logging
+
+EPOCH_KEY = "elastic/epoch"
+ROSTER_KEY = "elastic/roster"
+
+
+# --------------------------------------------------------------- typed errors
+
+
+class ElasticConfigError(ValueError):
+    """An elastic knob holds a value that cannot mean anything.
+
+    Raised at bring-up instead of silently disabling elasticity: a typo'd
+    ``ADT_ELASTIC=-1`` (or ``ADT_ELASTIC=yes``) that quietly parsed to
+    "off" would surface months later as a job that fail-fasts when its
+    operator believed it was elastic."""
+
+    def __init__(self, knob: str, raw: str, why: str):
+        self.knob = knob
+        self.raw = raw
+        super().__init__(
+            "invalid %s=%r: %s (unset it, or set a valid value)"
+            % (knob, raw, why))
+
+
+class FencedOut(Exception):
+    """A stale-epoch write was rejected by the membership fence.
+
+    Deliberately NOT an ``OSError``/``RuntimeError`` subclass: the
+    transport-resilience handlers (retry loops, best-effort mark writers)
+    swallow those, and a fenced zombie must stop — its identity has been
+    taken over, and every further write risks corrupting the successor's
+    state. The one correct reaction is to exit (or re-join as a fresh
+    member via the admission protocol)."""
+
+    def __init__(self, op: str, mine: int, current: int,
+                 worker: str = "", roster: Sequence[str] = ()):
+        self.op = op
+        self.my_epoch = mine
+        self.current_epoch = current
+        self.worker = worker
+        self.roster = list(roster)
+        super().__init__(
+            "%s fenced out: this process carries cluster epoch %d but the "
+            "membership plane is at epoch %d and its roster %s no longer "
+            "includes %r — a newer incarnation owns this identity; refusing "
+            "the write" % (op, mine, current, self.roster, worker))
+
+
+# ------------------------------------------------------------ knob validation
+
+_BOOL_RAW = ("", "0", "1", "False", "false", "True", "true")
+
+
+def validate_elastic_knobs() -> Tuple[int, bool, bool]:
+    """Parse the elastic bring-up knobs LOUDLY; returns
+    ``(budget, sync_elastic, inrun)``.
+
+    ``const.ENV``'s generic parsers are permissive by design (any unknown
+    string is a truthy bool; ``int()`` raises a bare ``ValueError`` with no
+    knob name). Elasticity is a safety feature, so its knobs get strict
+    validation with a typed error naming the knob."""
+    import os
+    raw = os.environ.get(const.ENV.ADT_ELASTIC.name_str)
+    if raw is None:
+        budget = 0
+    else:
+        try:
+            budget = int(raw)
+        except ValueError:
+            raise ElasticConfigError(
+                const.ENV.ADT_ELASTIC.name_str, raw,
+                "must be an integer restart budget (0 disables elasticity)"
+            ) from None
+        if budget < 0:
+            raise ElasticConfigError(
+                const.ENV.ADT_ELASTIC.name_str, raw,
+                "a negative restart budget is meaningless")
+    out = [budget]
+    for env in (const.ENV.ADT_ELASTIC_SYNC, const.ENV.ADT_ELASTIC_INRUN):
+        raw = os.environ.get(env.name_str)
+        if raw is not None and raw not in _BOOL_RAW:
+            raise ElasticConfigError(
+                env.name_str, raw,
+                "must be one of %s" % (_BOOL_RAW,))
+        out.append(env.val)
+    if out[2] and not out[1]:
+        raise ElasticConfigError(
+            const.ENV.ADT_ELASTIC_INRUN.name_str, "1",
+            "in-run reconfiguration is the sync-elastic upgrade path and "
+            "needs ADT_ELASTIC_SYNC=1 at bring-up")
+    if out[2] and out[0] <= 0:
+        raise ElasticConfigError(
+            const.ENV.ADT_ELASTIC_INRUN.name_str, "1",
+            "needs a positive ADT_ELASTIC budget (each in-run "
+            "reconfiguration spends one restart)")
+    return out[0], out[1], out[2]
+
+
+# ------------------------------------------------------------- epoch protocol
+
+
+def read_epoch(client) -> Optional[Tuple[int, List[str]]]:
+    """The service's ``(epoch, roster)``, or None when no epoch was ever
+    published (non-elastic job / service restarted)."""
+    raw = client.get(EPOCH_KEY)
+    if not raw:
+        return None
+    try:
+        epoch = int(raw)
+    except ValueError:
+        return None
+    roster_raw = client.get(ROSTER_KEY) or ""
+    return epoch, [a for a in roster_raw.split(",") if a]
+
+
+def publish_epoch(client, epoch: int, roster: Sequence[str]):
+    """Chief-side: commit a membership change. Roster first, then the
+    epoch (the commit point readers key on). Refuses to move backwards —
+    a re-published lower epoch would un-fence every zombie at once."""
+    cur = read_epoch(client)
+    if cur is not None and epoch <= cur[0]:
+        raise ValueError(
+            "elastic epoch must increase monotonically: refusing to "
+            "publish epoch %d over current %d" % (epoch, cur[0]))
+    client.put(ROSTER_KEY, ",".join(roster))
+    client.put(EPOCH_KEY, str(epoch))
+    tel.gauge_set("elastic.epoch", float(epoch))
+    tel.instant("elastic.epoch_published", "elastic", epoch=epoch,
+                world=len(roster))
+    logging.warning("elastic: published cluster epoch %d (roster: %s)",
+                    epoch, ",".join(roster))
+
+
+def announce_join(client, worker: str):
+    """A relaunched/hot-spare worker asks for admission; the chief's
+    watchdog answers with a grown-roster epoch at the next boundary."""
+    client.put("elastic/join/%s" % worker, repr(time.time()))
+
+
+def pending_join(client, worker: str,
+                 freshness_s: float = 600.0) -> bool:
+    """True while ``worker`` holds a fresh admission announcement."""
+    raw = client.get("elastic/join/%s" % worker)
+    if not raw:
+        return False
+    try:
+        ts = float(raw)
+    except ValueError:
+        return False
+    return ts > 0 and time.time() - ts < freshness_s
+
+
+def clear_join(client, worker: str):
+    client.put("elastic/join/%s" % worker, "0")
+
+
+def gc_worker_marks(client, worker: str):
+    """Watchdog hygiene: scrub every liveness record a dead incarnation of
+    ``worker`` may have left — its heartbeat (GOODBYE), its ``compiling``
+    grace mark and its ``straggler`` slow-but-alive mark (tombstoned to
+    "0", which both readers treat as cleared). Without this, a dead
+    incarnation's fresh-looking marks could satisfy — or poison — the
+    watchdog's freshness checks against the NEXT incarnation across an
+    epoch change (a worker flagged straggling in epoch N must not carry
+    the flag into its epoch N+1 self)."""
+    for op in (lambda: client.goodbye(worker),
+               lambda: client.put("compiling/%s" % worker, "0"),
+               lambda: client.put("straggler/%s" % worker, "0")):
+        try:
+            op()
+        except (OSError, RuntimeError):
+            pass  # hygiene is best-effort; marks also age out
+
+
+# ---------------------------------------------------------------- membership
+
+
+class Membership:
+    """One process's view of the elastic membership plane.
+
+    Holds the worker identity, the epoch this process currently operates
+    under, and that epoch's roster; owns a dedicated (raw, auto-reconnect)
+    coordination client so fence checks never share a socket with — or
+    deadlock against — the operation being fenced."""
+
+    def __init__(self, worker: str, epoch: int, roster: Sequence[str],
+                 client_factory: Optional[Callable] = None,
+                 fence_cache_s: float = 0.05):
+        self.worker = worker
+        self.epoch = epoch
+        self.roster = list(roster)
+        self._factory = client_factory or self._default_factory
+        self._client = None
+        self._lock = threading.Lock()
+        # read-side cache: a fence check inside the window reuses the last
+        # (epoch, roster) instead of issuing two more KV reads — without
+        # it, EVERY mutating control-plane op (and each per-step PS push)
+        # would pay 2 extra serialized RPCs. The 50 ms default is far
+        # inside the protocol's inherent race window (death detection
+        # itself takes a heartbeat/process-watch interval), so it weakens
+        # nothing: a write that slips through within 50 ms of the epoch
+        # bump was indistinguishable from one already in flight. 0 makes
+        # every check exact (tests).
+        self._fence_cache_s = fence_cache_s
+        self._cached: Optional[Tuple[int, List[str]]] = None
+        self._cached_at = float("-inf")
+        self.joined_late = False  # admitted via grow-on-join (not launch)
+
+    @staticmethod
+    def _default_factory():
+        from autodist_tpu.runtime.coordination import CoordinationClient
+        host = (const.ENV.ADT_COORDINATOR_ADDR.val.split(":")[0]
+                or "127.0.0.1")
+        return CoordinationClient(host, const.ENV.ADT_COORDSVC_PORT.val,
+                                  timeout=const.ENV.ADT_RPC_TIMEOUT_S.val
+                                  or None)
+
+    def _with_client(self, fn):
+        with self._lock:
+            if self._client is None:
+                self._client = self._factory()
+            try:
+                return fn(self._client)
+            except OSError:
+                try:
+                    self._client.close()
+                except OSError:
+                    pass
+                self._client = None
+                raise
+
+    def peek(self) -> Optional[Tuple[int, List[str]]]:
+        """The service's current (epoch, roster); None when unreachable
+        or never published."""
+        now = time.monotonic()
+        if (self._cached is not None
+                and now - self._cached_at < self._fence_cache_s):
+            return self._cached
+        try:
+            info = self._with_client(read_epoch)
+        except OSError:
+            return None
+        if info is not None:
+            self._cached, self._cached_at = info, now
+        return info
+
+    def fence(self, op: str):
+        """Raise :class:`FencedOut` when this process's epoch is stale AND
+        the current roster no longer includes it (see module docstring for
+        why lagging survivors pass). Service unreachable → the write
+        proceeds: the fence guards against zombies, and must not turn a
+        control-plane blip into a training outage (the resilient client
+        and degradation windows own that failure class)."""
+        info = self.peek()
+        if info is None:
+            return
+        epoch, roster = info
+        if epoch > self.epoch and self.worker not in roster:
+            tel.counter_add("elastic.fenced_writes")
+            tel.instant("elastic.fenced_write", "elastic", op=op,
+                        mine=self.epoch, current=epoch, worker=self.worker)
+            from autodist_tpu.telemetry import blackbox
+            blackbox.record("elastic.fenced_write", op=op, mine=self.epoch,
+                            current=epoch, worker=self.worker)
+            raise FencedOut(op, self.epoch, epoch, self.worker, roster)
+
+    def adopt(self, epoch: int, roster: Sequence[str]):
+        """This process finished reconfiguring under ``epoch``."""
+        self.epoch = epoch
+        self.roster = list(roster)
+        self._cached = (epoch, list(roster))
+        self._cached_at = time.monotonic()
+        tel.gauge_set("elastic.epoch", float(epoch))
+
+    def barrier_reconf(self, epoch: int, num_workers: int):
+        """The survivors' reconfiguration barrier — superstep-aligned
+        (every caller sits at a readback boundary), so no process is
+        stranded mid-collective when the old process set is torn down.
+        Blocking by design (members arrive up to a superstep apart), so
+        the per-RPC deadline is lifted for the call."""
+        def call(c):
+            c.set_rpc_timeout(None)
+            try:
+                return c.barrier("elastic/reconf/%d" % epoch, num_workers)
+            finally:
+                try:
+                    c.set_rpc_timeout(const.ENV.ADT_RPC_TIMEOUT_S.val
+                                      or None)
+                except OSError:
+                    pass
+        self._with_client(call)
+
+    def ack(self, epoch: int):
+        """Record that this worker completed the ``epoch`` reconfigure
+        (the chief's escalation timer waits on these)."""
+        self._with_client(
+            lambda c: c.put("elastic/ack/%d/%s" % (epoch, self.worker), "1"))
+
+    def close(self):
+        with self._lock:
+            if self._client is not None:
+                try:
+                    self._client.close()
+                except OSError:
+                    pass
+                self._client = None
+
+
+_current: Optional[Membership] = None
+
+
+def install(membership: Membership) -> Membership:
+    """Install the process-ambient membership (one per process — the
+    fence hooks in the resilience client, PS wire and savers read it)."""
+    global _current
+    _current = membership
+    tel.gauge_set("elastic.epoch", float(membership.epoch))
+    return membership
+
+
+def current() -> Optional[Membership]:
+    return _current
+
+
+def clear():
+    global _current
+    if _current is not None:
+        _current.close()
+    _current = None
+
+
+def maybe_fence(op: str):
+    """Fence hook for write paths: no-op (one global read) unless a
+    membership plane is installed in this process."""
+    m = _current
+    if m is not None:
+        m.fence(op)
+
+
+# -------------------------------------------------- process-set rejoin helper
+
+
+def roster_layout(roster: Sequence[str],
+                  chief: Optional[str] = None) -> List[str]:
+    """Deterministic process layout for a roster: chief first, the rest
+    sorted — every member computes the same ids with no extra round trip
+    (the same determinism ``Cluster`` gets from sorted addresses)."""
+    members = list(dict.fromkeys(roster))
+    if chief is None:
+        chief = members[0] if members else ""
+    if chief not in members:
+        raise ValueError("roster %s does not contain chief %r"
+                         % (members, chief))
+    return [chief] + sorted(a for a in members if a != chief)
+
+
+def epoch_coordinator_address(epoch: int) -> str:
+    """The jax.distributed coordinator address for ``epoch``. Epoch 1
+    (the launch epoch) IS the configured address — the initial bring-up
+    path stays byte-identical; each later epoch binds a fresh port
+    (base − ((epoch−1) mod 89)): the previous process set's runtime may
+    still be draining its socket, and every member derives the same
+    offset from the shared epoch."""
+    addr = const.ENV.ADT_COORDINATOR_ADDR.val
+    if addr and ":" in addr:
+        host, port = addr.rsplit(":", 1)
+        base = int(port)
+    else:
+        host, base = "127.0.0.1", const.DEFAULT_COORDINATOR_PORT
+    return "%s:%d" % (host, base - ((epoch - 1) % 89))
+
+
+def rejoin_process_set(roster: Sequence[str], epoch: int,
+                       chief: Optional[str] = None):
+    """Tear down this process's jax.distributed membership and re-join as
+    the ``epoch`` process set (the in-run half of what PR 8's whole-job
+    re-exec achieved by replacing the process image). Call ONLY from a
+    readback boundary after the reconfiguration barrier — live device
+    buffers of the old mesh are invalid afterwards."""
+    from autodist_tpu.runtime import server_starter
+    layout = roster_layout(roster, chief)
+    me = const.ENV.ADT_WORKER.val or layout[0]
+    if me not in layout:
+        raise FencedOut("rejoin", -1, epoch, me, layout)
+    import os
+    os.environ[const.ENV.ADT_NUM_PROCESSES.name_str] = str(len(layout))
+    os.environ[const.ENV.ADT_PROCESS_ID.name_str] = str(layout.index(me))
+    server_starter.reinit_distributed(
+        coordinator_address=epoch_coordinator_address(epoch),
+        num_processes=len(layout), process_id=layout.index(me))
+
+
+# ------------------------------------------------- worker-side admission wait
+
+
+def wait_for_admission(worker: str, timeout_s: float = 600.0
+                       ) -> Optional[Tuple[int, List[str]]]:
+    """A relaunched/hot-spare worker's bring-up: announce a join and poll
+    until an epoch's roster includes us, then return ``(epoch, roster)``
+    (the caller joins that epoch's jax.distributed set). Returns None when
+    no epoch was ever published (first launch — join from the env instead).
+    """
+    from autodist_tpu.runtime.coordination import CoordinationClient
+    host = (const.ENV.ADT_COORDINATOR_ADDR.val.split(":")[0]
+            or "127.0.0.1")
+    try:
+        client = CoordinationClient(host, const.ENV.ADT_COORDSVC_PORT.val)
+    except OSError:
+        return None
+    try:
+        info = read_epoch(client)
+        if info is None:
+            return None  # pre-epoch bring-up: the normal launch path
+        epoch, roster = info
+        if worker in roster:
+            return epoch, roster  # already admitted (fast relaunch)
+        announce_join(client, worker)
+        logging.warning("elastic: %s announced itself for admission "
+                        "(current epoch %d)", worker, epoch)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            info = read_epoch(client)
+            if info is not None and worker in info[1]:
+                logging.warning("elastic: %s admitted at epoch %d",
+                                worker, info[0])
+                return info
+            time.sleep(0.2)
+        raise TimeoutError(
+            "elastic admission: %s was not admitted within %.0fs"
+            % (worker, timeout_s))
+    finally:
+        try:
+            client.close()
+        except OSError:
+            pass
+
+
+def broadcast_state(snapshot: Optional[dict] = None) -> dict:
+    """Collective state handoff after a GROW: process 0 (the chief, a
+    survivor) broadcasts its host snapshot to the whole new process set
+    so the joiner — which has no state — adopts the run's truth. A plain
+    byte broadcast for now; the arXiv 2112.01075 redistribution
+    collectives are the scale upgrade (ship only the shards each member
+    needs) once state stops fitting one host."""
+    import pickle
+    import jax
+    from autodist_tpu.runtime import server_starter
+    payload = (pickle.dumps(snapshot)
+               if jax.process_index() == 0 else None)
+    return pickle.loads(server_starter.broadcast_bytes(payload))
+
+
+# ------------------------------------------- in-memory state snapshot/adopt
+
+
+def _local_full_value(arr) -> Optional[np.ndarray]:
+    """Assemble the FULL value of a (possibly global) jax.Array from this
+    process's addressable shards alone — no collectives (the old process
+    set may already be missing a member). None when the local shards do
+    not cover the array (cross-process sharded state: the caller falls
+    back to the last-good checkpoint re-shard)."""
+    import jax
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards:
+        return np.asarray(jax.device_get(arr))
+    shape = tuple(arr.shape)
+    out = np.empty(shape, dtype=arr.dtype) if shape else None
+    covered = np.zeros(shape, dtype=bool) if shape else False
+    for s in shards:
+        data = np.asarray(s.data)
+        if not shape:
+            return data  # scalar: any shard is the value
+        out[s.index] = data
+        covered[s.index] = True
+    if not bool(np.all(covered)):
+        return None
+    return out
+
+
+def snapshot_runner_state(runner) -> Optional[dict]:
+    """Host-side snapshot of the runner's TrainState assembled from LIVE
+    LOCAL replicas (zero cross-process collectives, zero disk): the
+    in-memory source for the post-reconfigure re-shard. Device leaves
+    come from this process's addressable shards; host-PS-resident leaves
+    come from the store (authoritative, process-local in the sync mirror
+    mode that the in-run path supports) — the snapshot carries FULL
+    original-layout trees, so the rebuilt DistributedStep's
+    ``init_state`` re-seeds its fresh PSStore exactly like a cold start.
+    None when any leaf is not locally reconstructible — state sharded
+    across processes without a local replica has to come from the
+    last-good checkpoint instead."""
+    import jax
+    state = runner.state
+    if state is None:
+        return None
+    snapshot = {"step": None, "params": None, "opt_state": None,
+                "sync_state": None}
+    for kind in ("params", "opt_state", "sync_state"):
+        tree = getattr(state, kind, None)
+        ok = True
+
+        def take(leaf):
+            nonlocal ok
+            full = _local_full_value(leaf)
+            if full is None:
+                ok = False
+            return full
+        host = jax.tree_util.tree_map(take, tree)
+        if not ok:
+            logging.warning(
+                "elastic: %s is not fully locally replicated — the in-run "
+                "re-shard will fall back to the last-good checkpoint", kind)
+            return None
+        snapshot[kind] = host
+    dstep = runner.distributed_step
+    store = getattr(dstep, "ps_store", None)
+    if store is not None:
+        # host-PS leaves are PSHole pytree nodes in the trees above (zero
+        # leaves — the tree_map never saw them): fill them from the store
+        # the same way gather_params/gather_opt_state do, so the snapshot
+        # is the FULL checkpoint-layout state
+        from autodist_tpu.parallel import ps as ps_lib
+        try:
+            store.drain()
+            snapshot["params"] = ps_lib.fill_holes(snapshot["params"],
+                                                   store.full_values())
+            snapshot["opt_state"] = ps_lib.fill_holes_with_path(
+                snapshot["opt_state"], store.full_opt_leaf)
+        except Exception as e:  # noqa: BLE001 — a store whose owner died
+            # with it (or an unreachable service) cannot seed the rebuild
+            logging.warning(
+                "elastic: host-PS state not locally reconstructible (%s) "
+                "— the in-run re-shard will fall back to the last-good "
+                "checkpoint", e)
+            return None
+    snapshot["step"] = int(np.asarray(_local_full_value(state.step)).ravel()[0])
+    return snapshot
+
+
+def adopt_snapshot(runner, snapshot: dict):
+    """Re-lay the in-memory snapshot out onto the runner's (rebuilt) mesh
+    — the same placement path the checkpoint restore uses
+    (``Saver._restore_at``), minus the disk."""
+    import jax
+    from autodist_tpu.train_state import TrainState
+    dstep = runner.distributed_step
+    state = dstep.init_state(snapshot["params"], snapshot["opt_state"],
+                             snapshot.get("sync_state"))
+    step = snapshot.get("step") or 0
+    state = TrainState(
+        step=dstep._put(np.asarray(step, np.int32),
+                        jax.sharding.PartitionSpec()),
+        params=state.params, opt_state=state.opt_state,
+        sync_state=state.sync_state)
+    runner.state = state
+    notify = getattr(runner, "notify_state_restored", None)
+    if callable(notify):
+        notify()
+    return state

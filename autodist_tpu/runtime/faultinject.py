@@ -71,13 +71,17 @@ class FaultRule:
 
     def __init__(self, spec: dict):
         self.op = spec["op"]
-        if self.op not in ("delay", "reset", "truncate", "restart"):
+        if self.op not in ("delay", "reset", "truncate", "restart",
+                           "partition"):
             raise ValueError("unknown fault op %r" % self.op)
         self.match = spec.get("match", "*")
         self.nth = int(spec.get("nth", 1))
         self.repeat = bool(spec.get("repeat", False))
         self.prob = spec.get("prob")
         self.delay_s = float(spec.get("delay_s", 0.0))
+        # partition: how long the proxy blackholes ALL traffic once this
+        # rule fires (the zombie-revival harness — see FaultyProxy)
+        self.duration_s = float(spec.get("duration_s", 0.0))
         self.bytes = int(spec.get("bytes", 0))
         self.when = spec.get("when", "before")
         self.at_step = spec.get("at_step")
@@ -126,6 +130,14 @@ class FaultPlan:
         self.rng = random.Random(self.seed)
         self.lock = threading.Lock()
         self.injected: List[str] = []  # audit log: what fired, in order
+        # network-partition window (monotonic deadline): while set, every
+        # proxied RPC — on every connection — is HELD until the window
+        # heals, then delivered late. This is the zombie-revival fault:
+        # the partitioned worker is alive but silent (declared dead,
+        # fenced out of the next epoch), and its delayed writes arrive
+        # only after its replacement took over — exactly what the
+        # epoch fence must reject.
+        self.partition_until = 0.0
 
     @classmethod
     def from_env(cls) -> "FaultPlan":
@@ -397,10 +409,25 @@ class FaultyProxy:
                     budget[0] = rule.bytes
                 logging.info("faultinject: truncating reply of %s to %d "
                              "bytes", cmd, rule.bytes)
+            elif rule.op == "partition":
+                with self._plan.lock:
+                    self._plan.partition_until = (time.monotonic()
+                                                  + rule.duration_s)
+                logging.warning("faultinject: PARTITION for %.1fs starting "
+                                "at %s", rule.duration_s, cmd)
             elif rule.op == "restart" and self._restart_fn is not None:
                 logging.warning("faultinject: restarting service at %s %s",
                                 cmd, step_arg)
                 self._restart_fn()
+        with self._plan.lock:
+            hold = self._plan.partition_until - time.monotonic()
+        if hold > 0:
+            # the partition window: hold (don't drop) — delivery resumes
+            # the instant the partition heals, i.e. the zombie's writes
+            # arrive LATE rather than never
+            logging.info("faultinject: holding %s for %.1fs (partition)",
+                         cmd, hold)
+            time.sleep(hold)
         try:
             upstream.sendall(rpc)
         except OSError:

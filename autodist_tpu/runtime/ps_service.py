@@ -33,6 +33,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from autodist_tpu.runtime import elastic
 from autodist_tpu.telemetry import spans as tel
 from autodist_tpu.utils import logging
 
@@ -198,18 +199,24 @@ class CoordPSService(PSServiceBase):
                 pass
 
     def publish(self, version, blob):
+        # epoch-fenced (also enforced inside a resilient client's bput;
+        # raw-client factories get the check here): a zombie owner must
+        # not overwrite the values its replacement now serves
+        elastic.maybe_fence("ps.publish")
         self._client().bput(self._prefix + "/vals", version, blob)
 
     def fetch(self):
         return self._client().bget(self._prefix + "/vals")
 
     def publish_opt(self, version, blob):
+        elastic.maybe_fence("ps.publish_opt")
         self._client().bput(self._prefix + "/opt", version, blob)
 
     def fetch_opt(self):
         return self._client().bget(self._prefix + "/opt")
 
     def push_grads(self, blob):
+        elastic.maybe_fence("ps.push")
         self._client().qpush(self._prefix + "/grads", blob)
 
     def pop_grads(self):
@@ -330,6 +337,15 @@ class AsyncPSWorker:
                 self._busy = False
                 if not self._recover(e, "publish"):
                     return
+            except elastic.FencedOut as e:
+                # this owner was declared dead and superseded: its apply
+                # loop must STOP — every further publish would fight the
+                # replacement's state (healthy turns False; the Runner
+                # fails the job loudly on its next step)
+                self._failed = True
+                self._last_error = e
+                logging.error("async PS owner loop fenced out: %s", e)
+                return
             except Exception as e:  # noqa: BLE001 — a poisoned blob must not kill the loop
                 logging.error("async PS apply failed: %s", e)
             finally:

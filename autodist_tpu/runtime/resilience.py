@@ -46,6 +46,7 @@ import uuid
 from typing import Callable, List, Optional
 
 from autodist_tpu import const
+from autodist_tpu.runtime import elastic
 from autodist_tpu.telemetry import spans as tel
 from autodist_tpu.utils import logging
 
@@ -228,18 +229,25 @@ class ResilientCoordinationClient:
         return self._call(lambda c: c.ping(), "ping")
 
     def put(self, key: str, value: str):
-        # pure overwrite: naturally idempotent, no token needed
+        # pure overwrite: naturally idempotent, no token needed.
+        # Epoch-fenced: KV marks (heartbeat grace, straggler, mirror
+        # digests) from a zombie incarnation must not poison the plane.
+        elastic.maybe_fence("coord.put")
         return self._call(lambda c: c.put(key, value), "put")
 
     def get(self, key: str) -> Optional[str]:
         return self._call(lambda c: c.get(key), "get")
 
     def incr(self, name: str) -> int:
+        elastic.maybe_fence("coord.incr")
         token = self._new_token()
         self.stats["deduped_risk_calls"] += 1
         return self._call(lambda c: c.incr(name, token=token), "incr")
 
     def barrier(self, name: str, num_workers: int):
+        # a zombie arriving at a barrier would satisfy an arrival count
+        # meant for its replacement — fenced like every mutation
+        elastic.maybe_fence("coord.barrier")
         token = self._new_token()
         self.stats["deduped_risk_calls"] += 1
         return self._call(
@@ -247,6 +255,7 @@ class ResilientCoordinationClient:
             "barrier", block=True)
 
     def report_step(self, worker: str, step: int):
+        elastic.maybe_fence("coord.step")
         token = self._new_token()
         self.stats["deduped_risk_calls"] += 1
         return self._call(
@@ -264,9 +273,13 @@ class ResilientCoordinationClient:
         return self._call(lambda c: c.goodbye(worker), "goodbye")
 
     def heartbeat(self, worker: str):
+        # a zombie's heartbeat would keep its dead identity "alive" at
+        # the watchdog across epochs
+        elastic.maybe_fence("coord.heartbeat")
         return self._call(lambda c: c.heartbeat(worker), "heartbeat")
 
     def bput(self, key: str, version: int, payload: bytes):
+        elastic.maybe_fence("coord.bput")
         token = self._new_token()
         self.stats["deduped_risk_calls"] += 1
         return self._call(
@@ -276,6 +289,7 @@ class ResilientCoordinationClient:
         return self._call(lambda c: c.bget(key), "bget")
 
     def qpush(self, queue: str, payload: bytes):
+        elastic.maybe_fence("coord.qpush")
         token = self._new_token()
         self.stats["deduped_risk_calls"] += 1
         return self._call(lambda c: c.qpush(queue, payload, token=token),

@@ -188,6 +188,18 @@ class Runner:
         # at the chief's heartbeat watchdog
         self._compile_grace_marked = False
         self._compile_grace_cleared = False
+        # ---- elastic membership plane (runtime/elastic.py): when a
+        # membership is installed (in-run elastic jobs), readback
+        # boundaries poll the cluster epoch; a bump parks a pending
+        # reconfigure that executes at the next SAFE point (never inside
+        # a dispatch or metrics materialization)
+        from autodist_tpu.runtime import elastic as elastic_lib
+        self._membership = elastic_lib.current()
+        self._reconfigure_fn = None   # wired by AutoDist (rebuild + re-shard)
+        self._reconfig_pending = None  # (epoch, roster) awaiting a safe point
+        self._epoch_poll_at = 0.0
+        self._last_reconfigure_s = None
+        self._reconfigs = 0
         # ---- cluster observability plane (telemetry/): arm the flight
         # recorder (always-on bounded black box; also installs the
         # SIGTERM/exit dump hooks per ADT_BLACKBOX*), the online
@@ -249,6 +261,23 @@ class Runner:
         checkpoint in ``ADT_CKPT_DIR`` is restored over the fresh init —
         every process calls init(), so the restore's collective placement
         runs everywhere."""
+        m = self._membership
+        if m is not None and getattr(m, "joined_late", False):
+            # grow-on-join: this worker was admitted into a RUNNING job —
+            # the survivors broadcast the run's state (the chief sends at
+            # the end of its reconfigure); a fresh init or a stale
+            # checkpoint would diverge from the live run
+            from autodist_tpu.runtime import elastic as elastic_lib
+            snap = elastic_lib.broadcast_state(None)
+            elastic_lib.adopt_snapshot(self, snap)
+            m.joined_late = False
+            try:
+                m.ack(m.epoch)
+            except OSError:
+                pass
+            logging.warning("elastic: adopted broadcast state at step %d "
+                            "(grow-on-join)", snap.get("step") or 0)
+            return self.state
         if const.ENV.ADT_AUTO_RESUME.val:
             # probe BOTH checkpoint formats — a sync-elastic job that
             # checkpoints through ShardedSaver (the scale path) must
@@ -484,6 +513,101 @@ class Runner:
         if self._sentinel is not None:
             self._sentinel.maybe_act()
 
+    # ---------------------------------------- in-run elastic reconfiguration
+
+    def set_reconfigure_handler(self, fn):
+        """Wire the rebuild half of an in-run reconfiguration:
+        ``fn(runner, epoch, roster, snapshot)`` must re-join the process
+        set, rebuild the mesh/programs for it, and re-place the state
+        (AutoDist._elastic_reconfigure is the standard handler;
+        ``snapshot`` is the in-memory host state, or None when some shard
+        had no live local replica — then fall back to the last-good
+        checkpoint re-shard)."""
+        self._reconfigure_fn = fn
+
+    def adopt_distributed_step(self, dstep):
+        """Swap in a rebuilt DistributedStep (post-reconfigure): the
+        remapper and staleness metadata follow the new mesh; step/dispatch
+        counters and telemetry continue — it is the same logical run."""
+        self._dstep = dstep
+        self._remapper = Remapper(dstep.mesh, dstep.mesh_axis,
+                                  seq_axis=dstep.seq_axis,
+                                  batch_axes=dstep.batch_axes,
+                                  seq_keys=getattr(dstep, "seq_feed_keys",
+                                                   None))
+        self._staleness = int(dstep.metadata.get("staleness", 0))
+
+    def _poll_epoch(self):
+        """Readback-boundary membership poll (throttled to
+        ``ADT_ELASTIC_POLL_S``): a published epoch newer than ours parks a
+        pending reconfigure for the next safe point."""
+        m = self._membership
+        if m is None or self._reconfig_pending is not None:
+            return
+        now = time.monotonic()
+        if now < self._epoch_poll_at:
+            return
+        self._epoch_poll_at = now + max(0.05,
+                                        const.ENV.ADT_ELASTIC_POLL_S.val)
+        info = m.peek()
+        if info is not None and info[0] > m.epoch:
+            self._reconfig_pending = info
+            logging.warning(
+                "elastic: cluster epoch %d published (we are at %d) — "
+                "reconfiguring to %d member(s) at the next boundary",
+                info[0], m.epoch, len(info[1]))
+
+    def _maybe_reconfigure(self):
+        """Execute a pending membership change at a SAFE point (no
+        dispatch in flight, metrics all materialized): barrier with the
+        other members of the new epoch, snapshot state from live local
+        replicas, tear down / re-join the process set via the wired
+        handler, and ack. Downtime is the ``elastic.reconfigure`` span."""
+        if self._reconfig_pending is None:
+            return
+        (epoch, roster), self._reconfig_pending = \
+            self._reconfig_pending, None
+        m = self._membership
+        from autodist_tpu.runtime import elastic as elastic_lib
+        if m.worker not in roster:
+            # we were declared dead and survived anyway: a zombie. Every
+            # write path is already fenced; this is the loud exit.
+            raise elastic_lib.FencedOut("reconfigure", m.epoch, epoch,
+                                        m.worker, roster)
+        if self._reconfigure_fn is None:
+            raise RuntimeError(
+                "elastic epoch %d published but no reconfigure handler is "
+                "wired on this Runner (AutoDist.build arms it for in-run "
+                "elastic jobs)" % epoch)
+        t0 = time.perf_counter()
+        with tel.span("elastic.reconfigure", "elastic", epoch=epoch,
+                      world=len(roster), from_world=len(m.roster),
+                      step=self._step_count):
+            # land the fused PS carry / in-flight pushes before snapshot
+            self._dstep.flush_ps()
+            snapshot = elastic_lib.snapshot_runner_state(self)
+            # superstep-aligned rendezvous of the NEW process set: nobody
+            # tears down jax.distributed while a peer is still dispatching
+            m.barrier_reconf(epoch, len(roster))
+            self._reconfigure_fn(self, epoch, roster, snapshot)
+            m.adopt(epoch, roster)
+            try:
+                m.ack(epoch)
+            except OSError:
+                logging.warning("elastic: ack for epoch %d failed (the "
+                                "chief may escalate)", epoch)
+        self._last_reconfigure_s = time.perf_counter() - t0
+        self._reconfigs += 1
+        tel.counter_add("elastic.reconfigs")
+        tel.gauge_set("elastic.epoch", float(epoch))
+        from autodist_tpu.telemetry import blackbox
+        blackbox.record("elastic.reconfigure", epoch=epoch,
+                        world=len(roster),
+                        downtime_s=round(self._last_reconfigure_s, 6))
+        logging.warning(
+            "elastic: reconfigured to epoch %d (%d member(s)) in %.3fs",
+            epoch, len(roster), self._last_reconfigure_s)
+
     def _sentinel_observer(self):
         return self._sentinel.observe if self._sentinel is not None else None
 
@@ -552,6 +676,7 @@ class Runner:
         tel.counter_add("runner.supersteps")
         self._maybe_fleet_profile_stop()
         self._poll_profile_window()
+        self._poll_epoch()
         self._maybe_heartbeat()
         if self._coord is not None:
             # bounded staleness across processes (the reference's size-s
@@ -646,6 +771,7 @@ class Runner:
         readback re-syncs the clock)."""
         t_begin = time.perf_counter()
         self._maybe_sentinel_act()  # a pending rollback replaces self.state
+        self._maybe_reconfigure()   # a pending epoch re-forms the mesh
         st = state if state is not None else self.state
         if st is None:
             raise RuntimeError("Runner.run before init()")
@@ -693,6 +819,7 @@ class Runner:
         k microsteps."""
         t_begin = time.perf_counter()
         self._maybe_sentinel_act()  # a pending rollback replaces self.state
+        self._maybe_reconfigure()   # a pending epoch re-forms the mesh
         if self.state is None:
             raise RuntimeError("Runner.run_superstep before init()")
         self._compile_grace_begin()
@@ -927,6 +1054,18 @@ class Runner:
         out["goodput_breakdown"] = (
             {k: round(v, 6) for k, v in report.buckets.items()}
             if report is not None else None)
+        # elastic plane (stable shape): epoch/reconfigure accounting for
+        # monitoring and the bench --smoke downtime leg
+        m = getattr(self, "_membership", None)
+        out["elastic"] = {
+            "epoch": m.epoch if m is not None else None,
+            "reconfigs": getattr(self, "_reconfigs", 0),
+            "last_reconfigure_s": (
+                round(self._last_reconfigure_s, 6)
+                if getattr(self, "_last_reconfigure_s", None) is not None
+                else None),
+            "fenced_writes": c.get("elastic.fenced_writes", 0.0),
+        }
         return out
 
     def goodput_report(self):

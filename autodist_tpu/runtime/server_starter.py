@@ -47,6 +47,55 @@ def init_distributed(coordinator_address: str, num_processes: int,
     _INITIALIZED = True
 
 
+def reinit_distributed(coordinator_address: str, num_processes: int,
+                       process_id: int):
+    """Tear down this process's jax.distributed membership and join a NEW
+    process set — the in-run elastic reconfiguration path
+    (``runtime/elastic.rejoin_process_set``). The old runtime's device
+    buffers and cached backends are invalid across this call; callers
+    hold a host-side state snapshot and re-place it afterwards.
+
+    Raises ``RuntimeError`` when the runtime cannot be re-initialized in
+    this process (older jax backends without a clean shutdown path) — the
+    coordinator's ack-timeout escalation then falls back to the PR 8
+    whole-job re-exec, which achieves the same membership change by
+    replacing the process image."""
+    global _INITIALIZED
+    import jax
+    if _INITIALIZED:
+        try:
+            jax.distributed.shutdown()
+        except Exception as e:  # noqa: BLE001 — a dead peer can fail the
+            # shutdown barrier; the local teardown below is what matters
+            logging.warning("jax.distributed.shutdown during elastic "
+                            "rejoin: %s (continuing)", e)
+        _INITIALIZED = False
+    # drop the cached XLA backends so the next device query builds
+    # clients for the NEW world (public clear_backends was removed; the
+    # private hook is version-gated and failure here must be loud — a
+    # stale backend would silently run collectives over the dead mesh)
+    try:
+        from jax._src import xla_bridge
+        xla_bridge._clear_backends()
+        jax.clear_caches()
+    except Exception as e:  # noqa: BLE001
+        raise RuntimeError(
+            "cannot re-initialize the XLA backend in-process (%s); "
+            "in-run elastic reconfiguration is unavailable on this jax "
+            "build — falling back to whole-job restart" % e) from e
+    if num_processes <= 1:
+        logging.warning("elastic rejoin: single survivor — local backend "
+                        "only (no jax.distributed)")
+        return
+    logging.warning("elastic rejoin: jax.distributed.initialize(%s, "
+                    "num_processes=%d, process_id=%d)",
+                    coordinator_address, num_processes, process_id)
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _INITIALIZED = True
+
+
 def initialized() -> bool:
     """True once this process's distributed bring-up has happened — a
     jax.distributed join, or an elastic bring-up (which deliberately has
@@ -79,6 +128,33 @@ def maybe_init_distributed():
         logging.info("elastic mode: skipping jax.distributed join "
                      "(process coupling is via the parameter service)")
         return
+    if const.ENV.ADT_ELASTIC_INRUN.val and const.is_worker():
+        # in-run elastic worker bring-up: a relaunched/hot-spare worker
+        # whose roster no longer includes it must NOT join the original
+        # process set (stale env) — it announces itself and joins the
+        # epoch that admits it (runtime/elastic.py grow-on-join)
+        from autodist_tpu.runtime import elastic
+        worker = const.ENV.ADT_WORKER.val
+        info = elastic.wait_for_admission(worker)
+        if info is not None:
+            epoch, roster = info
+            layout = elastic.roster_layout(
+                roster, const.ENV.ADT_COORDINATOR_ADDR.val.split(":")[0]
+                or roster[0])
+            if epoch > 1:
+                membership = elastic.install(
+                    elastic.Membership(worker, epoch, roster))
+                membership.joined_late = True
+                # participate in the survivors' reconfiguration barrier:
+                # its count spans the WHOLE new roster, joiner included
+                membership.barrier_reconf(epoch, len(roster))
+            os.environ[const.ENV.ADT_NUM_PROCESSES.name_str] = (
+                str(len(layout)))
+            os.environ[const.ENV.ADT_PROCESS_ID.name_str] = (
+                str(layout.index(worker)))
+            init_distributed(elastic.epoch_coordinator_address(epoch),
+                             len(layout), layout.index(worker))
+            return
     addr = const.ENV.ADT_COORDINATOR_ADDR.val
     n = const.ENV.ADT_NUM_PROCESSES.val
     if addr and n > 1:

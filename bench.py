@@ -582,9 +582,86 @@ def smoke_main(fused: bool = False):
     result["sentinel"] = sentinel_result
     result["quantized_wire"] = quantized_result
     result["search"] = _smoke_search(loss_fn, params, batches[0])
+    # trace export BEFORE the elastic leg: its builds reset the recorder
+    # (and its reconfigure clears the XLA backend — rebuilt on demand,
+    # but the paired timing legs above must not pay that), so it runs
+    # dead last with the main legs' telemetry already harvested
     result.update(_smoke_telemetry())
+    result["elastic"] = _smoke_elastic(loss_fn, params, batches)
     adt.reset()
     print(RESULT_TAG + json.dumps(result), flush=True)
+
+
+def _smoke_elastic(loss_fn, params, batches):
+    """Elastic leg of the smoke bench: run the smoke MLP under an in-run
+    membership, publish a same-roster epoch bump mid-run, and record what
+    one reconfiguration event COSTS — span-derived downtime seconds and
+    the steps it blocked (downtime / steady median step) — plus the
+    fenced-write counter, so BENCH rounds track the price of an elastic
+    event alongside throughput."""
+    import socket
+
+    import numpy as np
+    import optax
+    import autodist_tpu as adt
+    from autodist_tpu import strategy
+    from autodist_tpu.runtime import elastic
+    from autodist_tpu.runtime.coordination import (CoordinationClient,
+                                                   CoordinationServer)
+    from autodist_tpu.telemetry import spans as tel
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    saved = {k: os.environ.get(k) for k in
+             ("ADT_COORDSVC_PORT", "ADT_ELASTIC", "ADT_ELASTIC_SYNC",
+              "ADT_ELASTIC_INRUN", "ADT_ELASTIC_POLL_S")}
+    os.environ.update({"ADT_COORDSVC_PORT": str(port), "ADT_ELASTIC": "1",
+                       "ADT_ELASTIC_SYNC": "1", "ADT_ELASTIC_INRUN": "1",
+                       "ADT_ELASTIC_POLL_S": "0.01"})
+    srv = CoordinationServer(port)
+    try:
+        srv.start()
+        adt.reset()
+        ad = adt.AutoDist(strategy_builder=strategy.AllReduce())
+        runner = ad.build(loss_fn, optax.adam(1e-2), params, batches[0])
+        runner.init(params)
+        client = CoordinationClient("127.0.0.1", port)
+        m = elastic.current()
+        assert m is not None, "elastic membership was not armed"
+        for i, b in enumerate(batches):
+            runner.run(b)
+            if i == len(batches) // 2:
+                elastic.publish_epoch(client, m.epoch + 1, m.roster)
+                time.sleep(0.05)  # let the poll window lapse
+        client.close()
+        stats = runner.step_stats()
+        assert stats["elastic"]["reconfigs"] == 1, stats["elastic"]
+        spans = tel.get_recorder().durations_s("elastic.reconfigure")
+        downtime = spans[0] if spans else stats["elastic"][
+            "last_reconfigure_s"]
+        steady = stats["steady_median_s"] or 0.0
+        return {
+            "reconfigs": stats["elastic"]["reconfigs"],
+            "epoch": stats["elastic"]["epoch"],
+            "reconfigure_downtime_s": round(float(downtime or 0.0), 4),
+            "steps_blocked": (int(np.ceil(downtime / steady))
+                              if downtime and steady else None),
+            "fenced_writes": stats["elastic"]["fenced_writes"],
+        }
+    except Exception as e:  # noqa: BLE001 — a broken elastic leg must
+        # not sink the whole smoke round; surface it in the json instead
+        print("[bench] elastic smoke leg failed: %s" % e, file=sys.stderr,
+              flush=True)
+        return {"error": "%s: %s" % (type(e).__name__, str(e)[:160])}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        adt.reset()
+        srv.stop()
 
 
 def _smoke_sentinel(loss_fn, params, batches, plain_steps):

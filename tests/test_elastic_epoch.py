@@ -1,0 +1,457 @@
+"""Epoch-fenced elastic membership (runtime/elastic.py).
+
+The in-run shrink/grow plane's testable core, against a REAL coordination
+service: the epoch/roster protocol, the zombie-writer fence on every
+mutating wire path (KV marks, barrier arrival, PS push/publish, checkpoint
+commit), watchdog mark hygiene across epochs, loud knob validation, the
+partition (zombie-revival) fault op, and a real single-process
+reconfiguration — epoch bump → readback-boundary pickup → backend
+teardown/rebuild → in-memory re-shard — driven end to end in a subprocess.
+The multi-process SIGKILL shrink/grow chaos legs live in
+``tests/test_elastic.py`` (slow, nightly).
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from autodist_tpu.runtime import elastic
+from autodist_tpu.runtime.coordination import (CoordinationClient,
+                                               CoordinationServer)
+from autodist_tpu.telemetry import spans as tel
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PORT = 15911
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = CoordinationServer(port=PORT)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(autouse=True)
+def _clean_membership():
+    yield
+    elastic.clear()
+
+
+def _client(**kw):
+    return CoordinationClient("127.0.0.1", PORT, **kw)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ------------------------------------------------------------ knob validation
+
+
+def test_elastic_knobs_validated_loudly(monkeypatch):
+    """Garbage/negative elastic knobs raise a typed error NAMING the knob
+    instead of silently disabling elasticity."""
+    monkeypatch.setenv("ADT_ELASTIC", "-1")
+    with pytest.raises(elastic.ElasticConfigError) as e:
+        elastic.validate_elastic_knobs()
+    assert "ADT_ELASTIC" in str(e.value) and e.value.knob == "ADT_ELASTIC"
+
+    monkeypatch.setenv("ADT_ELASTIC", "two")
+    with pytest.raises(elastic.ElasticConfigError, match="ADT_ELASTIC"):
+        elastic.validate_elastic_knobs()
+
+    monkeypatch.setenv("ADT_ELASTIC", "1")
+    monkeypatch.setenv("ADT_ELASTIC_SYNC", "yes")  # permissive bool trap
+    with pytest.raises(elastic.ElasticConfigError,
+                       match="ADT_ELASTIC_SYNC"):
+        elastic.validate_elastic_knobs()
+
+    # inrun needs the sync-elastic bring-up AND a positive budget
+    monkeypatch.setenv("ADT_ELASTIC_SYNC", "0")
+    monkeypatch.setenv("ADT_ELASTIC_INRUN", "1")
+    with pytest.raises(elastic.ElasticConfigError,
+                       match="ADT_ELASTIC_INRUN"):
+        elastic.validate_elastic_knobs()
+    monkeypatch.setenv("ADT_ELASTIC_SYNC", "1")
+    monkeypatch.setenv("ADT_ELASTIC", "0")
+    with pytest.raises(elastic.ElasticConfigError,
+                       match="ADT_ELASTIC_INRUN"):
+        elastic.validate_elastic_knobs()
+
+    monkeypatch.setenv("ADT_ELASTIC", "2")
+    assert elastic.validate_elastic_knobs() == (2, True, True)
+
+
+def test_coordinator_validates_knobs_at_construction(tmp_path, monkeypatch):
+    """The Coordinator (chief supervision) refuses to come up over a
+    garbage budget — the error must fire at bring-up, not at first death."""
+    monkeypatch.setenv("ADT_ELASTIC", "nope")
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.runtime.cluster import Cluster
+    from autodist_tpu.runtime.coordinator import Coordinator
+    spec = tmp_path / "spec.yml"
+    spec.write_text("nodes:\n  - address: 127.0.0.1\n    chief: true\n"
+                    "    cpus: [0]\n")
+    with pytest.raises(elastic.ElasticConfigError, match="ADT_ELASTIC"):
+        Coordinator("sid", Cluster(ResourceSpec(str(spec))))
+
+
+# ------------------------------------------------------------- epoch protocol
+
+
+def test_epoch_publish_read_monotonic(server):
+    c = _client()
+    assert elastic.read_epoch(c) is None or True  # service may be shared
+    elastic.publish_epoch(c, 10, ["a", "b"])
+    assert elastic.read_epoch(c) == (10, ["a", "b"])
+    with pytest.raises(ValueError, match="monotonically"):
+        elastic.publish_epoch(c, 10, ["a"])
+    elastic.publish_epoch(c, 11, ["a"])
+    assert elastic.read_epoch(c) == (11, ["a"])
+    c.close()
+
+
+def test_roster_layout_and_epoch_address(monkeypatch):
+    assert elastic.roster_layout(["w2", "chiefy", "w1"], "chiefy") == \
+        ["chiefy", "w1", "w2"]
+    with pytest.raises(ValueError, match="chief"):
+        elastic.roster_layout(["w1"], "chiefy")
+    monkeypatch.setenv("ADT_COORDINATOR_ADDR", "10.0.0.1:16000")
+    # epoch 1 (launch) IS the configured address; later epochs offset
+    assert elastic.epoch_coordinator_address(1) == "10.0.0.1:16000"
+    assert elastic.epoch_coordinator_address(2) == "10.0.0.1:15999"
+    assert elastic.epoch_coordinator_address(3) != \
+        elastic.epoch_coordinator_address(2)
+
+
+# ------------------------------------------------------------ the write fence
+
+
+def _counter(name):
+    return tel.counters().get(name, 0.0)
+
+
+def test_fence_rejects_zombie_but_not_lagging_survivor(server):
+    """A zombie (stale epoch, evicted from the roster) gets FencedOut on
+    every mutating path; a lagging survivor (stale epoch, still rostered)
+    keeps writing until its own reconfigure boundary."""
+    c = _client()
+    base = 100
+    elastic.publish_epoch(c, base, ["chief", "w2"])
+
+    zombie = elastic.Membership("w2", base, ["chief", "w2"],
+                                client_factory=_client)
+    survivor = elastic.Membership("chief", base, ["chief", "w2"],
+                                  client_factory=_client)
+    # membership change: w2 is declared dead, the job shrinks
+    elastic.publish_epoch(c, base + 1, ["chief"])
+
+    survivor.fence("anything")  # lagging but rostered: allowed
+    before = _counter("elastic.fenced_writes")
+    with pytest.raises(elastic.FencedOut) as e:
+        zombie.fence("ps.push")
+    assert e.value.op == "ps.push"
+    assert e.value.my_epoch == base and e.value.current_epoch == base + 1
+    assert _counter("elastic.fenced_writes") == before + 1
+
+    # the fence hooks in the resilient client: every mutating RPC of an
+    # installed zombie raises FencedOut; reads still pass
+    elastic.install(zombie)
+    from autodist_tpu.runtime.resilience import ResilientCoordinationClient
+    rc = ResilientCoordinationClient("127.0.0.1", PORT)
+    rejected = 0
+    for call in (lambda: rc.put("straggler/w2", "123.0"),
+                 lambda: rc.heartbeat("w2"),
+                 lambda: rc.barrier("late-barrier", 1),
+                 lambda: rc.report_step("w2", 9),
+                 lambda: rc.bput("ps/vals", 7, b"zzz"),
+                 lambda: rc.qpush("ps/grads", b"zzz")):
+        with pytest.raises(elastic.FencedOut):
+            call()
+        rejected += 1
+    assert rc.get("straggler/w2") is None  # the marks never landed
+    assert _counter("elastic.fenced_writes") >= before + 1 + rejected
+
+    # PS wire facade over a raw client: fenced at the service boundary too
+    from autodist_tpu.runtime import ps_service
+    svc = ps_service.CoordPSService(_client, prefix="fencetest")
+    with pytest.raises(elastic.FencedOut):
+        svc.push_grads(b"blob")
+    with pytest.raises(elastic.FencedOut):
+        svc.publish(1, b"blob")
+    assert svc.pending_grads() == 0  # read path open; nothing enqueued
+    svc.close()
+    rc.close()
+    zombie.close()
+    survivor.close()
+    c.close()
+
+
+def test_fence_open_when_service_unreachable():
+    """The fence guards against zombies, not against control-plane blips:
+    with the service down, writes proceed (the resilience plane owns that
+    failure class)."""
+    def refuse():
+        raise OSError("nobody home")
+    m = elastic.Membership("w", 1, ["w"], client_factory=refuse)
+    m.fence("ps.push")  # no raise
+    m.close()
+
+
+def test_fenced_checkpoint_save_leaves_directory_untouched(server, tmp_path,
+                                                           monkeypatch):
+    """A zombie's late checkpoint save is rejected BEFORE any file is
+    written: the checkpoint directory stays byte-identical to a run where
+    the zombie never woke."""
+    jax = pytest.importorskip("jax")
+    import numpy as np
+    import optax
+    import autodist_tpu as adt
+    from autodist_tpu import strategy as S
+    from autodist_tpu.checkpoint.saver import Saver
+    adt.reset()
+    rng = np.random.RandomState(0)
+    params = {"w": jax.numpy.asarray(rng.randn(4, 2), jax.numpy.float32)}
+
+    def loss_fn(p, batch):
+        return jax.numpy.mean((batch["x"] @ p["w"]) ** 2)
+
+    batch = {"x": rng.randn(8, 4).astype(np.float32)}
+    ad = adt.AutoDist(strategy_builder=S.AllReduce())
+    runner = ad.build(loss_fn, optax.sgd(0.1), params, batch)
+    runner.init(params)
+    runner.run(batch)
+
+    ckpt_dir = tmp_path / "ckpt"
+    saver = Saver(directory=str(ckpt_dir))
+    c = _client()
+    base = 200
+    elastic.publish_epoch(c, base, ["chief", "w9"])
+    elastic.install(elastic.Membership("w9", base, ["chief", "w9"],
+                                       client_factory=_client))
+    elastic.publish_epoch(c, base + 1, ["chief"])  # w9 is now a zombie
+    before = _counter("elastic.fenced_writes")
+    with pytest.raises(elastic.FencedOut, match="ckpt.save"):
+        saver.save(runner)
+    assert sorted(os.listdir(ckpt_dir)) == []  # byte-identical: nothing
+    assert _counter("elastic.fenced_writes") == before + 1
+
+    # the successor (current epoch) saves fine into the same directory
+    elastic.clear()
+    elastic.install(elastic.Membership("chief", base + 1, ["chief"],
+                                       client_factory=_client))
+    assert saver.save(runner) is not None
+    assert any(f.endswith(".meta.json") for f in os.listdir(ckpt_dir))
+    c.close()
+    adt.reset()
+
+
+# ------------------------------------------- watchdog mark hygiene × epochs
+
+
+def _mini_coordinator(tmp_path, monkeypatch):
+    monkeypatch.setenv("ADT_COORDSVC_PORT", str(PORT))
+    from autodist_tpu.resource_spec import ResourceSpec
+    from autodist_tpu.runtime.cluster import Cluster
+    from autodist_tpu.runtime.coordinator import Coordinator
+    spec = tmp_path / "spec.yml"
+    spec.write_text(
+        "nodes:\n  - address: 127.0.0.1\n    chief: true\n    cpus: [0]\n"
+        "  - address: localhost\n    cpus: [0]\n")
+    return Coordinator("sid-hygiene", Cluster(ResourceSpec(str(spec))),
+                       heartbeat_timeout=5.0, max_restarts=0)
+
+
+def test_mark_gc_scrubs_dead_incarnation(server, tmp_path, monkeypatch):
+    """gc_worker_marks clears heartbeat + compiling + straggler records of
+    a worker leaving the roster, so a dead incarnation can neither satisfy
+    nor poison freshness checks across epochs."""
+    coord = _mini_coordinator(tmp_path, monkeypatch)
+    c = _client()
+    c.heartbeat("wgc")
+    c.put("compiling/wgc", repr(time.time()))
+    c.put("straggler/wgc", repr(time.time()))
+    assert coord._in_compile_grace(c, "wgc") is True
+    assert coord._is_straggling(c, "wgc") is True
+    assert "wgc" not in c.dead_workers(5.0)  # fresh beat
+
+    elastic.gc_worker_marks(c, "wgc")
+    assert coord._in_compile_grace(c, "wgc") is False
+    assert coord._is_straggling(c, "wgc") is False
+    # deregistered: the stale beat cannot age into a false death either
+    assert "wgc" not in c.dead_workers(0.0)
+    c.close()
+
+
+def test_straggler_flag_does_not_carry_across_epochs(server, tmp_path,
+                                                     monkeypatch):
+    """Satellite: a worker flagged straggling in epoch N must not carry
+    the flag into its epoch N+1 incarnation — the admission path GCs the
+    marks, and the new incarnation starts clean while a compile-grace
+    mark it writes itself still works."""
+    coord = _mini_coordinator(tmp_path, monkeypatch)
+    c = _client()
+    # epoch N: the incarnation is flagged slow-but-alive mid-compile
+    c.put("straggler/wsx", repr(time.time()))
+    c.put("compiling/wsx", repr(time.time()))
+    assert coord._is_straggling(c, "wsx") is True
+    # epoch N+1: wsx died, was shrunk away, relaunched, admitted — the
+    # admission path (coordinator._maybe_admit_joiners) GCs its marks
+    elastic.gc_worker_marks(c, "wsx")
+    assert coord._is_straggling(c, "wsx") is False
+    assert coord._in_compile_grace(c, "wsx") is False
+    # the NEW incarnation's own compile grace works from a clean slate
+    c.put("compiling/wsx", repr(time.time()))
+    assert coord._in_compile_grace(c, "wsx") is True
+    assert coord._is_straggling(c, "wsx") is False
+    c.close()
+
+
+# ----------------------------------------------- partition (zombie) fault op
+
+
+@pytest.mark.chaos
+def test_partition_fault_holds_and_then_delivers(server):
+    """The ``partition`` op blackholes ALL proxied traffic for its window,
+    then delivers LATE — the zombie-revival timing the epoch fence must
+    beat (writes arrive after the worker was declared dead)."""
+    from autodist_tpu.runtime.faultinject import FaultPlan, FaultyProxy
+    plan = FaultPlan({"faults": [
+        {"op": "partition", "match": "INC", "nth": 1, "duration_s": 0.8}]})
+    with FaultyProxy("127.0.0.1", PORT, plan=plan) as proxy:
+        c = CoordinationClient("127.0.0.1", proxy.port)
+        t0 = time.monotonic()
+        assert c.incr("part-n") >= 1      # fires AND is held itself
+        held = time.monotonic() - t0
+        assert held >= 0.7, held           # delivered late, not dropped
+        t0 = time.monotonic()
+        c.put("part-k", "v")               # window over: fast again
+        assert time.monotonic() - t0 < 0.5
+        assert c.get("part-k") == "v"
+        assert "partition:INC" in plan.injected
+        c.close()
+
+
+# ------------------------------------- real single-process reconfigure (e2e)
+
+
+INRUN_DRIVER = """
+import json, os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import optax
+import autodist_tpu as adt
+from autodist_tpu import strategy
+from autodist_tpu.runtime import elastic
+from autodist_tpu.runtime.coordination import (CoordinationClient,
+                                               CoordinationServer)
+from autodist_tpu.telemetry import spans as tel
+
+outdir = sys.argv[1]
+builder_name = sys.argv[2] if len(sys.argv) > 2 else "AllReduce"
+def make_builder():
+    # PS() exercises the host-PS-resident half of the snapshot: the
+    # rebuilt store must be re-seeded from the filled snapshot trees
+    return getattr(strategy, builder_name)(sync=True) \
+        if builder_name == "PS" else getattr(strategy, builder_name)()
+port = int(os.environ["ADT_COORDSVC_PORT"])
+srv = CoordinationServer(port)
+srv.start()
+
+rng = np.random.RandomState(0)
+params = {"w": jax.numpy.asarray(rng.randn(8, 4) * 0.3, jax.numpy.float32)}
+
+def loss_fn(p, batch):
+    return jax.numpy.mean((batch["x"] @ p["w"] - batch["y"]) ** 2)
+
+batch = {"x": rng.randn(8, 8).astype(np.float32),
+         "y": rng.randn(8, 4).astype(np.float32)}
+
+# uninterrupted reference first (no elastic knobs read at build)
+ad = adt.AutoDist(strategy_builder=make_builder())
+step = ad.function(loss_fn, optimizer=optax.sgd(0.05), params=params)
+ref = [float(step(batch)["loss"]) for _ in range(10)]
+adt.reset()
+
+os.environ["ADT_ELASTIC"] = "1"
+os.environ["ADT_ELASTIC_SYNC"] = "1"
+os.environ["ADT_ELASTIC_INRUN"] = "1"
+os.environ["ADT_ELASTIC_POLL_S"] = "0.01"
+ad = adt.AutoDist(strategy_builder=make_builder())
+runner = ad.build(loss_fn, optax.sgd(0.05), params, batch)
+runner.init(params)
+m = elastic.current()
+assert m is not None, "in-run membership was not armed"
+assert m.epoch == 1, m.epoch
+
+client = CoordinationClient("127.0.0.1", port)
+losses = []
+for i in range(10):
+    losses.append(float(runner.run(batch)["loss"]))
+    if i == 4:
+        # membership change with the same roster: the runner must pick it
+        # up at a readback boundary, tear down + rebuild mesh/programs,
+        # and re-shard its state in memory — losses continue exactly
+        elastic.publish_epoch(client, 2, m.roster)
+        time.sleep(0.05)  # let the poll window lapse
+
+stats = runner.step_stats()
+spans = tel.get_recorder().durations_s("elastic.reconfigure")
+out = {"ref": ref, "losses": losses, "reconfigs": runner._reconfigs,
+       "epoch": elastic.current().epoch, "elastic": stats["elastic"],
+       "reconfigure_spans": spans}
+with open(os.path.join(outdir, "out.json"), "w") as f:
+    json.dump(out, f)
+print("DRIVER_DONE", flush=True)
+srv.stop()
+"""
+
+
+@pytest.mark.parametrize("builder", ["AllReduce", "PS"])
+def test_inrun_reconfigure_single_process_e2e(tmp_path, builder):
+    """A REAL in-run reconfiguration driven end to end (subprocess, so
+    the backend teardown cannot disturb other tests): publish epoch 2 →
+    the runner reconfigures at its next boundary (backend cleared, mesh +
+    programs rebuilt, state re-sharded from the in-memory snapshot — for
+    PS, the rebuilt host store re-seeded from the filled snapshot) → the
+    loss trajectory is exactly the uninterrupted run's, the reconfigure
+    span carries the downtime, and the epoch gauge/counters advance."""
+    script = tmp_path / "driver.py"
+    script.write_text(INRUN_DRIVER)
+    env = dict(os.environ)
+    for k in ("ADT_WORKER", "ADT_ELASTIC", "ADT_ELASTIC_SYNC",
+              "ADT_ELASTIC_INRUN"):
+        env.pop(k, None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "ADT_COORDSVC_PORT": str(_free_port()),
+        "ADT_TRACE": "1",
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(HERE)] +
+            ([os.environ["PYTHONPATH"]] if os.environ.get("PYTHONPATH")
+             else [])),
+    })
+    proc = subprocess.run([sys.executable, str(script), str(tmp_path),
+                           builder],
+                          env=env, capture_output=True, text=True,
+                          timeout=240)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-4000:]
+    out = json.loads((tmp_path / "out.json").read_text())
+    assert out["reconfigs"] == 1, out
+    assert out["epoch"] == 2, out
+    assert out["elastic"]["last_reconfigure_s"] > 0
+    assert len(out["reconfigure_spans"]) == 1  # downtime is span-derived
+    assert out["reconfigure_spans"][0] > 0
+    # state survived the reconfiguration bit-exactly: the interrupted
+    # run's losses match the uninterrupted reference at every step
+    import numpy as np
+    np.testing.assert_allclose(out["losses"], out["ref"],
+                               rtol=1e-6, atol=1e-7)
