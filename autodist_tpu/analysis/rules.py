@@ -736,6 +736,16 @@ def verify_sentinel(policy, metadata: dict) -> List[Diagnostic]:
     return out
 
 
+def fail_fast_model_axes(strategy) -> dict:
+    """The model-parallel mesh axes that make a topology fail-fast for
+    BOTH in-run shrink (ADT430) and planned preemption handoff (ADT432)
+    — one predicate, so the two lints and the coordinator's runtime
+    shrink decision can never disagree about what "fail-fast" means."""
+    mesh_shape = strategy.graph_config.mesh_shape or {}
+    return {ax: n for ax, n in mesh_shape.items()
+            if ax != const.DATA_AXIS and int(n) > 1}
+
+
 def verify_elastic(strategy, dead_worker: str = "") -> List[Diagnostic]:
     """ADT43x — can this job's topology survive an IN-RUN elastic shrink
     (``runtime/elastic.py``)? Shared by the pre-compile lint and the
@@ -753,9 +763,7 @@ def verify_elastic(strategy, dead_worker: str = "") -> List[Diagnostic]:
       committed checkpoint to fall back to for that state.
     """
     out: List[Diagnostic] = []
-    mesh_shape = strategy.graph_config.mesh_shape or {}
-    model_axes = {ax: n for ax, n in mesh_shape.items()
-                  if ax != const.DATA_AXIS and int(n) > 1}
+    model_axes = fail_fast_model_axes(strategy)
     if model_axes:
         out.append(warning(
             "ADT430",
@@ -781,6 +789,31 @@ def verify_elastic(strategy, dead_worker: str = "") -> List[Diagnostic]:
                     fixit="keep PS destinations on the chief, or "
                           "checkpoint at least once per restart window"))
                 break
+    return out
+
+
+def verify_preemption(strategy) -> List[Diagnostic]:
+    """ADT432 — preemption handoff armed on a topology the elasticity
+    matrix marks fail-fast. The planned-handoff path
+    (``runtime/preemption.py``) rides the in-run elastic shrink, and a
+    model-parallel strategy cannot shrink (ADT430): every announced
+    departure then degrades to rescue-checkpoint + whole-job restart —
+    legal, but the operator armed a graceful-handoff feature that can
+    never actually hand off. Warned at BUILD time, not at the first
+    eviction (docs/failure_model.md has the per-family matrix)."""
+    out: List[Diagnostic] = []
+    model_axes = fail_fast_model_axes(strategy)
+    if model_axes:
+        out.append(warning(
+            "ADT432",
+            "preemption handoff is armed but the strategy partitions "
+            "state over model-parallel mesh axes %s — the elasticity "
+            "matrix marks this family fail-fast, so every planned "
+            "departure degrades to rescue-checkpoint + whole-job "
+            "restart instead of a live handoff" % (model_axes,),
+            fixit="use a data-parallel strategy for live handoffs, or "
+                  "accept the checkpoint-restart path and size "
+                  "ADT_PREEMPT_DEADLINE_S to cover a full save"))
     return out
 
 

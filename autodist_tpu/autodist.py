@@ -71,6 +71,8 @@ def reset():
     _bb.reset()  # clear the flight recorder's event/log tails
     from autodist_tpu.runtime import elastic as _elastic
     _elastic.clear()  # drop the epoch-fenced membership (and its socket)
+    from autodist_tpu.runtime import preemption as _preemption
+    _preemption.reset()  # forget signal notices and armed guards
 
 
 class AutoDist:
@@ -418,12 +420,17 @@ class AutoDist:
         ADT430 job can never shrink in-run, so say so at build time, not
         at the first death."""
         from autodist_tpu.analysis import rules as rules_lib
-        from autodist_tpu.runtime import elastic
+        from autodist_tpu.runtime import elastic, preemption
         # single-node jobs never construct a Coordinator, so the loud
         # knob validation must also run here
         elastic.validate_elastic_knobs()
+        preemption.validate_preempt_knobs()
         for d in rules_lib.verify_elastic(strategy):
             logging.warning("elastic: %s", d.format())
+        # the planned-handoff path rides the in-run shrink, so arming it
+        # on a fail-fast (model-parallel) family warns at build time
+        for d in rules_lib.verify_preemption(strategy):
+            logging.warning("preemption: %s", d.format())
         if elastic.current() is not None:
             return  # admitted via grow-on-join: membership already live
         self._orig_spec = self._resource_spec

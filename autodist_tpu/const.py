@@ -123,6 +123,25 @@ class ENV(Enum):
     # the coordinator when a worker's death triggers two consecutive
     # whole-job restarts; can also be set by hand to decommission a host.
     ADT_ELASTIC_EXCLUDE = ("ADT_ELASTIC_EXCLUDE", str, "")
+    # ---- preemption plane (runtime/preemption.py): advance-notice
+    # graceful departure. Default grace window a SIGTERM notice budgets
+    # when the sender attached no explicit deadline (seconds — TPU
+    # maintenance gives minutes, spot VMs ~30s); the rescue checkpoint is
+    # skipped when the remaining budget is below the measured save p99.
+    # Validated loudly (preemption.validate_preempt_knobs).
+    ADT_PREEMPT_DEADLINE_S = ("ADT_PREEMPT_DEADLINE_S", float, 30.0)
+    # how often Runners poll the preempt/<worker> notice marks at
+    # readback boundaries (piggybacked on the elastic epoch poll;
+    # 0 disables the KV poll — local SIGTERM notices still work)
+    ADT_PREEMPT_POLL_S = ("ADT_PREEMPT_POLL_S", float, 1.0)
+    # Retry-After (seconds) a draining serving tier attaches to its typed
+    # sheds, so load balancers re-route instead of hammering the leaver
+    ADT_DRAIN_RETRY_AFTER_S = ("ADT_DRAIN_RETRY_AFTER_S", float, 5.0)
+    # cloud maintenance-event poll hook: a path whose EXISTENCE signals a
+    # pending maintenance eviction for this host (its JSON body may carry
+    # {"deadline_s": ..., "reason": ...}). Cloud integrations materialize
+    # the metadata-server event into this file; tests touch it directly.
+    ADT_MAINTENANCE_FILE = ("ADT_MAINTENANCE_FILE", str, "")
     # ---- control-plane resilience knobs (runtime/resilience.py, the
     # failure model in docs/failure_model.md documents how they compose)
     # TCP connect timeout for every CoordinationClient (seconds)

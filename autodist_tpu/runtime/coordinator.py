@@ -97,6 +97,25 @@ class Coordinator:
         self._restart_at: dict = {}        # address -> last relaunch time
         self._launch_cmds: dict = {}       # address -> (command, env)
         self._live_procs: dict = {}        # address -> current launcher proc
+        # preemption plane: address -> announced departure deadline (wall
+        # clock). A worker here is LEAVING ON PURPOSE — its heartbeat
+        # silence is expected and its exit is shutdown (never failure).
+        # Filled by BOTH the shrink planner and the watchdog's
+        # _is_departing consultation, so it must NOT double as the
+        # "shrink already published" memory — that lives in
+        # _departures_handled (a consultation caching first would
+        # otherwise suppress the planned shrink forever).
+        self._planned_departures: dict = {}
+        self._departures_handled: set = set()
+        # addresses whose survivor epoch WAS published: only these skip
+        # the process watcher's failure path on a nonzero exit — a
+        # planned departure the chief could NOT shrink for (fail-fast
+        # topology, chief leaving) must still take the whole-job restart
+        # its log promises when the leaver dies
+        self._departures_shrunk: set = set()
+        # preempt/seq cursor: the planner re-scans the per-worker notice
+        # marks only when a publish bumped it (one GET per tick steady)
+        self._preempt_seq_seen: str = ""
         # sync-elastic (checkpoint-restore orchestration): worker death
         # restarts the WHOLE job from the latest checkpoint instead of
         # relaunching one worker. ADT_ELASTIC_SYNC at bring-up declares the
@@ -170,6 +189,36 @@ class Coordinator:
             return True
         return False
 
+    def _is_departing(self, client, worker: str) -> bool:
+        """True while ``worker`` holds a live preemption notice (or its
+        announced deadline has not aged out): its heartbeat silence and
+        process exit are an ANNOUNCED departure mid-handoff, and routing
+        it to the unplanned-death path (shrink escalation + mark GC)
+        would race — and corrupt — the graceful handoff it is running.
+        Consulted BEFORE any dead declaration (the planned-departure
+        satellite of the preemption plane)."""
+        deadline = self._planned_departures.get(worker)
+        if deadline is None:
+            from autodist_tpu.runtime import preemption
+            try:
+                notice = preemption.read_notice(client, worker)
+            except OSError:
+                return False
+            if notice is None:
+                return False
+            deadline = notice.deadline
+            self._planned_departures[worker] = deadline
+        # grace past the deadline: the platform's SIGKILL and the exit
+        # propagation take a moment; afterwards the departure is complete
+        # and normal (dead) accounting may resume for the NEXT incarnation
+        if time.time() < deadline + 2 * self._heartbeat_timeout:
+            logging.info(
+                "watchdog: worker %s missed heartbeats but announced its "
+                "departure — expected, not escalating", worker)
+            return True
+        self._planned_departures.pop(worker, None)
+        return False
+
     def start_watchdog(self):
         """Heartbeat-based failure detection via the coordination service
         (augments the process-exit watcher): a worker that stops heartbeating
@@ -227,6 +276,13 @@ class Coordinator:
                     self._maybe_admit_joiners(client)
                 except OSError:
                     pass  # service blip: the next tick retries
+                # preemption: an ANNOUNCED departure is handled while the
+                # leaver is still alive — publish the survivor roster now
+                # (no detection latency, no false-death escalation)
+                try:
+                    self._maybe_plan_departures(client)
+                except OSError:
+                    pass  # service blip: the next tick retries
                 # elastic-aware: a worker with restart budget left may be
                 # mid-relaunch (import + trace + compile easily exceeds the
                 # heartbeat window) — skip anything inside a fresh
@@ -255,6 +311,11 @@ class Coordinator:
                 # would turn a throttled host into a real outage
                 dead = [d for d in dead
                         if not self._is_straggling(client, d)]
+                # announced departures: the leaver's silence is the
+                # handoff, not a death — the planned-shrink path above
+                # (_maybe_plan_departures) already owns its recovery
+                dead = [d for d in dead
+                        if not self._is_departing(client, d)]
                 fatal = [d for d in dead
                          if self._max_restarts <= self._restarts.get(d, 0)]
                 for d in dead:
@@ -371,6 +432,27 @@ class Coordinator:
         def watch():
             code = proc.wait()
             if code != 0 and not self._stop_watchdog.is_set():
+                if address in self._departures_shrunk:
+                    # an announced leaver whose survivor shrink WAS
+                    # published: its exit is shutdown, not failure —
+                    # even a nonzero code (the platform's deadline
+                    # SIGKILL) must not abort the survivors or burn a
+                    # restart. (A planned departure the chief could NOT
+                    # shrink for falls through to _try_restart: the
+                    # whole-job restart is its recovery.) Scrub its
+                    # liveness records so the stale beat never ages
+                    # against a future incarnation.
+                    logging.warning(
+                        "preemption: announced leaver %s exited with code "
+                        "%s — planned departure complete", address, code)
+                    try:
+                        from autodist_tpu.runtime import elastic
+                        c = self._coordsvc_client()
+                        elastic.gc_worker_marks(c, address)
+                        c.close()
+                    except OSError:
+                        pass
+                    return
                 try:
                     restarted = self._try_restart(address, code, proc)
                 except Exception as e:  # noqa: BLE001 — a broken restart
@@ -626,6 +708,80 @@ class Coordinator:
             if self._stop_watchdog.wait(0.25):
                 return
 
+    def _maybe_plan_departures(self, client):
+        """Planned handoff, chief side: a rostered worker published a
+        preemption notice — publish the survivor roster at epoch+1 NOW,
+        while the leaver is still alive and lockstep. The survivors
+        reconfigure at their next readback boundary with step-exact live
+        replicas (no checkpoint fallback, no watchdog detection
+        latency); the leaver — excluded from the new roster — runs its
+        graceful departure instead of the zombie fence-out. No reap, no
+        relaunch, no restart-budget spend: the host is being taken away,
+        not recovered."""
+        from autodist_tpu.runtime import elastic, preemption
+        if not self._inrun:
+            return
+        # one-key steady state: scan the per-worker marks only when a
+        # publish bumped preempt/seq (the same cursor the runner-side
+        # guard polls). The cursor is consumed only after a FULL scan
+        # that published nothing — a tick that planned one shrink leaves
+        # it unconsumed so any second notice is planned next tick.
+        seq = client.get(preemption.SEQ_KEY) or ""
+        if seq == self._preempt_seq_seen:
+            return
+        info = elastic.read_epoch(client)
+        if info is None:
+            return
+        epoch, roster = info
+        for addr in roster:
+            if addr in self._departures_handled:
+                continue  # this departure's shrink decision is made
+            notice = preemption.read_notice(client, addr)
+            if notice is None or preemption.has_left(client, addr):
+                continue
+            self._departures_handled.add(addr)
+            self._planned_departures[addr] = notice.deadline
+            if addr == "chief" or self._cluster.is_chief(addr):
+                logging.error(
+                    "preemption: the CHIEF announced departure (%s) — a "
+                    "chief handoff needs external re-election; relying on "
+                    "the rescue checkpoint + ADT_AUTO_RESUME relaunch",
+                    notice.reason)
+                continue
+            reason = self._shrink_unsound_reason(addr)
+            if reason is not None:
+                logging.error(
+                    "preemption: %s announced departure but the topology "
+                    "cannot shrink past it (%s) — it departs with its "
+                    "rescue checkpoint and the job takes the whole-job "
+                    "restart when it exits", addr, reason)
+                continue
+            survivors = [a for a in roster if a != addr]
+            if not survivors:
+                continue  # last worker standing: nothing to shrink to
+            elastic.publish_epoch(client, epoch + 1, survivors)
+            self._departures_shrunk.add(addr)
+            tel.counter_add("preempt.planned_shrinks")
+            tel.instant("preempt.planned_shrink", "preempt", worker=addr,
+                        epoch=epoch + 1, world=len(survivors),
+                        reason=notice.reason)
+            logging.warning(
+                "preemption: planned shrink for announced leaver %s (%s, "
+                "%.1fs of grace) — published epoch %d with %d "
+                "survivor(s); the leaver hands off ALIVE at its next "
+                "boundary", addr, notice.reason,
+                max(notice.remaining_s(), 0.0), epoch + 1, len(survivors))
+            # same escalation ladder as the unplanned shrink: survivors
+            # that never ack get the whole-job checkpoint restart
+            t = threading.Thread(
+                target=self._watch_acks,
+                args=(epoch + 1, survivors, addr, "preempted"),
+                daemon=True)
+            t.start()
+            self._threads.append(t)
+            return  # one membership change per tick (cursor unconsumed)
+        self._preempt_seq_seen = seq
+
     def _maybe_admit_joiners(self, client):
         """Grow-on-join: admit relaunched/hot-spare workers that announced
         themselves (``elastic/join/<worker>``) by publishing the grown
@@ -651,9 +807,17 @@ class Coordinator:
                 elastic.gc_worker_marks(client, a)
         if not joiners:
             return
+        from autodist_tpu.runtime import preemption
         for a in joiners:
             elastic.clear_join(client, a)
             elastic.gc_worker_marks(client, a)
+            # a previous incarnation's departure notice must not make
+            # the watchdog treat the NEW incarnation as leaving — and a
+            # future departure of the same address must plan afresh
+            preemption.clear_notice(client, a)
+            self._planned_departures.pop(a, None)
+            self._departures_handled.discard(a)
+            self._departures_shrunk.discard(a)
         grown = roster + sorted(joiners)
         elastic.publish_epoch(client, epoch + 1, grown)
         tel.counter_add("elastic.grows")

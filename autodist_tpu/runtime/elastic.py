@@ -259,6 +259,12 @@ class Membership:
         self._cached: Optional[Tuple[int, List[str]]] = None
         self._cached_at = float("-inf")
         self.joined_late = False  # admitted via grow-on-join (not launch)
+        # wall-clock deadline of an ANNOUNCED departure of THIS worker
+        # (runtime/preemption.py): until it passes, the fence yields —
+        # the planned-shrink epoch is published while the leaver still
+        # runs its final lockstep boundary (rescue checkpoint, flush),
+        # and fencing those writes would strand its peers mid-collective
+        self._departure_until = 0.0
 
     @staticmethod
     def _default_factory():
@@ -298,17 +304,31 @@ class Membership:
             self._cached, self._cached_at = info, now
         return info
 
+    def expect_departure(self, deadline: float):
+        """Announced planned departure of THIS worker
+        (``runtime/preemption.py``): keep the fence open for it until
+        ``deadline`` even after an epoch excludes it — the leaver
+        participates ALIVE in its final boundary (rescue checkpoint,
+        flush, left stamp) by design, and its peers are in collectives
+        with it. Past the deadline the platform's SIGKILL has fired and
+        zombie semantics resume: a late incarnation is fenced again."""
+        self._departure_until = max(self._departure_until, float(deadline))
+
     def fence(self, op: str):
         """Raise :class:`FencedOut` when this process's epoch is stale AND
         the current roster no longer includes it (see module docstring for
-        why lagging survivors pass). Service unreachable → the write
-        proceeds: the fence guards against zombies, and must not turn a
-        control-plane blip into a training outage (the resilient client
-        and degradation windows own that failure class)."""
+        why lagging survivors pass — and :meth:`expect_departure` for why
+        an announced leaver passes until its deadline). Service
+        unreachable → the write proceeds: the fence guards against
+        zombies, and must not turn a control-plane blip into a training
+        outage (the resilient client and degradation windows own that
+        failure class)."""
         info = self.peek()
         if info is None:
             return
         epoch, roster = info
+        if time.time() < self._departure_until:
+            return  # announced leaver finishing its final boundary
         if epoch > self.epoch and self.worker not in roster:
             tel.counter_add("elastic.fenced_writes")
             tel.instant("elastic.fenced_write", "elastic", op=op,

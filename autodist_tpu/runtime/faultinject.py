@@ -35,6 +35,11 @@ Fault classes (``op``):
 - ``restart``  — when a ``STEP`` command with step >= ``at_step`` passes
   through, invoke the proxy's ``restart_fn`` (kill + relaunch the real
   service); models a control-plane crash mid-run.
+- ``preempt``  — advance-notice eviction of a supervised process: a real
+  SIGTERM to ``pid`` (or the proxy's ``preempt_pid``) when the rule
+  fires, then a real SIGKILL ``deadline_s`` later — the spot-VM /
+  maintenance-event timing the preemption plane
+  (``runtime/preemption.py``) must beat.
 
 Matching: ``match`` prefix-matches the command word (``"*"`` = any
 non-PING command; PING is the liveness probe both sides use and is never
@@ -72,7 +77,7 @@ class FaultRule:
     def __init__(self, spec: dict):
         self.op = spec["op"]
         if self.op not in ("delay", "reset", "truncate", "restart",
-                           "partition"):
+                           "partition", "preempt"):
             raise ValueError("unknown fault op %r" % self.op)
         self.match = spec.get("match", "*")
         self.nth = int(spec.get("nth", 1))
@@ -85,6 +90,13 @@ class FaultRule:
         self.bytes = int(spec.get("bytes", 0))
         self.when = spec.get("when", "before")
         self.at_step = spec.get("at_step")
+        # preempt: advance-notice eviction of the target process — a REAL
+        # SIGTERM the moment this rule fires, then a REAL SIGKILL
+        # ``deadline_s`` later (the spot-VM / maintenance-event timing;
+        # see deliver_preemption). ``pid`` defaults to the proxy's
+        # ``preempt_pid`` (the training subprocess a chaos harness runs).
+        self.deadline_s = spec.get("deadline_s")
+        self.pid = spec.get("pid")
         self._matched = 0
         self._spent = False
 
@@ -181,10 +193,14 @@ class FaultyProxy:
 
     def __init__(self, upstream_host: str, upstream_port: int,
                  listen_port: int = 0, plan: Optional[FaultPlan] = None,
-                 restart_fn: Optional[Callable[[], None]] = None):
+                 restart_fn: Optional[Callable[[], None]] = None,
+                 preempt_pid: Optional[int] = None):
         self._upstream = (upstream_host, upstream_port)
         self._plan = plan if plan is not None else FaultPlan.from_env()
         self._restart_fn = restart_fn
+        # target for "preempt" rules without an explicit pid — the
+        # training subprocess a chaos harness supervises
+        self.preempt_pid = preempt_pid
         self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listen.bind(("127.0.0.1", listen_port))
@@ -415,6 +431,16 @@ class FaultyProxy:
                                                   + rule.duration_s)
                 logging.warning("faultinject: PARTITION for %.1fs starting "
                                 "at %s", rule.duration_s, cmd)
+            elif rule.op == "preempt":
+                pid = rule.pid if rule.pid is not None else self.preempt_pid
+                if pid is None:
+                    logging.error(
+                        "faultinject: preempt rule fired at %s but no "
+                        "target pid is configured (rule 'pid' or "
+                        "FaultyProxy(preempt_pid=)) — skipping", cmd)
+                else:
+                    deliver_preemption(int(pid), rule.deadline_s,
+                                       reason="faultinject@%s" % cmd)
             elif rule.op == "restart" and self._restart_fn is not None:
                 logging.warning("faultinject: restarting service at %s %s",
                                 cmd, step_arg)
@@ -441,6 +467,52 @@ class FaultyProxy:
             self._hard_reset(client)
             return False
         return True
+
+
+# ====================================================== preemption delivery
+#
+# The PREEMPT fault plane: a planned eviction is a real SIGTERM followed,
+# one grace window later, by a real SIGKILL — exactly what a spot VM or a
+# TPU maintenance event delivers. Available as a wire-plan op
+# (``{"op": "preempt", "match": "STEP", "nth": 20, "deadline_s": 5}`` on
+# a FaultyProxy supervising a training subprocess) and directly as
+# :func:`deliver_preemption` for chaos harnesses that schedule the
+# eviction on wall time instead of RPC counts. The target's preemption
+# plane (``runtime/preemption.py``) must rescue-checkpoint and hand off
+# INSIDE the window; the SIGKILL is unconditional — the platform never
+# waits for a well-behaved guest.
+
+
+def deliver_preemption(pid: int, deadline_s: Optional[float] = None,
+                       reason: str = "faultinject") -> threading.Thread:
+    """SIGTERM ``pid`` now; SIGKILL it ``deadline_s`` seconds later if it
+    is still alive (a process that departed gracefully — exit 0 inside
+    the window — is never touched). Returns the (daemon) killer thread
+    so harnesses can join it."""
+    if deadline_s is None:
+        deadline_s = const.ENV.ADT_PREEMPT_DEADLINE_S.val
+    deadline_s = float(deadline_s)
+    logging.warning("faultinject: PREEMPT pid %d — SIGTERM now, SIGKILL "
+                    "in %.1fs (%s)", pid, deadline_s, reason)
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except ProcessLookupError:
+        logging.warning("faultinject: preempt target pid %d already gone",
+                        pid)
+
+    def kill_at_deadline():
+        time.sleep(deadline_s)
+        try:
+            os.kill(pid, signal.SIGKILL)
+            logging.warning("faultinject: preempt deadline hit — SIGKILLed "
+                            "pid %d", pid)
+        except ProcessLookupError:
+            pass  # departed inside the window: the graceful path won
+
+    t = threading.Thread(target=kill_at_deadline,
+                         name="adt-preempt-killer", daemon=True)
+    t.start()
+    return t
 
 
 # ===================================================== checkpoint lifecycle

@@ -1,0 +1,926 @@
+"""Preemption plane: advance-notice graceful departure.
+
+The elastic plane (``runtime/elastic.py``) recovers from *unplanned*
+death: a worker vanishes, the watchdog notices a heartbeat hole, the
+survivors shrink. But most departures in a real fleet are ANNOUNCED —
+TPU maintenance events, spot/preemptible VM evictions, an operator
+draining a host for a kernel upgrade — and treating them as crashes
+throws away the one asset a crash never has: the leaver is still alive,
+its state is still on the wire, and there is a deadline-sized window to
+use both. This module is the planned-departure half:
+
+1. **Notice sources**, all normalized into one
+   :class:`PreemptionNotice` published as a KV mark on the coordination
+   service (``preempt/notice/<worker>``):
+
+   - **SIGTERM with a deadline** — the universal cloud eviction signal.
+     :func:`install_sigterm_notice` arms a handler that records the
+     notice locally (signal-safe: one flag write), publishes the mark
+     from a helper thread, and chains the PR 10 blackbox dump hook
+     deterministically (both fire; the dump runs LAST, so it captures
+     the notice in its event tail).
+   - **Cloud maintenance-event poll** — ``ADT_MAINTENANCE_FILE`` names a
+     path whose existence signals a pending eviction for this host (the
+     cloud integration materializes the metadata-server event into it;
+     its JSON body may carry ``{"deadline_s": ..., "reason": ...}``).
+   - **Operator drain** — ``python -m autodist_tpu.runtime.preemption
+     drain <worker> [--deadline S]`` publishes the same mark over the
+     coordination service.
+
+2. **Cluster-agreed rescue point** — every Runner polls the notice
+   marks at readback boundaries (piggybacked on the elastic epoch poll,
+   throttled to ``ADT_PREEMPT_POLL_S``; one ``preempt/seq`` read in the
+   steady state). On a fresh notice the chief publishes a rescue *plan*
+   (``preempt/plan/<worker>``) naming the step every process saves at;
+   at that boundary each process joins the **deadline-budgeted rescue
+   checkpoint**: if the remaining grace is below the measured
+   ``ckpt.save_ms`` p99 (× a safety factor) the save is SKIPPED
+   (``preempt.rescue_skips``) — a checkpoint that cannot commit before
+   the SIGKILL would burn the whole window and leave torn debris —
+   and the worker goes straight to the handoff.
+
+3. **Planned handoff** — the departing worker stays ALIVE through the
+   shrink: the chief's watchdog sees the notice (never a heartbeat
+   hole), publishes the survivor roster at epoch+1 *before* the worker
+   dies, and the leaver runs every collective up to its final readback
+   boundary — so the survivors' live replicas are step-exact and the
+   shrink re-shards from memory, never from the last-good checkpoint
+   (``ckpt.fallback`` stays untouched). Serving tiers stop admitting,
+   drain the in-flight micro-batches, and shed queued work with a typed
+   ``Retry-After`` (``ADT_DRAIN_RETRY_AFTER_S``). The leaver then exits
+   via :class:`PlannedDeparture` (a ``SystemExit`` with code 0: the
+   chief's process watcher reads it as shutdown, not failure).
+
+Protocol keys (all on the native coordination service):
+
+=====================================  ====================================
+``preempt/seq``                         bumped on every publish (poll key)
+``preempt/notice/<worker>``             the JSON notice (the mark)
+``preempt/plan/<worker>``               chief's rescue plan for it
+``preempt/left/<worker>``               leaver's "handoff complete" stamp
+=====================================  ====================================
+
+Knobs — validated LOUDLY (the PR 12 ``ElasticConfigError`` pattern):
+``ADT_PREEMPT_DEADLINE_S`` (default grace when the source attached
+none), ``ADT_PREEMPT_POLL_S`` (notice poll period; 0 disables the KV
+poll — local SIGTERM/maintenance notices still work), and
+``ADT_DRAIN_RETRY_AFTER_S`` (the serving tier's typed Retry-After).
+"""
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+from autodist_tpu import const
+from autodist_tpu.runtime.elastic import ElasticConfigError
+from autodist_tpu.telemetry import spans as tel
+from autodist_tpu.utils import logging
+
+SEQ_KEY = "preempt/seq"
+NOTICE_PREFIX = "preempt/notice/"
+PLAN_PREFIX = "preempt/plan/"
+LEFT_PREFIX = "preempt/left/"
+
+# skip the rescue save unless the remaining grace covers the measured
+# save p99 with this much headroom (commit is all-or-nothing: a save the
+# SIGKILL tears wastes the whole window AND leaves debris to GC)
+RESCUE_SAFETY_FACTOR = 1.5
+
+# a notice is GC-stale this long past its deadline (the SIGKILL never
+# came — a cancelled maintenance event; the mark must not poison the
+# worker's next incarnation)
+NOTICE_STALE_AFTER_S = 600.0
+
+
+def _bump_seq(client):
+    """Advance the one-key poll cursor (a KV value, not an INC counter —
+    the service's counters live in a different namespace than GET):
+    pollers re-scan the per-worker marks only when this changes."""
+    client.put(SEQ_KEY, repr(time.time()))
+
+
+class PlannedDeparture(SystemExit):
+    """The graceful exit of a preempted worker: handoff complete, state
+    flushed, serving drained. A ``SystemExit`` with code 0 by design —
+    the chief's process watcher treats a zero exit as shutdown, never
+    failure, so a planned leaver's death aborts nothing."""
+
+    def __init__(self, worker: str, reason: str):
+        self.worker = worker
+        self.reason = reason
+        super().__init__(0)
+
+    def __str__(self):
+        return ("planned departure of %s (%s): handoff complete"
+                % (self.worker, self.reason))
+
+
+# ------------------------------------------------------------ knob validation
+
+
+def validate_preempt_knobs() -> tuple:
+    """Parse the preemption knobs LOUDLY; returns ``(deadline_s, poll_s,
+    retry_after_s)``. Same contract as
+    :func:`~autodist_tpu.runtime.elastic.validate_elastic_knobs`: a
+    typo'd knob raises a typed error NAMING it at bring-up — a grace
+    window that silently parsed to garbage would surface as a torn
+    rescue checkpoint months later."""
+    out = []
+    for env, lo, what in (
+            (const.ENV.ADT_PREEMPT_DEADLINE_S, 1e-9,
+             "must be a positive grace window in seconds"),
+            (const.ENV.ADT_PREEMPT_POLL_S, 0.0,
+             "must be a poll period in seconds (0 disables the KV poll)"),
+            (const.ENV.ADT_DRAIN_RETRY_AFTER_S, 0.0,
+             "must be a Retry-After in seconds (>= 0)")):
+        raw = os.environ.get(env.name_str)
+        if raw is None:
+            out.append(env.value[2])  # the member's typed default
+            continue
+        try:
+            val = float(raw)
+        except ValueError:
+            raise ElasticConfigError(env.name_str, raw, what) from None
+        if val < lo:
+            raise ElasticConfigError(env.name_str, raw, what)
+        out.append(val)
+    return tuple(out)
+
+
+# --------------------------------------------------------------- the notice
+
+
+@dataclasses.dataclass
+class PreemptionNotice:
+    """One normalized advance notice: ``worker`` is leaving, with
+    ``deadline`` (absolute wall clock — the moment the platform may
+    SIGKILL) and a human ``reason`` (``sigterm`` / ``maintenance`` /
+    ``drain`` / ...)."""
+
+    worker: str
+    deadline: float
+    reason: str = "unknown"
+    announced: float = 0.0
+
+    def remaining_s(self) -> float:
+        return self.deadline - time.time()
+
+    def fresh(self) -> bool:
+        """A notice stays actionable until its deadline, and stays
+        *visible* (for watchdog grace) a while past it; beyond that it
+        is GC-stale — the eviction was cancelled or already happened."""
+        return time.time() < self.deadline + NOTICE_STALE_AFTER_S
+
+    def to_json(self) -> str:
+        return json.dumps({"worker": self.worker,
+                           "deadline": round(self.deadline, 6),
+                           "reason": self.reason,
+                           "announced": round(self.announced, 6)})
+
+    @classmethod
+    def from_json(cls, raw: str) -> Optional["PreemptionNotice"]:
+        try:
+            d = json.loads(raw)
+            return cls(worker=str(d["worker"]),
+                       deadline=float(d["deadline"]),
+                       reason=str(d.get("reason", "unknown")),
+                       announced=float(d.get("announced", 0.0)))
+        except (ValueError, KeyError, TypeError):
+            return None
+
+
+def publish_notice(client, worker: str, deadline_s: Optional[float] = None,
+                   reason: str = "drain") -> PreemptionNotice:
+    """Publish an advance notice for ``worker`` (epoch-fenced when a
+    membership plane is installed in this process: a zombie must not
+    announce departures for the epoch that evicted it)."""
+    from autodist_tpu.runtime import elastic
+    elastic.maybe_fence("preempt.notice")
+    if deadline_s is None:
+        deadline_s = validate_preempt_knobs()[0]
+    now = time.time()
+    notice = PreemptionNotice(worker=worker, deadline=now + float(deadline_s),
+                              reason=reason, announced=now)
+    client.put(NOTICE_PREFIX + worker, notice.to_json())
+    _bump_seq(client)
+    tel.counter_add("preempt.notices")
+    tel.instant("preempt.notice", "preempt", worker=worker, reason=reason,
+                deadline_s=round(float(deadline_s), 3))
+    from autodist_tpu.telemetry import blackbox
+    blackbox.record("preempt.notice", worker=worker, reason=reason,
+                    deadline_s=round(float(deadline_s), 3))
+    logging.warning("preemption: %s announced leaving in %.1fs (%s)",
+                    worker, deadline_s, reason)
+    return notice
+
+
+def read_notice(client, worker: str) -> Optional[PreemptionNotice]:
+    raw = client.get(NOTICE_PREFIX + worker)
+    if not raw or raw == "0":
+        return None
+    notice = PreemptionNotice.from_json(raw)
+    if notice is None or not notice.fresh():
+        return None
+    return notice
+
+
+def clear_notice(client, worker: str):
+    """Tombstone a consumed/stale notice (and its plan + left stamps) so
+    the worker's next incarnation starts clean."""
+    for key in (NOTICE_PREFIX + worker, PLAN_PREFIX + worker,
+                LEFT_PREFIX + worker):
+        try:
+            client.put(key, "0")
+        except (OSError, RuntimeError):
+            pass
+
+
+def read_plan(client, worker: str) -> Optional[dict]:
+    raw = client.get(PLAN_PREFIX + worker)
+    if not raw or raw == "0":
+        return None
+    try:
+        plan = json.loads(raw)
+        int(plan["rescue_step"])
+        return plan
+    except (ValueError, KeyError, TypeError):
+        return None
+
+
+def publish_plan(client, worker: str, rescue_step: int,
+                 notice: PreemptionNotice):
+    """Chief-side: the cluster-agreed rescue point. Every process saves
+    at its first readback boundary at/after ``rescue_step`` — sync jobs
+    are collective-lockstep, so that is the SAME step everywhere (the
+    save's gathers are collectives and must line up)."""
+    client.put(PLAN_PREFIX + worker, json.dumps(
+        {"rescue_step": int(rescue_step),
+         "deadline": round(notice.deadline, 6), "reason": notice.reason}))
+    _bump_seq(client)
+    logging.warning("preemption: rescue plan for %s published — every "
+                    "process checkpoints at step >= %d (%.1fs of grace "
+                    "left)", worker, rescue_step, notice.remaining_s())
+
+
+def mark_left(client, worker: str):
+    client.put(LEFT_PREFIX + worker, repr(time.time()))
+    _bump_seq(client)
+
+
+def has_left(client, worker: str) -> bool:
+    raw = client.get(LEFT_PREFIX + worker)
+    if not raw or raw == "0":
+        return False
+    try:
+        return float(raw) > 0
+    except ValueError:
+        return False
+
+
+# ------------------------------------------------------------ SIGTERM source
+
+# written only by the signal handler, read by guard polls — a plain
+# attribute (atomic in CPython); a lock here could self-deadlock the
+# handler against the very main thread it interrupts
+_signal_notice: Optional[PreemptionNotice] = None
+_sigterm_installed = False
+_armed_guards: List["PreemptionGuard"] = []
+
+
+def grace_active() -> bool:
+    """True when a preemption guard is armed AND the notice handler is
+    actually installed in this process — a SIGTERM is then an advance
+    notice consumed by the training loop, not a kill; the blackbox hook
+    consults this before re-raising the default disposition. The
+    installed-handler half matters: a guard built on a non-main thread
+    has no handler, and suppressing the default kill for it would make
+    the process silently ignore evictions."""
+    return _sigterm_installed and bool(_armed_guards)
+
+
+def signal_notice() -> Optional[PreemptionNotice]:
+    """The notice a SIGTERM delivered to THIS process (None when none)."""
+    return _signal_notice
+
+
+def _publish_signal_notice(notice: PreemptionNotice):
+    """Helper-thread half of the SIGTERM handler: everything that takes
+    a high-collision lock — logging, the telemetry recorder, the KV mark
+    RPC — runs HERE, never in the signal frame (the handler interrupts
+    the main thread mid-bytecode; re-entering the recorder/logging locks
+    the training loop holds on every step would wedge the process inside
+    the handler and burn the whole grace window). The flight-recorder
+    EVENT is the one exception kept in the handler: the chained dump
+    snapshots the box synchronously and must contain the notice."""
+    tel.counter_add("preempt.notices")
+    logging.warning(
+        "preemption: SIGTERM received — treating it as an advance "
+        "notice with %.1fs of grace (rescue checkpoint + graceful "
+        "handoff at the next step boundary)",
+        max(notice.remaining_s(), 0.0))
+    try:
+        from autodist_tpu.runtime.coordination import CoordinationClient
+        host = (const.ENV.ADT_COORDINATOR_ADDR.val.split(":")[0]
+                or "127.0.0.1")
+        c = CoordinationClient(host, const.ENV.ADT_COORDSVC_PORT.val,
+                               timeout=5.0)
+        try:
+            c.put(NOTICE_PREFIX + notice.worker, notice.to_json())
+            _bump_seq(c)
+        finally:
+            c.close()
+    except (OSError, RuntimeError) as e:
+        logging.warning("preemption: could not publish the SIGTERM notice "
+                        "(%s); peers learn of the departure from the "
+                        "watchdog instead", e)
+
+
+def install_sigterm_notice() -> bool:
+    """Install the SIGTERM-as-advance-notice handler (idempotent; main
+    thread only — returns False when it cannot install). Chains whatever
+    handler was there before — the PR 10 blackbox dump hook in
+    particular — so both fire, dump LAST (the dump's event tail then
+    contains the notice; see ``telemetry/blackbox.py`` for the
+    reverse-order half of the contract)."""
+    global _sigterm_installed
+    if _sigterm_installed:
+        return True
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    import signal as _signal
+    try:
+        prev = _signal.getsignal(_signal.SIGTERM)
+
+        def _on_sigterm(signum, frame):
+            # SIGNAL FRAME: flag write, the flight-recorder event (one
+            # short-held deque lock — the chained dump must snapshot
+            # the notice), and the thread spawn. Everything touching a
+            # high-collision lock (logging, the telemetry recorder,
+            # sockets) belongs to _publish_signal_notice's helper thread.
+            global _signal_notice
+            deadline_s = validate_preempt_knobs()[0]
+            now = time.time()
+            worker = const.ENV.ADT_WORKER.val or "chief"
+            notice = PreemptionNotice(
+                worker=worker, deadline=now + deadline_s,
+                reason="sigterm", announced=now)
+            _signal_notice = notice
+            from autodist_tpu.telemetry import blackbox
+            blackbox.record("preempt.notice", worker=worker,
+                            reason="sigterm",
+                            deadline_s=round(deadline_s, 3))
+            threading.Thread(target=_publish_signal_notice, args=(notice,),
+                             name="adt-preempt-publish",
+                             daemon=True).start()
+            # chain the previous handler (the blackbox dump hook) so the
+            # dump runs LAST and captures this notice; a notice-aware
+            # prev (double-install race) is never re-entered
+            if callable(prev) and not getattr(prev, "_adt_notice_handler",
+                                              False):
+                prev(signum, frame)
+            # never re-raise: the grace window owns the process now —
+            # the platform's deadline SIGKILL is the backstop
+
+        _on_sigterm._adt_notice_handler = True
+        _signal.signal(_signal.SIGTERM, _on_sigterm)
+        _sigterm_installed = True
+        return True
+    except (ValueError, OSError):
+        return False  # restricted env / non-main thread
+
+
+# ----------------------------------------------------- maintenance-event poll
+
+
+class MaintenancePoller:
+    """The cloud maintenance-event hook: ``ADT_MAINTENANCE_FILE`` names
+    a path whose EXISTENCE signals a pending eviction of this host (a
+    sidecar watches the metadata server — e.g. GCE's
+    ``instance/maintenance-event`` — and materializes the event there;
+    tests just touch the file). One ``os.path.exists`` per poll; the
+    file's JSON body may carry ``deadline_s``/``reason``."""
+
+    def __init__(self, path: Optional[str] = None):
+        self._path = (const.ENV.ADT_MAINTENANCE_FILE.val
+                      if path is None else path)
+        self._consumed = False
+
+    def check(self) -> Optional[PreemptionNotice]:
+        if not self._path or self._consumed or not os.path.exists(self._path):
+            return None
+        try:
+            with open(self._path) as f:
+                body = json.load(f)
+            if not isinstance(body, dict):
+                body = {}
+        except (OSError, ValueError):
+            body = {}  # bare touch file
+        # reason and deadline parse INDEPENDENTLY: a body carrying only
+        # a reason must not lose it to a missing deadline_s
+        reason = str(body.get("reason", "maintenance"))
+        try:
+            deadline_s = float(body["deadline_s"])
+        except (KeyError, TypeError, ValueError):
+            deadline_s = validate_preempt_knobs()[0]
+        self._consumed = True  # one notice per event file
+        now = time.time()
+        worker = const.ENV.ADT_WORKER.val or "chief"
+        logging.warning("preemption: maintenance event detected at %s — "
+                        "%.1fs of grace (%s)", self._path, deadline_s,
+                        reason)
+        return PreemptionNotice(worker=worker, deadline=now + deadline_s,
+                                reason=reason, announced=now)
+
+
+# ------------------------------------------------------------- runner guard
+
+
+class PreemptionGuard:
+    """One Runner's half of the preemption protocol: poll the notice
+    sources at readback boundaries, drive the cluster-agreed rescue
+    checkpoint under the deadline budget, and execute the planned
+    handoff (serving drain + graceful :class:`PlannedDeparture`) when
+    the leaver is this process. Created by every Runner; costs one flag
+    check per boundary while no notice is live."""
+
+    def __init__(self, runner, client_factory: Optional[Callable] = None):
+        (self.deadline_s, self.poll_s,
+         self.retry_after_s) = validate_preempt_knobs()
+        self._runner = runner
+        # the membership's identity when armed (the ROSTER address —
+        # what epochs and operator drains name); the heartbeat identity
+        # otherwise. Both are accepted as "this worker" — the chief's
+        # roster address and its heartbeat name ("chief") differ.
+        m = getattr(runner, "_membership", None)
+        hb_name = const.ENV.ADT_WORKER.val or "chief"
+        self.worker = m.worker if m is not None else hb_name
+        self.aliases = frozenset({self.worker, hb_name})
+        self._client_factory = client_factory
+        self._maintenance = MaintenancePoller()
+        self._poll_at = 0.0
+        self._seen_seq = ""
+        self._notice: Optional[PreemptionNotice] = None  # being acted on
+        self._plan: Optional[dict] = None
+        self._rescued = False      # rescue point passed (saved or skipped)
+        self._published = False    # self-notice pushed to the service
+        self._saver = None
+        self.last_handoff_s: Optional[float] = None
+        install_sigterm_notice()
+        _armed_guards.append(self)
+
+    def close(self):
+        try:
+            _armed_guards.remove(self)
+        except ValueError:
+            pass
+
+    # -------------------------------------------------------------- plumbing
+
+    def attach_saver(self, saver):
+        """The saver the rescue checkpoint goes through (``fit`` wires
+        its periodic saver; default: a fresh one on ``ADT_CKPT_DIR``)."""
+        self._saver = saver
+
+    def _rescue_saver(self):
+        if self._saver is None:
+            from autodist_tpu.checkpoint.saver import Saver
+            self._saver = Saver(directory=const.ENV.ADT_CKPT_DIR.val)
+        return self._saver
+
+    def _client(self):
+        """A coordination client to poll/publish with — whatever the
+        runner already opened, else the membership's dedicated client
+        factory; None in serviceless (single-process) runs."""
+        r = self._runner
+        for attr in ("_async_hb", "_coord"):
+            c = getattr(r, attr, None)
+            if c not in (None, False):
+                return c
+        return None
+
+    def _with_any_client(self, fn):
+        """Run ``fn(client)`` against the runner's client, the wired
+        factory, or the membership's; returns None when no service is
+        reachable (local-only mode)."""
+        c = self._client()
+        if c is not None:
+            try:
+                return fn(c)
+            except (OSError, RuntimeError):
+                return None
+        m = getattr(self._runner, "_membership", None)
+        factory = self._client_factory
+        if factory is None and m is not None:
+            try:
+                return m._with_client(fn)
+            except (OSError, RuntimeError):
+                return None
+        if factory is None:
+            return None
+        try:
+            c = factory()
+        except (OSError, RuntimeError):
+            return None
+        try:
+            return fn(c)
+        except (OSError, RuntimeError):
+            return None
+        finally:
+            try:
+                c.close()
+            except (OSError, RuntimeError):
+                pass
+
+    # ----------------------------------------------------------------- poll
+
+    def poll(self):
+        """Readback-boundary notice intake (cheap: local flag + file
+        checks always; the KV read is throttled to ``ADT_PREEMPT_POLL_S``
+        and is ONE ``preempt/seq`` get until something is published)."""
+        if self._notice is None:
+            sig = signal_notice()
+            if sig is not None:
+                self._adopt_notice(sig, local=True)
+        if self._notice is None:
+            maint = self._maintenance.check()
+            if maint is not None:
+                tel.counter_add("preempt.notices")
+                from autodist_tpu.telemetry import blackbox
+                blackbox.record("preempt.notice", worker=maint.worker,
+                                reason=maint.reason)
+                self._adopt_notice(maint, local=True)
+        if self.poll_s <= 0:
+            return
+        now = time.monotonic()
+        if now < self._poll_at:
+            return
+        self._poll_at = now + self.poll_s
+
+        def read(c):
+            seq = c.get(SEQ_KEY) or ""
+            if seq == self._seen_seq:
+                return None
+            members = list(self.aliases)
+            m = getattr(self._runner, "_membership", None)
+            if m is not None:
+                members = list(dict.fromkeys(
+                    list(self.aliases) + list(m.roster)))
+            found = None
+            for w in members:
+                n = read_notice(c, w)
+                if n is not None and not has_left(c, w):
+                    found = n
+                    break
+            # consume the cursor only after a COMPLETE scan: a transient
+            # error mid-scan raises out of here (swallowed by the caller)
+            # with the cursor untouched, so the next poll re-scans — a
+            # publish must never be permanently missed
+            self._seen_seq = seq
+            return found
+        found = self._with_any_client(read)
+        if found is not None and self._notice is None:
+            self._adopt_notice(found, local=False)
+
+    def _adopt_notice(self, notice: PreemptionNotice, local: bool):
+        self._notice = notice
+        self._plan = None
+        self._rescued = False
+        self._published = not local or notice.reason == "sigterm"
+        if notice.worker in self.aliases:
+            # keep the epoch fence open for this announced leaver until
+            # its deadline: the planned-shrink epoch may land BEFORE our
+            # final boundary, and the rescue checkpoint / flush / left
+            # stamp must not read as zombie writes mid-collective
+            m = getattr(self._runner, "_membership", None)
+            if m is not None:
+                m.expect_departure(notice.deadline)
+        logging.warning(
+            "preemption: notice live for %s (%s, %.1fs of grace) — "
+            "rescue checkpoint at the agreed boundary, then %s",
+            notice.worker, notice.reason, max(notice.remaining_s(), 0.0),
+            "graceful handoff" if notice.worker in self.aliases
+            else "planned shrink")
+
+    # ------------------------------------------------------------------ act
+
+    @property
+    def pending(self) -> bool:
+        return self._notice is not None
+
+    def maybe_act(self):
+        """Drive the protocol at a SAFE point (no dispatch in flight):
+        publish/adopt the rescue plan, take the deadline-budgeted rescue
+        checkpoint at the agreed step, and — when the leaver is this
+        process — pre-stage the handoff (the actual departure happens in
+        ``Runner._maybe_reconfigure`` when the shrink epoch lands, or
+        directly here when no membership plane is armed)."""
+        notice = self._notice
+        if notice is None:
+            return
+        if not notice.fresh():
+            logging.warning("preemption: notice for %s went stale "
+                            "(cancelled eviction?) — disarming",
+                            notice.worker)
+            self._notice = None
+            return
+        runner = self._runner
+        m = getattr(runner, "_membership", None)
+        if (notice.worker not in self.aliases and m is not None
+                and notice.worker not in m.roster):
+            # the announced leaver is out of our (reconfigured) roster:
+            # the planned shrink completed — nothing left to stage for
+            self._notice = None
+            return
+        if not self._published and notice.worker in self.aliases:
+            # maintenance-file notices reach peers through the mark too
+            self._published = True
+            self._with_any_client(
+                lambda c: c.put(NOTICE_PREFIX + self.worker,
+                                notice.to_json()) or _bump_seq(c))
+        if self._plan is None:
+            self._plan = self._agree_plan(notice)
+            if self._plan is None:
+                return  # non-chief waiting for the chief's plan
+        step = runner._step_count
+        if not self._rescued and step >= int(self._plan["rescue_step"]):
+            self._rescue(notice)
+        if self._rescued and notice.worker in self.aliases:
+            m = getattr(runner, "_membership", None)
+            solo = m is None or len(m.roster) <= 1
+            if solo:
+                # no survivors to hand off to: rescue checkpoint is the
+                # legacy, drain serving and leave (ADT_AUTO_RESUME picks
+                # the job back up elsewhere)
+                self.depart(epoch=None, roster=())
+            if notice.remaining_s() <= 0:
+                # the shrink epoch never arrived inside the grace (no
+                # in-run plane, a fail-fast topology, or a chief that
+                # declined) — the deadline says this process is going
+                # away regardless, and an operator's SIGTERM must not
+                # leave an unkillable worker: depart WITHOUT the live
+                # handoff; the rescue checkpoint already committed and
+                # the unplanned machinery recovers the peers
+                logging.warning(
+                    "preemption: grace expired with no shrink epoch — "
+                    "departing without a live handoff (%s)", notice.reason)
+                self.depart(epoch=None, roster=())
+            # else: the chief's watchdog publishes the survivor epoch;
+            # Runner._maybe_reconfigure routes the excluded leaver here
+            # via depart() when it lands. Pre-stage the snapshot so the
+            # survivors' reconfigure span carries less work (the planned
+            # path's downtime edge over the unplanned shrink).
+        elif self._rescued and notice.worker not in self.aliases:
+            # pre-stage ONLY at the boundary the reconfigure will run at
+            # (the epoch poll already parked it; _maybe_reconfigure is
+            # the very next hook) — a per-boundary prestage across the
+            # whole notice window would pay a full flush + host
+            # snapshot per step just to discard it
+            if getattr(runner, "_reconfig_pending", None) is not None:
+                runner._prestage_snapshot()
+
+    def _agree_plan(self, notice: PreemptionNotice) -> Optional[dict]:
+        """The cluster-agreed rescue step. The chief publishes ``its
+        current boundary step`` (sync jobs are collective-lockstep, so
+        every process reaches that same boundary); workers adopt the
+        published plan. Serviceless runs plan locally."""
+        runner = self._runner
+        my_step = runner._step_count
+        if const.is_chief() or notice.worker in self.aliases:
+            plan = {"rescue_step": int(my_step),
+                    "deadline": notice.deadline, "reason": notice.reason}
+            self._with_any_client(
+                lambda c: publish_plan(c, notice.worker, my_step, notice))
+            return plan
+        return self._with_any_client(
+            lambda c: read_plan(c, notice.worker))
+
+    def _rescue(self, notice: PreemptionNotice):
+        """The deadline-budgeted rescue checkpoint: save synchronously
+        (a rescue that does not COMMIT before the SIGKILL is worthless)
+        unless the measured save p99 no longer fits the remaining
+        grace."""
+        self._rescued = True
+        remaining = notice.remaining_s()
+        p99_ms = tel.hist_quantile("ckpt.save_ms", 0.99)
+        # an already-expired grace skips UNCONDITIONALLY (no p99 needed:
+        # any synchronous save now is torn by the SIGKILL) — otherwise
+        # skip when the measured p99 no longer fits with headroom
+        if remaining <= 0 or (
+                p99_ms is not None
+                and remaining * 1e3 < p99_ms * RESCUE_SAFETY_FACTOR):
+            tel.counter_add("preempt.rescue_skips")
+            tel.instant("preempt.rescue_skip", "preempt",
+                        remaining_s=round(remaining, 3),
+                        save_p99_ms=round(p99_ms or 0.0, 1))
+            logging.warning(
+                "preemption: SKIPPING the rescue checkpoint — %.2fs of "
+                "grace left vs saves measuring %sms at p99 (x%.1f "
+                "safety); going straight to the handoff", remaining,
+                ("%.0f" % p99_ms) if p99_ms is not None else "unmeasured",
+                RESCUE_SAFETY_FACTOR)
+            return
+        t0 = time.monotonic()
+        with tel.span("preempt.rescue_save", "preempt",
+                      step=self._runner._step_count,
+                      remaining_s=round(remaining, 3)):
+            saver = self._rescue_saver()
+            saver.save(self._runner)
+            saver.wait()  # the commit must land inside the grace window
+        save_ms = (time.monotonic() - t0) * 1e3
+        tel.counter_add("preempt.rescue_saves")
+        tel.hist_observe("preempt.rescue_save_ms", save_ms)
+        from autodist_tpu.telemetry import blackbox
+        blackbox.record("preempt.rescue_save", worker=notice.worker,
+                        step=self._runner._step_count,
+                        save_ms=round(save_ms, 1))
+        logging.warning("preemption: rescue checkpoint committed at step "
+                        "%d in %.0fms (%.2fs of grace left)",
+                        self._runner._step_count, save_ms,
+                        notice.remaining_s())
+
+    # -------------------------------------------------------------- handoff
+
+    def departing(self) -> bool:
+        """True when THIS worker holds a live notice (the Runner's
+        reconfigure path asks before treating an epoch that excludes us
+        as a zombie fence-out)."""
+        n = self._notice
+        return n is not None and n.worker in self.aliases and n.fresh()
+
+    def check_departure_now(self) -> bool:
+        """UNTHROTTLED departure check for the reconfigure path: the
+        chief publishes the shrink epoch right after a notice, and the
+        epoch poll (``ADT_ELASTIC_POLL_S``) can observe the exclusion
+        before the throttled notice poll (``ADT_PREEMPT_POLL_S``) ever
+        adopted the mark — concluding "zombie" there would crash an
+        announced leaver with ``FencedOut`` mid-handoff. Consult the KV
+        marks directly before the zombie verdict. A departure adopted
+        HERE skips the rescue checkpoint by design: its peers are
+        already heading into the reconfigure barrier, not into a
+        collective save — and the shrink was only published because the
+        survivors' live replicas cover the state."""
+        if self.departing():
+            return True
+
+        def read(c):
+            for w in self.aliases:
+                n = read_notice(c, w)
+                if n is not None:
+                    return n
+            return None
+        found = self._with_any_client(read)
+        if found is not None:
+            self._adopt_notice(found, local=False)
+        return self.departing()
+
+    def depart(self, epoch: Optional[int], roster) -> "PlannedDeparture":
+        """The graceful exit: drain serving (typed Retry-After sheds),
+        flush training state, stamp ``preempt/left`` so peers and the
+        watchdog know the handoff COMPLETED, and raise
+        :class:`PlannedDeparture`. Never returns."""
+        notice = self._notice
+        reason = notice.reason if notice is not None else "drain"
+        t0 = time.perf_counter()
+        with tel.span("preempt.handoff", "preempt",
+                      worker=self.worker, reason=reason,
+                      epoch=epoch if epoch is not None else -1,
+                      step=self._runner._step_count):
+            drained = drain_serving(self.retry_after_s)
+            try:
+                self._runner.distributed_step.flush_ps()
+            except Exception as e:  # noqa: BLE001 — a dead PS pipeline
+                # (or the epoch fence on a post-shrink wire write) must
+                # not block the departure; the rescue ckpt covers it
+                logging.warning("preemption: flush on departure failed "
+                                "(%s)", e)
+            try:
+                # the left stamp may ride a FENCED client — by now the
+                # epoch already excludes us, and that is fine: the stamp
+                # is the departure protocol's own namespace, best-effort
+                self._with_any_client(lambda c: mark_left(c, self.worker))
+            except Exception as e:  # noqa: BLE001 — incl. FencedOut
+                logging.warning("preemption: left stamp not published "
+                                "(%s); the watchdog ages the notice out "
+                                "instead", e)
+        self.last_handoff_s = time.perf_counter() - t0
+        tel.counter_add("preempt.handoffs")
+        from autodist_tpu.telemetry import blackbox
+        blackbox.record("preempt.handoff", worker=self.worker,
+                        reason=reason, drained=drained,
+                        downtime_s=round(self.last_handoff_s, 6))
+        logging.warning(
+            "preemption: %s handed off alive (%s; %d serving request(s) "
+            "shed with Retry-After %.1fs) — departing with exit code 0",
+            self.worker, reason, drained, self.retry_after_s)
+        # the runner is NOT closed here: PlannedDeparture unwinds through
+        # fit()'s finally (flush + saver.wait) first, and the runner's
+        # exit hook / the departing script's teardown does the close
+        # (with its clean GOODBYE) once the unwind completes
+        raise PlannedDeparture(self.worker, reason)
+
+    def stats(self) -> dict:
+        c = tel.counters()
+        n = self._notice
+        return {
+            "notice": (None if n is None else
+                       {"worker": n.worker, "reason": n.reason,
+                        "remaining_s": round(n.remaining_s(), 3)}),
+            "notices": c.get("preempt.notices", 0.0),
+            "rescue_saves": c.get("preempt.rescue_saves", 0.0),
+            "rescue_skips": c.get("preempt.rescue_skips", 0.0),
+            "handoffs": c.get("preempt.handoffs", 0.0),
+            "last_handoff_s": (round(self.last_handoff_s, 6)
+                               if self.last_handoff_s is not None else None),
+        }
+
+
+def drain_serving(retry_after_s: Optional[float] = None) -> int:
+    """Drain every live serving micro-batcher in this process: in-flight
+    groups complete, queued requests shed with the typed Retry-After.
+    Returns the number of shed requests."""
+    from autodist_tpu.serving import batcher as batcher_lib
+    shed = 0
+    for mb in batcher_lib.active_batchers():
+        try:
+            shed += mb.drain(retry_after_s=retry_after_s)
+        except Exception as e:  # noqa: BLE001 — one wedged batcher must
+            # not block the departure of the whole process
+            logging.warning("preemption: serving drain failed (%s)", e)
+    return shed
+
+
+def reset():
+    """Test isolation: forget the signal notice and armed guards (the
+    installed SIGTERM handler stays — handlers are process state)."""
+    global _signal_notice
+    _signal_notice = None
+    del _armed_guards[:]
+
+
+# --------------------------------------------------------------- drain CLI
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Operator verbs over the coordination service::
+
+        python -m autodist_tpu.runtime.preemption drain <worker> \\
+            [--deadline S] [--reason R] [--host H] [--port P]
+        python -m autodist_tpu.runtime.preemption status <worker> [...]
+
+    ``drain`` publishes an advance notice: the worker takes its rescue
+    checkpoint, hands off into a planned shrink, and exits cleanly —
+    the operator then has the host. ``status`` prints the live
+    notice/plan/left marks for a worker."""
+    import argparse
+    p = argparse.ArgumentParser(prog="python -m "
+                                "autodist_tpu.runtime.preemption")
+    sub = p.add_subparsers(dest="verb", required=True)
+    for verb in ("drain", "status"):
+        sp = sub.add_parser(verb)
+        sp.add_argument("worker")
+        sp.add_argument("--host", default=None)
+        sp.add_argument("--port", type=int, default=None)
+        if verb == "drain":
+            sp.add_argument("--deadline", type=float, default=None,
+                            help="grace seconds before the platform may "
+                                 "SIGKILL (default ADT_PREEMPT_DEADLINE_S)")
+            sp.add_argument("--reason", default="drain")
+    args = p.parse_args(argv)
+    host = args.host or (const.ENV.ADT_COORDINATOR_ADDR.val.split(":")[0]
+                         or "127.0.0.1")
+    port = args.port or const.ENV.ADT_COORDSVC_PORT.val
+    from autodist_tpu.runtime.coordination import CoordinationClient
+    try:
+        client = CoordinationClient(host, port, timeout=10.0)
+    except OSError as e:
+        print("coordination service unreachable at %s:%d: %s"
+              % (host, port, e))
+        return 1
+    try:
+        if args.verb == "drain":
+            notice = publish_notice(client, args.worker,
+                                    deadline_s=args.deadline,
+                                    reason=args.reason)
+            print("drain published: %s leaves by %s (%s)"
+                  % (args.worker,
+                     time.strftime("%H:%M:%S",
+                                   time.localtime(notice.deadline)),
+                     notice.reason))
+            return 0
+        notice = read_notice(client, args.worker)
+        plan = read_plan(client, args.worker)
+        left = has_left(client, args.worker)
+        print(json.dumps({
+            "worker": args.worker,
+            "notice": (None if notice is None else
+                       json.loads(notice.to_json())),
+            "plan": plan, "left": left}, indent=2, sort_keys=True))
+        return 0
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

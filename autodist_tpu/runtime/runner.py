@@ -200,6 +200,15 @@ class Runner:
         self._epoch_poll_at = 0.0
         self._last_reconfigure_s = None
         self._reconfigs = 0
+        # ---- preemption plane (runtime/preemption.py): advance-notice
+        # graceful departure — SIGTERM-with-deadline, maintenance events
+        # and operator drains all park a notice the readback boundaries
+        # consume (cluster-agreed rescue checkpoint, then planned handoff)
+        from autodist_tpu.runtime import preemption as preemption_lib
+        self._preempt = preemption_lib.PreemptionGuard(self)
+        # (step, snapshot) pre-staged while a planned departure is
+        # pending, so the reconfigure span skips the snapshot work
+        self._prestaged = None
         # ---- cluster observability plane (telemetry/): arm the flight
         # recorder (always-on bounded black box; also installs the
         # SIGTERM/exit dump hooks per ADT_BLACKBOX*), the online
@@ -557,6 +566,29 @@ class Runner:
                 "reconfiguring to %d member(s) at the next boundary",
                 info[0], m.epoch, len(info[1]))
 
+    def _maybe_preempt_act(self):
+        """Drive a pending preemption notice at a SAFE point (next to the
+        sentinel/reconfigure hooks): cluster-agreed rescue checkpoint,
+        snapshot pre-staging, and — for a departing worker with no
+        membership plane — the graceful exit itself."""
+        if self._preempt.pending:
+            self._preempt.maybe_act()
+
+    def _prestage_snapshot(self):
+        """Pre-stage the in-memory state snapshot for an ANNOUNCED
+        membership change (one per boundary step): the leaver is known in
+        advance, so the survivors take the flush + snapshot cost here —
+        outside the reconfigure span — and the planned handoff's
+        recorded downtime carries strictly less work than an unplanned
+        shrink's."""
+        if (self._prestaged is not None
+                and self._prestaged[0] == self._step_count):
+            return
+        from autodist_tpu.runtime import elastic as elastic_lib
+        self._dstep.flush_ps()
+        self._prestaged = (self._step_count,
+                           elastic_lib.snapshot_runner_state(self))
+
     def _maybe_reconfigure(self):
         """Execute a pending membership change at a SAFE point (no
         dispatch in flight, metrics all materialized): barrier with the
@@ -570,6 +602,14 @@ class Runner:
         m = self._membership
         from autodist_tpu.runtime import elastic as elastic_lib
         if m.worker not in roster:
+            # UNTHROTTLED notice check: the shrink epoch can outrun the
+            # throttled notice poll, and an announced leaver must never
+            # take the zombie path
+            if self._preempt.check_departure_now():
+                # the epoch that excludes us is OUR announced departure:
+                # hand off alive (serving drain, state flush, left stamp)
+                # and exit gracefully — never the zombie fence-out
+                self._preempt.depart(epoch, roster)
             # we were declared dead and survived anyway: a zombie. Every
             # write path is already fenced; this is the loud exit.
             raise elastic_lib.FencedOut("reconfigure", m.epoch, epoch,
@@ -580,12 +620,22 @@ class Runner:
                 "wired on this Runner (AutoDist.build arms it for in-run "
                 "elastic jobs)" % epoch)
         t0 = time.perf_counter()
+        planned = (self._prestaged is not None
+                   and self._prestaged[0] == self._step_count)
         with tel.span("elastic.reconfigure", "elastic", epoch=epoch,
                       world=len(roster), from_world=len(m.roster),
-                      step=self._step_count):
-            # land the fused PS carry / in-flight pushes before snapshot
-            self._dstep.flush_ps()
-            snapshot = elastic_lib.snapshot_runner_state(self)
+                      step=self._step_count, planned=planned):
+            if planned:
+                # announced departure: the snapshot was pre-staged at
+                # this boundary (outside the span) — the planned path's
+                # downtime edge over an unplanned shrink
+                snapshot = self._prestaged[1]
+            else:
+                # land the fused PS carry / in-flight pushes, then
+                # snapshot
+                self._dstep.flush_ps()
+                snapshot = elastic_lib.snapshot_runner_state(self)
+            self._prestaged = None
             # superstep-aligned rendezvous of the NEW process set: nobody
             # tears down jax.distributed while a peer is still dispatching
             m.barrier_reconf(epoch, len(roster))
@@ -677,6 +727,7 @@ class Runner:
         self._maybe_fleet_profile_stop()
         self._poll_profile_window()
         self._poll_epoch()
+        self._preempt.poll()
         self._maybe_heartbeat()
         if self._coord is not None:
             # bounded staleness across processes (the reference's size-s
@@ -771,6 +822,7 @@ class Runner:
         readback re-syncs the clock)."""
         t_begin = time.perf_counter()
         self._maybe_sentinel_act()  # a pending rollback replaces self.state
+        self._maybe_preempt_act()   # a pending notice rescues/hands off
         self._maybe_reconfigure()   # a pending epoch re-forms the mesh
         st = state if state is not None else self.state
         if st is None:
@@ -819,6 +871,7 @@ class Runner:
         k microsteps."""
         t_begin = time.perf_counter()
         self._maybe_sentinel_act()  # a pending rollback replaces self.state
+        self._maybe_preempt_act()   # a pending notice rescues/hands off
         self._maybe_reconfigure()   # a pending epoch re-forms the mesh
         if self.state is None:
             raise RuntimeError("Runner.run_superstep before init()")
@@ -1066,6 +1119,13 @@ class Runner:
                 else None),
             "fenced_writes": c.get("elastic.fenced_writes", 0.0),
         }
+        # preemption plane (stable shape): notice/rescue/handoff
+        # accounting for monitoring and the bench --smoke downtime leg
+        guard = getattr(self, "_preempt", None)
+        out["preempt"] = (guard.stats() if guard is not None else
+                          {"notice": None, "notices": 0.0,
+                           "rescue_saves": 0.0, "rescue_skips": 0.0,
+                           "handoffs": 0.0, "last_handoff_s": None})
         return out
 
     def goodput_report(self):
@@ -1205,6 +1265,9 @@ class Runner:
         host-PS store's serving threads/sockets. Idempotent."""
         worker = const.ENV.ADT_WORKER.val or "chief"
         self._hb_enabled = False
+        guard = getattr(self, "_preempt", None)
+        if guard is not None:
+            guard.close()
         if getattr(self, "_atexit_cb", None) is not None:
             import atexit
             try:
@@ -1307,6 +1370,9 @@ class Runner:
         if self._sentinel is not None and saver is not None:
             # rollback restores from where fit checkpoints
             self._sentinel.attach_saver(saver)
+        if saver is not None:
+            # the rescue checkpoint commits where fit checkpoints too
+            self._preempt.attach_saver(saver)
         if fuse_steps > 1 or metrics_every > 1:
             return self._fit_pipelined(batches, steps, callbacks, save_every,
                                        saver, max(1, fuse_steps),

@@ -19,10 +19,15 @@ the resilient control plane — applied to inference traffic
 - per-request observability: ``serve.enqueue/batch/dispatch/readback``
   spans, a ``serve.queue_depth`` gauge, and the ``serve.latency_ms``
   histogram feeding p50/p99 (docs/observability.md).
+- drain-aware departure: ``MicroBatcher.drain`` (driven by the
+  preemption plane, ``runtime/preemption.py``) stops admitting, lets
+  in-flight groups complete, and sheds queued work with a typed
+  ``Retry-After`` (``ADT_DRAIN_RETRY_AFTER_S``) so load balancers
+  re-route instead of hammering a leaving replica.
 """
 from autodist_tpu.serving.engine import (InferenceEngine, ServingConfig,
                                          ServingUnavailable)
-from autodist_tpu.serving.batcher import MicroBatcher
+from autodist_tpu.serving.batcher import MicroBatcher, active_batchers
 
 __all__ = ["InferenceEngine", "MicroBatcher", "ServingConfig",
-           "ServingUnavailable"]
+           "ServingUnavailable", "active_batchers"]

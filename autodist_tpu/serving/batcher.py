@@ -21,14 +21,26 @@ requests/batches/shed/degraded/padded_rows`` counters, the
 import queue
 import threading
 import time
+import weakref
 from concurrent.futures import Future
 from typing import Optional
 
+from autodist_tpu import const
 from autodist_tpu.serving.engine import InferenceEngine, ServingUnavailable
 from autodist_tpu.telemetry import spans as tel
 from autodist_tpu.utils import logging
 
 _SENTINEL = object()
+
+# every live batcher, so the preemption plane can drain a departing
+# process's whole serving tier without threading references through it
+_ACTIVE: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def active_batchers() -> list:
+    """The process's live micro-batchers (drained on planned departure
+    by ``runtime/preemption.py``)."""
+    return list(_ACTIVE)
 
 
 class _Pending:
@@ -68,11 +80,15 @@ class MicroBatcher:
         # this module promises never happens
         self._submit_lock = threading.Lock()
         self.stats_local = {"requests": 0, "batches": 0, "shed": 0,
-                            "errors": 0, "fan_out": 0}
+                            "errors": 0, "fan_out": 0, "drained": 0}
+        # set while draining/closed: the Retry-After attached to every
+        # typed shed (None = plain close, no retry hint)
+        self._retry_after: Optional[float] = None
         self._worker = threading.Thread(target=self._run,
                                         name="adt-serve-batcher",
                                         daemon=True)
         self._worker.start()
+        _ACTIVE.add(self)
 
     # ------------------------------------------------------------- submit
 
@@ -84,7 +100,11 @@ class MicroBatcher:
         overloaded tier fails fast instead of buffering unboundedly."""
         with tel.span("serve.enqueue", "serve"), self._submit_lock:
             if self._closed:
-                raise ServingUnavailable("micro-batcher is closed")
+                raise ServingUnavailable(
+                    "micro-batcher is %s" % ("draining"
+                                             if self._retry_after is not None
+                                             else "closed"),
+                    retry_after_s=self._retry_after)
             if self._queue.qsize() >= self.max_queue:
                 self.stats_local["shed"] += 1
                 tel.counter_add("serve.shed")
@@ -208,6 +228,67 @@ class MicroBatcher:
         return out
 
     # ------------------------------------------------------------ shutdown
+
+    def drain(self, retry_after_s: Optional[float] = None,
+              timeout: float = 30.0) -> int:
+        """Planned-departure drain: stop admitting (subsequent submits
+        shed with the typed Retry-After), let the IN-FLIGHT group finish
+        and resolve its futures, and shed everything still queued —
+        typed, with ``retry_after_s`` (default ``ADT_DRAIN_RETRY_AFTER_S``)
+        so callers route to another replica instead of hammering the
+        leaver. Counts ``serve.drained`` (in-flight requests completed
+        during the drain) and ``serve.shed`` (queued requests rejected).
+        Returns the shed count. Idempotent; a drained batcher is
+        closed."""
+        retry = (const.ENV.ADT_DRAIN_RETRY_AFTER_S.val
+                 if retry_after_s is None else float(retry_after_s))
+        with self._submit_lock:
+            if self._closed:
+                return 0
+            self._closed = True
+            self._retry_after = retry
+        # shed the QUEUE first (before the sentinel): whatever the worker
+        # already took is in-flight and completes; whatever still sits in
+        # the queue is work a healthier replica should take
+        shed_exc = ServingUnavailable(
+            "serving replica draining for departure — retry elsewhere "
+            "(Retry-After %.1fs)" % retry, retry_after_s=retry)
+        shed = 0
+        requeue = []
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is _SENTINEL:
+                requeue.append(item)  # a concurrent close posted it
+                continue
+            if not item.future.done():
+                item.future.set_exception(shed_exc)
+                shed += 1
+        for item in requeue:
+            self._queue.put(item)
+        fan0 = self.stats_local["fan_out"]
+        self._queue.put(_SENTINEL)
+        self._worker.join(timeout=timeout)
+        # a submit that raced the closed-flag flip cannot exist (the flip
+        # holds the submit lock), but the worker may have been mid-group:
+        # those futures resolved above the fan-out counter
+        drained = self.stats_local["fan_out"] - fan0
+        self.stats_local["shed"] += shed
+        self.stats_local["drained"] += drained
+        if shed:
+            tel.counter_add("serve.shed", shed)
+        tel.counter_add("serve.drained", drained)
+        tel.instant("serve.drained", "serve", shed=shed, drained=drained,
+                    retry_after_s=retry)
+        logging.warning(
+            "serving: drained micro-batcher — %d in-flight request(s) "
+            "completed, %d queued shed with Retry-After %.1fs",
+            drained, shed, retry)
+        if self._worker.is_alive():
+            self._queue.put(_SENTINEL)  # join timed out mid-group
+        return shed
 
     def close(self, timeout: float = 30.0):
         """Stop accepting, drain the worker, and fail any still-queued

@@ -42,9 +42,18 @@ from autodist_tpu.utils import logging
 
 class ServingUnavailable(RuntimeError):
     """Typed load-shed: the serving tier cannot answer right now —
-    queue overflow, or a PS snapshot staler than the strategy's window
-    with the control plane still unreachable. Callers retry/hedge
-    elsewhere; nothing hangs."""
+    queue overflow, a PS snapshot staler than the strategy's window
+    with the control plane still unreachable, or a drain for a planned
+    departure. Callers retry/hedge elsewhere; nothing hangs.
+
+    ``retry_after_s`` (when set) is the shed's Retry-After: how long the
+    caller should wait — or route elsewhere — before retrying; a
+    draining replica sets it from ``ADT_DRAIN_RETRY_AFTER_S`` so load
+    balancers back off instead of hammering the leaver."""
+
+    def __init__(self, *args, retry_after_s=None):
+        super().__init__(*args)
+        self.retry_after_s = retry_after_s
 
 
 @dataclasses.dataclass

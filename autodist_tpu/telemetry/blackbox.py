@@ -251,15 +251,37 @@ def _install_hooks():
 
             prev = signal.getsignal(signal.SIGTERM)
 
+            def _grace_active() -> bool:
+                # the preemption plane consumed the SIGTERM as an advance
+                # notice: the process lives through its grace window, so
+                # the default-disposition re-raise must not fire
+                try:
+                    from autodist_tpu.runtime import preemption
+                    return preemption.grace_active()
+                except ImportError:
+                    return False
+
             def _on_sigterm(signum, frame):
+                # deterministic chain with the preemption notice handler
+                # REGARDLESS of install order: the notice fires first,
+                # the dump fires LAST (so its event tail contains the
+                # notice). When the previous handler IS the notice
+                # handler, run it before dumping; any other callable
+                # keeps the legacy dump-then-chain order.
+                notice_prev = (callable(prev)
+                               and getattr(prev, "_adt_notice_handler",
+                                           False))
+                if notice_prev:
+                    prev(signum, frame)
                 record("signal", signum=signum)
                 dump("fatal signal SIGTERM")
-                if callable(prev):
+                if callable(prev) and not notice_prev:
                     prev(signum, frame)
-                elif prev == signal.SIG_DFL:
+                elif prev == signal.SIG_DFL and not _grace_active():
                     signal.signal(signal.SIGTERM, signal.SIG_DFL)
                     os.kill(os.getpid(), signal.SIGTERM)
 
+            _on_sigterm._adt_blackbox_handler = True
             signal.signal(signal.SIGTERM, _on_sigterm)
         except (ValueError, OSError):
             pass  # non-main thread / restricted env: dumps still work

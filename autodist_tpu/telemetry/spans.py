@@ -136,7 +136,9 @@ DEFAULT_COUNTERS = (
     "sentinel.lr_halvings",
     "search.candidates", "search.pruned",
     "serve.requests", "serve.batches", "serve.compiles",
-    "serve.padded_rows", "serve.degraded", "serve.shed",
+    "serve.padded_rows", "serve.degraded", "serve.shed", "serve.drained",
+    "preempt.notices", "preempt.rescue_saves", "preempt.rescue_skips",
+    "preempt.handoffs", "preempt.planned_shrinks",
     "telemetry.straggler_flags", "blackbox.dumps", "profiler.windows",
     "cluster.scrapes",
 )

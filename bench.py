@@ -37,6 +37,7 @@ Methodology (unchanged from round 2):
   chip peak — computed from the framework path's own best phase so tunnel
   stalls don't understate it.
 """
+import contextlib
 import functools
 import json
 import os
@@ -588,8 +589,144 @@ def smoke_main(fused: bool = False):
     # dead last with the main legs' telemetry already harvested
     result.update(_smoke_telemetry())
     result["elastic"] = _smoke_elastic(loss_fn, params, batches)
+    result["preempt"] = _smoke_preempt(loss_fn, params, batches)
     adt.reset()
     print(RESULT_TAG + json.dumps(result), flush=True)
+
+
+@contextlib.contextmanager
+def _inrun_elastic_sandbox(extra_env=None):
+    """Shared harness of the elastic/preempt smoke legs: a fresh
+    coordination service on a free port, the in-run elastic knobs
+    exported (restored afterwards), and a clean AutoDist registry on
+    entry AND exit. Yields the service port."""
+    import socket
+
+    import autodist_tpu as adt
+    from autodist_tpu.runtime.coordination import CoordinationServer
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {"ADT_COORDSVC_PORT": str(port), "ADT_ELASTIC": "1",
+           "ADT_ELASTIC_SYNC": "1", "ADT_ELASTIC_INRUN": "1",
+           "ADT_ELASTIC_POLL_S": "0.01"}
+    env.update(extra_env or {})
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    srv = None
+    try:
+        # INSIDE the try: a bind race / failed service start must still
+        # restore the exported elastic knobs, or they silently apply to
+        # everything that runs after this leg in the same process
+        srv = CoordinationServer(port)
+        srv.start()
+        adt.reset()
+        yield port
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        adt.reset()
+        if srv is not None:
+            srv.stop()
+
+
+def _smoke_preempt(loss_fn, params, batches):
+    """Preemption leg of the smoke bench: two symmetric shrink legs of a
+    2-member roster (this process + a phantom peer) down to 1 — one
+    PLANNED (the peer announces its departure: cluster-agreed rescue
+    checkpoint, pre-staged snapshot, ``planned`` reconfigure) and one
+    UNPLANNED (no notice; the snapshot is taken inside the reconfigure
+    span) — so every BENCH round records rescue-save latency and
+    planned-handoff downtime NEXT TO the unplanned-shrink downtime, plus
+    the detection floor (``ADT_HEARTBEAT_TIMEOUT_S``) only the
+    un-announced death pays end to end. The planned leg runs FIRST (any
+    process-level cache warming then favors the baseline). Asserted on
+    the planned leg: exactly one rescue save, zero ``ckpt.fallback``
+    restores."""
+    import tempfile
+
+    import optax
+    import autodist_tpu as adt
+    from autodist_tpu import const, strategy
+    from autodist_tpu.runtime import elastic, preemption
+    from autodist_tpu.runtime.coordination import CoordinationClient
+    from autodist_tpu.telemetry import spans as tel
+
+    def shrink_leg(planned):
+        """Fresh service + runner: pre-published [me, phantom] roster,
+        then a shrink to [me] — announced (notice first) or not.
+        Returns (downtime_s, step_stats)."""
+        ckpt_dir = tempfile.mkdtemp(prefix="adt-preempt-smoke-")
+        with _inrun_elastic_sandbox({"ADT_PREEMPT_POLL_S": "0.01",
+                                     "ADT_CKPT_DIR": ckpt_dir}) as port:
+            client = CoordinationClient("127.0.0.1", port)
+            me = "127.0.0.1"
+            elastic.publish_epoch(client, 1, [me, "peer-evicted"])
+            ad = adt.AutoDist(strategy_builder=strategy.AllReduce())
+            runner = ad.build(loss_fn, optax.adam(1e-2), params,
+                              batches[0])
+            runner.init(params)
+            n = len(batches)
+            for i, b in enumerate(batches):
+                runner.run(b)
+                if planned and i == 2:
+                    # the peer's eviction is announced: rescue
+                    # checkpoint at the agreed boundary + pre-stage
+                    preemption.publish_notice(client, "peer-evicted",
+                                              deadline_s=60,
+                                              reason="maintenance")
+                    time.sleep(0.05)
+                elif i == n // 2:
+                    # the shrink epoch (for the planned leg: published
+                    # while the announced leaver is still "alive")
+                    elastic.publish_epoch(client, 2, [me])
+                    time.sleep(0.05)
+            client.close()
+            stats = runner.step_stats()
+            assert stats["elastic"]["reconfigs"] == 1, stats["elastic"]
+            # counters/histograms must be read INSIDE the sandbox: its
+            # teardown resets the telemetry recorder
+            leg_telemetry = (tel.counters().get("ckpt.fallback", 0.0),
+                             tel.hist_quantile("preempt.rescue_save_ms",
+                                               0.5))
+            return (stats["elastic"]["last_reconfigure_s"], stats,
+                    leg_telemetry)
+
+    try:
+        planned_s, planned_stats, (fallback, rescue_ms) = \
+            shrink_leg(planned=True)
+        assert planned_stats["preempt"]["rescue_saves"] == 1.0, \
+            planned_stats["preempt"]
+        assert fallback == 0.0, "planned handoff touched ckpt.fallback"
+        unplanned_s, _, _ = shrink_leg(planned=False)
+        # the structural gap: an UN-announced death is invisible until
+        # the watchdog's heartbeat window expires, so its end-to-end
+        # downtime floors at detection + reconfigure; an announced
+        # departure pays reconfigure alone (the notice precedes the
+        # death). The reconfigure spans are recorded raw side by side;
+        # the *_total_* fields add that detection floor.
+        detect_floor = const.ENV.ADT_HEARTBEAT_TIMEOUT_S.val
+        return {
+            "rescue_save_ms": round(rescue_ms or 0.0, 2),
+            "planned_handoff_downtime_s": round(planned_s, 4),
+            "unplanned_shrink_downtime_s": round(unplanned_s, 4),
+            "unplanned_detection_floor_s": round(detect_floor, 1),
+            "planned_total_downtime_s": round(planned_s, 4),
+            "unplanned_total_downtime_s": round(unplanned_s + detect_floor,
+                                                4),
+            "notices": planned_stats["preempt"]["notices"],
+            "rescue_saves": planned_stats["preempt"]["rescue_saves"],
+            "ckpt_fallback": fallback,
+        }
+    except Exception as e:  # noqa: BLE001 — a broken preempt leg must
+        # not sink the whole smoke round; surface it in the json instead
+        print("[bench] preempt smoke leg failed: %s" % e, file=sys.stderr,
+              flush=True)
+        return {"error": "%s: %s" % (type(e).__name__, str(e)[:160])}
 
 
 def _smoke_elastic(loss_fn, params, batches):
@@ -599,69 +736,47 @@ def _smoke_elastic(loss_fn, params, batches):
     the steps it blocked (downtime / steady median step) — plus the
     fenced-write counter, so BENCH rounds track the price of an elastic
     event alongside throughput."""
-    import socket
-
     import numpy as np
     import optax
     import autodist_tpu as adt
     from autodist_tpu import strategy
     from autodist_tpu.runtime import elastic
-    from autodist_tpu.runtime.coordination import (CoordinationClient,
-                                                   CoordinationServer)
+    from autodist_tpu.runtime.coordination import CoordinationClient
     from autodist_tpu.telemetry import spans as tel
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    saved = {k: os.environ.get(k) for k in
-             ("ADT_COORDSVC_PORT", "ADT_ELASTIC", "ADT_ELASTIC_SYNC",
-              "ADT_ELASTIC_INRUN", "ADT_ELASTIC_POLL_S")}
-    os.environ.update({"ADT_COORDSVC_PORT": str(port), "ADT_ELASTIC": "1",
-                       "ADT_ELASTIC_SYNC": "1", "ADT_ELASTIC_INRUN": "1",
-                       "ADT_ELASTIC_POLL_S": "0.01"})
-    srv = CoordinationServer(port)
     try:
-        srv.start()
-        adt.reset()
-        ad = adt.AutoDist(strategy_builder=strategy.AllReduce())
-        runner = ad.build(loss_fn, optax.adam(1e-2), params, batches[0])
-        runner.init(params)
-        client = CoordinationClient("127.0.0.1", port)
-        m = elastic.current()
-        assert m is not None, "elastic membership was not armed"
-        for i, b in enumerate(batches):
-            runner.run(b)
-            if i == len(batches) // 2:
-                elastic.publish_epoch(client, m.epoch + 1, m.roster)
-                time.sleep(0.05)  # let the poll window lapse
-        client.close()
-        stats = runner.step_stats()
-        assert stats["elastic"]["reconfigs"] == 1, stats["elastic"]
-        spans = tel.get_recorder().durations_s("elastic.reconfigure")
-        downtime = spans[0] if spans else stats["elastic"][
-            "last_reconfigure_s"]
-        steady = stats["steady_median_s"] or 0.0
-        return {
-            "reconfigs": stats["elastic"]["reconfigs"],
-            "epoch": stats["elastic"]["epoch"],
-            "reconfigure_downtime_s": round(float(downtime or 0.0), 4),
-            "steps_blocked": (int(np.ceil(downtime / steady))
-                              if downtime and steady else None),
-            "fenced_writes": stats["elastic"]["fenced_writes"],
-        }
+        with _inrun_elastic_sandbox() as port:
+            ad = adt.AutoDist(strategy_builder=strategy.AllReduce())
+            runner = ad.build(loss_fn, optax.adam(1e-2), params, batches[0])
+            runner.init(params)
+            client = CoordinationClient("127.0.0.1", port)
+            m = elastic.current()
+            assert m is not None, "elastic membership was not armed"
+            for i, b in enumerate(batches):
+                runner.run(b)
+                if i == len(batches) // 2:
+                    elastic.publish_epoch(client, m.epoch + 1, m.roster)
+                    time.sleep(0.05)  # let the poll window lapse
+            client.close()
+            stats = runner.step_stats()
+            assert stats["elastic"]["reconfigs"] == 1, stats["elastic"]
+            spans = tel.get_recorder().durations_s("elastic.reconfigure")
+            downtime = spans[0] if spans else stats["elastic"][
+                "last_reconfigure_s"]
+            steady = stats["steady_median_s"] or 0.0
+            return {
+                "reconfigs": stats["elastic"]["reconfigs"],
+                "epoch": stats["elastic"]["epoch"],
+                "reconfigure_downtime_s": round(float(downtime or 0.0), 4),
+                "steps_blocked": (int(np.ceil(downtime / steady))
+                                  if downtime and steady else None),
+                "fenced_writes": stats["elastic"]["fenced_writes"],
+            }
     except Exception as e:  # noqa: BLE001 — a broken elastic leg must
         # not sink the whole smoke round; surface it in the json instead
         print("[bench] elastic smoke leg failed: %s" % e, file=sys.stderr,
               flush=True)
         return {"error": "%s: %s" % (type(e).__name__, str(e)[:160])}
-    finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
-        adt.reset()
-        srv.stop()
 
 
 def _smoke_sentinel(loss_fn, params, batches, plain_steps):

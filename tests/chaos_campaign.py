@@ -1,0 +1,338 @@
+"""Randomized chaos campaign — all five fault planes, one seeded run.
+
+A *campaign* composes a seeded schedule across every fault plane the
+repo can inject — **wire** (FaultyProxy delays/resets), **partition**
+(the zombie-revival blackhole window), **ckpt** (post-commit damage),
+**grad** (a traced NaN the sentinel must skip), and **preempt** (a real
+SIGTERM with a deadline-to-SIGKILL, ``faultinject.deliver_preemption``)
+— against a real training subprocess, then restarts it with
+``ADT_AUTO_RESUME`` and asserts the standing invariants:
+
+- **loss continuity within tolerance** — the interrupted + resumed
+  trajectory matches an uncrashed reference run step for step (training
+  is deterministic; the grad fault and sentinel run identically in
+  both);
+- **zero fenced-write corruption / always-resumable** — every
+  checkpoint the integrity scan sees is committed-or-expected-debris,
+  and the newest committed one restores (the deliberately damaged one,
+  when the schedule includes damage, is skipped by the fallback scan);
+- **the rescue checkpoint landed** — a graceful (exit 0) preemption
+  leaves a committed checkpoint at the rescue step, and the planned
+  path never touches ``ckpt.fallback``.
+
+Each campaign writes a JSON transcript (schedule, observed events,
+assertion outcomes) — the nightly workflow uploads them as artifacts::
+
+    python tests/chaos_campaign.py --seeds 101,202,303 --out /tmp/chaos
+
+``tests/test_preemption.py`` runs one seed as the slow/chaos pytest leg.
+"""
+import argparse
+import json
+import os
+import random
+import socket
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+DRIVER = r"""
+import json, os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import optax
+import autodist_tpu as adt
+from autodist_tpu import strategy
+from autodist_tpu.runtime import preemption
+
+steps = int(sys.argv[1])
+progress_path = sys.argv[2]
+
+rng = np.random.RandomState(7)
+params = {"w": jax.numpy.asarray(rng.randn(8, 4) * 0.3, jax.numpy.float32)}
+
+def loss_fn(p, batch):
+    return jax.numpy.mean((batch["x"] @ p["w"] - batch["y"]) ** 2)
+
+batch = {"x": rng.randn(8, 8).astype(np.float32),
+         "y": rng.randn(8, 4).astype(np.float32)}
+
+ad = adt.AutoDist(strategy_builder=strategy.AllReduce())
+runner = ad.build(loss_fn, optax.sgd(0.05), params, batch)
+runner.init(params)
+start = int(np.asarray(jax.device_get(runner.state.step)).ravel()[0])
+
+from autodist_tpu.checkpoint.saver import Saver
+saver = Saver(directory=os.environ["ADT_CKPT_DIR"])
+runner._preempt.attach_saver(saver)
+
+try:
+    for i in range(start, steps):
+        m = runner.run(batch)
+        with open(progress_path, "a") as f:
+            f.write("%d %.8f\n" % (i, float(m["loss"])))
+            f.flush()
+            os.fsync(f.fileno())
+        if (i + 1) % 3 == 0:
+            saver.save(runner)
+            saver.wait()
+except preemption.PlannedDeparture as e:
+    print("DRIVER_PLANNED_DEPARTURE %s" % e, flush=True)
+    raise
+print("DRIVER_DONE", flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def build_schedule(seed: int, steps: int = 15) -> dict:
+    """One seeded composition across the five fault planes."""
+    rng = random.Random(seed)
+    return {
+        "seed": seed,
+        "steps": steps,
+        # grad plane: a transient NaN the sentinel skips (identical in
+        # the reference run, so trajectories stay comparable)
+        "grad_fault_step": rng.randrange(2, 5),
+        # wire plane: a delayed RPC and an ambiguous reset
+        "wire": [
+            {"op": "delay", "match": "PUT",
+             "nth": rng.randrange(3, 9), "delay_s": 0.05},
+            {"op": "reset", "match": "GET", "when": "after",
+             "nth": rng.randrange(6, 18)},
+        ],
+        # partition plane: a short global blackhole window
+        "partition": {"op": "partition", "match": "PUT",
+                      "nth": rng.randrange(4, 10),
+                      "duration_s": round(rng.uniform(0.1, 0.3), 2)},
+        # preempt plane: SIGTERM after this many observed steps, SIGKILL
+        # deadline_s later — the window the rescue + handoff must fit
+        "preempt_after_steps": rng.randrange(7, 10),
+        "deadline_s": round(rng.uniform(8.0, 15.0), 1),
+        # ckpt plane: flip a bit in the newest committed checkpoint
+        # before the resume (the fallback scan must skip past it)
+        "ckpt_damage": rng.random() < 0.5,
+    }
+
+
+def _spawn(script_path: str, schedule: dict, env_extra: dict,
+           progress_path: str, tmpdir: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    for k in ("ADT_WORKER", "ADT_ELASTIC", "ADT_ELASTIC_SYNC",
+              "ADT_ELASTIC_INRUN", "ADT_AUTO_RESUME", "ADT_FAULT_PLAN",
+              "ADT_GRAD_FAULT_PLAN", "ADT_CKPT_FAULT_PLAN",
+              "ADT_SENTINEL", "ADT_NUM_PROCESSES", "ADT_STRATEGY_ID"):
+        env.pop(k, None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "ADT_WORKING_DIR": os.path.join(tmpdir, "work"),
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(HERE)] +
+            ([os.environ["PYTHONPATH"]] if os.environ.get("PYTHONPATH")
+             else [])),
+        # grad plane + the sentinel that survives it
+        "ADT_GRAD_FAULT_PLAN": json.dumps({"faults": [
+            {"var": "w", "mode": "nan",
+             "step": schedule["grad_fault_step"]}]}),
+        "ADT_SENTINEL": "1",
+    })
+    env.update(env_extra)
+    return subprocess.Popen(
+        [sys.executable, script_path, str(schedule["steps"]),
+         progress_path],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+
+def _read_progress(path: str) -> list:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) == 2:
+                out.append((int(parts[0]), float(parts[1])))
+    return out
+
+
+def _wait_for_steps(path: str, n: int, timeout_s: float = 300.0) -> list:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        prog = _read_progress(path)
+        if len(prog) >= n:
+            return prog
+        time.sleep(0.05)
+    raise TimeoutError("victim never reached step %d (have %d)"
+                       % (n, len(_read_progress(path))))
+
+
+def run_campaign(seed: int, outdir: str) -> dict:
+    """Run one seeded campaign end to end; returns (and writes) the
+    transcript. Raises AssertionError when an invariant breaks."""
+    from autodist_tpu.checkpoint import integrity
+    from autodist_tpu.runtime import faultinject
+    from autodist_tpu.runtime.coordination import CoordinationServer
+
+    schedule = build_schedule(seed)
+    os.makedirs(outdir, exist_ok=True)
+    campaign_dir = os.path.join(outdir, "campaign-%d" % seed)
+    os.makedirs(campaign_dir, exist_ok=True)
+    script = os.path.join(campaign_dir, "driver.py")
+    with open(script, "w") as f:
+        f.write(DRIVER)
+    ckpt_dir = os.path.join(campaign_dir, "ckpt")
+    transcript = {"format": "adt-chaos-campaign-v1", "schedule": schedule,
+                  "events": [], "invariants": {}}
+
+    def event(kind, **data):
+        transcript["events"].append(
+            {"t": round(time.time(), 3), "kind": kind, **data})
+
+    # ---- phase 0: uncrashed reference (grad fault + sentinel only; no
+    # wire/partition/preempt/ckpt planes, no coordination service)
+    ref_progress = os.path.join(campaign_dir, "ref.txt")
+    ref = _spawn(script, schedule, {
+        "ADT_CKPT_DIR": os.path.join(campaign_dir, "ref-ckpt"),
+    }, ref_progress, campaign_dir)
+    ref_out, ref_err = ref.communicate(timeout=300)
+    assert ref.returncode == 0, ref_out[-2000:] + ref_err[-4000:]
+    ref_losses = dict(_read_progress(ref_progress))
+    assert len(ref_losses) == schedule["steps"]
+    event("reference_done", steps=len(ref_losses))
+
+    # ---- phase 1: the victim, all five planes armed
+    svc_port = _free_port()
+    server = CoordinationServer(port=svc_port)
+    server.start()
+    plan = faultinject.FaultPlan({"seed": seed, "faults":
+                                  schedule["wire"] + [schedule["partition"]]})
+    proxy = faultinject.FaultyProxy("127.0.0.1", svc_port, plan=plan)
+    proxy.start()
+    progress = os.path.join(campaign_dir, "victim.txt")
+    victim_env = {
+        "ADT_COORDSVC_PORT": str(proxy.port),
+        "ADT_CKPT_DIR": ckpt_dir,
+        "ADT_ELASTIC": "1", "ADT_ELASTIC_SYNC": "1",
+        "ADT_ELASTIC_INRUN": "1", "ADT_ELASTIC_POLL_S": "0.05",
+        "ADT_PREEMPT_POLL_S": "0.05",
+        "ADT_PREEMPT_DEADLINE_S": str(schedule["deadline_s"]),
+    }
+    victim = _spawn(script, schedule, victim_env, progress, campaign_dir)
+    try:
+        _wait_for_steps(progress, schedule["preempt_after_steps"])
+        event("preempt_delivered", pid=victim.pid,
+              deadline_s=schedule["deadline_s"])
+        killer = faultinject.deliver_preemption(
+            victim.pid, deadline_s=schedule["deadline_s"],
+            reason="campaign-%d" % seed)
+        v_out, v_err = victim.communicate(timeout=schedule["deadline_s"]
+                                          + 60)
+        killer.join(timeout=1)
+    finally:
+        proxy.stop()
+        server.stop()
+    event("victim_exit", code=victim.returncode,
+          injected=list(plan.injected))
+    graceful = victim.returncode == 0
+    transcript["invariants"]["graceful_departure"] = graceful
+    if graceful:
+        assert "DRIVER_PLANNED_DEPARTURE" in v_out, (
+            "exit 0 without the planned-departure path:\n"
+            + v_out[-2000:] + v_err[-4000:])
+
+    # invariant: a committed checkpoint exists (the rescue save on the
+    # graceful path; the last periodic save otherwise), and the
+    # integrity scan classifies nothing as corrupt
+    victim_steps = _read_progress(progress)
+    assert victim_steps, "victim made no progress"
+    statuses = list(integrity.scan(ckpt_dir))
+    committed = [s for s in statuses if s.state == "committed"]
+    assert committed, "no committed checkpoint after preemption: %s" % (
+        [(s.step, s.state) for s in statuses],)
+    assert not [s for s in statuses if s.state == "corrupt"], statuses
+    if graceful:
+        rescue_step = max(s.step for s in committed)
+        assert rescue_step >= victim_steps[-1][0], (
+            "graceful departure without a rescue checkpoint at the final "
+            "boundary: newest committed step %d < last trained step %d"
+            % (rescue_step, victim_steps[-1][0]))
+        transcript["invariants"]["rescue_step"] = rescue_step
+    event("integrity_scan",
+          committed=[s.step for s in committed])
+
+    # ---- phase 2 (ckpt plane): damage the newest committed checkpoint,
+    # the resume must fall back past it — always-resumable
+    if schedule["ckpt_damage"]:
+        newest = max(committed, key=lambda s: s.step)
+        target = os.path.join(ckpt_dir, "ckpt-%d.params.npz" % newest.step)
+        if os.path.exists(target):
+            faultinject.flip_bit(target)
+            event("ckpt_damaged", step=newest.step)
+
+    # ---- phase 3: restart with auto-resume; the trajectory must match
+    # the reference at every step it trains
+    resume_env = dict(victim_env)
+    resume_env.pop("ADT_COORDSVC_PORT", None)  # serviceless resume
+    resume_env["ADT_AUTO_RESUME"] = "1"
+    resume_progress = os.path.join(campaign_dir, "resume.txt")
+    resumed = _spawn(script, schedule, resume_env, resume_progress,
+                     campaign_dir)
+    r_out, r_err = resumed.communicate(timeout=300)
+    assert resumed.returncode == 0, r_out[-2000:] + r_err[-4000:]
+    resume_losses = _read_progress(resume_progress)
+    assert resume_losses, "resume trained nothing (nothing to restore?)"
+    assert resume_losses[-1][0] == schedule["steps"] - 1
+    event("resume_done", first_step=resume_losses[0][0],
+          steps=len(resume_losses))
+
+    # loss continuity: every resumed step's loss matches the uncrashed
+    # reference (training is deterministic; the grad fault ran in both)
+    worst = 0.0
+    for step, loss in resume_losses:
+        ref_loss = ref_losses[step]
+        denom = max(abs(ref_loss), 1e-12)
+        worst = max(worst, abs(loss - ref_loss) / denom)
+    assert worst < 1e-4, (
+        "resumed trajectory diverged from the reference: max rel err %g"
+        % worst)
+    transcript["invariants"].update(
+        loss_continuity_max_rel_err=worst,
+        always_resumable=True,
+        zero_corrupt_committed=True,
+    )
+    path = os.path.join(campaign_dir, "transcript.json")
+    with open(path, "w") as f:
+        json.dump(transcript, f, indent=2, sort_keys=True)
+    transcript["path"] = path
+    return transcript
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--seeds", default="101",
+                   help="comma-separated campaign seeds")
+    p.add_argument("--out", default="/tmp/adt-chaos-campaigns")
+    args = p.parse_args(argv)
+    failures = 0
+    for seed in [int(s) for s in args.seeds.split(",") if s]:
+        t0 = time.monotonic()
+        try:
+            t = run_campaign(seed, args.out)
+            print("campaign %d OK in %.1fs: %s"
+                  % (seed, time.monotonic() - t0,
+                     json.dumps(t["invariants"], sort_keys=True)))
+        except (AssertionError, TimeoutError) as e:
+            failures += 1
+            print("campaign %d FAILED: %s" % (seed, e))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
