@@ -145,6 +145,8 @@ def _builders(mp_rules):
         "AllReduceInt8Wire": lambda: S.AllReduce(wire_dtype="int8"),
         "PSInt8Wire": lambda: S.PS(wire_dtype="int8"),
         "PartitionedAR": lambda: S.PartitionedAR(),
+        "ZeroSharded": lambda: S.ZeroSharded(),
+        "ZeroShardedInt8Wire": lambda: S.ZeroSharded(wire_dtype="int8"),
         "RandomAxisPartitionAR": lambda: S.RandomAxisPartitionAR(),
         "Parallax": lambda: S.Parallax(),
         "SequenceParallelAR": lambda: S.SequenceParallelAR(seq_shards=2),

@@ -386,6 +386,10 @@ def _is_ar(sync) -> bool:
     return getattr(sync, "kind", "") == "AllReduce"
 
 
+def _is_zero(sync) -> bool:
+    return getattr(sync, "kind", "") == "ZeroSharded"
+
+
 @rule
 def _r_synchronizers(ctx: Context) -> Iterable[Diagnostic]:
     for node in ctx.strategy.node_config:
@@ -478,11 +482,13 @@ def _r_async_all_or_nothing(ctx: Context) -> Iterable[Diagnostic]:
     if not is_async:
         return
     for node, owner, sync in all_syncs:
-        if _is_ar(sync):
+        if _is_ar(sync) or _is_zero(sync):
             yield error(
                 "ADT307",
-                "async PS is all-or-nothing: this variable rides "
-                "AllReduce while others are async", var=owner,
+                "async PS is all-or-nothing: this variable rides %s "
+                "while others are async"
+                % ("ZeroSharded" if _is_zero(sync) else "AllReduce"),
+                var=owner,
                 fixit="route every trainable variable through "
                       "PS(sync=False)")
         elif _is_ps(sync) and sync.sync:
@@ -614,6 +620,84 @@ def _r_wire_dtype(ctx: Context) -> Iterable[Diagnostic]:
                     % (info_.num_elements, block), var=owner,
                     fixit="keep variables smaller than one block "
                           "(ADT_WIRE_BLOCK=%d) on the fp32 wire" % block)
+
+
+@rule
+def _r_zero_sharded(ctx: Context) -> Iterable[Diagnostic]:
+    """ZeRO-sharded update (``ZeroShardedSynchronizer``) validity.
+
+    - ``ADT312`` (error): combinations the sharded update cannot lower —
+      sparse variables (the reduce-scatter densifies the batch-row-sized
+      gradient to the full table), ``mp_axes``/``partitioner`` storage on
+      the same variable (the flat shard math owns the whole value), and
+      mixing ZeroSharded with stale/async PS variables (the rs+ag pair
+      is a lockstep collective every step; decoupled peers deadlock or
+      apply against drifted params).
+    - ``ADT313`` (warning): a variable smaller than one per-replica
+      shard — the padding and two collective launches exceed the
+      opt-state saving; keep it on plain AllReduce."""
+    from autodist_tpu.strategy.zero_sharded_strategy import zero_shardable
+    n_data = int(ctx.mesh_axis_sizes().get(const.DATA_AXIS,
+                                           max(len(ctx.replicas), 1)))
+    zero_owners = []
+    decoupled_ps = []
+    for node in ctx.strategy.node_config:
+        info_ = ctx.var_infos.get(node.var_name)
+        for owner, sync in ctx.synchronizers(node):
+            if _is_ps(sync) and (not sync.sync or sync.staleness > 0):
+                decoupled_ps.append(owner)
+            if not _is_zero(sync):
+                continue
+            zero_owners.append(owner)
+            if info_ is not None and getattr(info_, "sparse", False):
+                yield error(
+                    "ADT312",
+                    "ZeroSharded on a sparse (gather-indexed) variable — "
+                    "the reduce-scatter densifies its batch-row-sized "
+                    "gradient to the full table every step", var=owner,
+                    fixit="route embeddings to PS (Parallax) or plain "
+                          "AllReduce so the (ids, values) sparse wire "
+                          "engages")
+            if node.mp_axes:
+                yield error(
+                    "ADT312",
+                    "ZeroSharded cannot combine with mp_axes storage — "
+                    "the sharded update owns the whole flat variable",
+                    var=owner,
+                    fixit="drop one: model-parallel storage or the "
+                          "sharded update")
+            if node.partitioner:
+                yield error(
+                    "ADT312",
+                    "ZeroSharded cannot combine with a partitioner — "
+                    "partitioned storage already shards the update "
+                    "(reduce-scatter path)", var=owner,
+                    fixit="drop the partitioner (ZeroSharded shards the "
+                          "flat variable itself)")
+            if (info_ is not None and not node.mp_axes
+                    and not node.partitioner
+                    and not getattr(info_, "sparse", False)
+                    and not zero_shardable(info_, n_data)):
+                yield warning(
+                    "ADT313",
+                    "ZeroSharded on a %d-element variable with %d "
+                    "replicas: each shard is smaller than one element — "
+                    "the padding + rs/ag launches outweigh the opt-state "
+                    "saving" % (getattr(info_, "num_elements", 0), n_data),
+                    var=owner,
+                    fixit="keep variables smaller than one per-replica "
+                          "shard on plain AllReduce")
+    if zero_owners and decoupled_ps:
+        yield error(
+            "ADT312",
+            "ZeroSharded vars %s mix with stale/async PS vars %s: the "
+            "sharded update's rs+ag pair is a lockstep collective every "
+            "step, but a stale/async PS window lets peers run decoupled "
+            "steps" % (sorted(set(zero_owners))[:3],
+                       sorted(set(decoupled_ps))[:3]),
+            var=zero_owners[0],
+            fixit="use sync staleness=0 PS beside ZeroSharded, or keep "
+                  "the whole plan on one discipline")
 
 
 # ------------------------------------------------------------- ADT4xx rules

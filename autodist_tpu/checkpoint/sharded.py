@@ -838,8 +838,15 @@ class ShardedSaver:
             item = dstep.model_item
             holed = dstep._holed_template
             # step_fn mode has no framework optimizer: the opaque state's
-            # own moments live under P| and the O tree is empty
-            opt_template = (jax.eval_shape(item.optimizer.init, holed)
+            # own moments live under P| and the O tree is empty.
+            # ZeRO-sharded vars additionally have no O| slot — their
+            # optimizer shards ride the S| (sync_state) tree.
+            opt_basis = holed
+            if getattr(dstep, "zero_syncs", None):
+                from autodist_tpu.parallel import ps as ps_lib
+                opt_basis = ps_lib.hole_out_params(
+                    holed, frozenset(dstep.zero_syncs))
+            opt_template = (jax.eval_shape(item.optimizer.init, opt_basis)
                             if item.optimizer is not None else {})
             p_flex = o_flex = None
             if not same:
@@ -870,12 +877,14 @@ class ShardedSaver:
                 # to the wrong devices (or fail outright on scale-up), so
                 # a cross-topology restore resets it to fresh init:
                 # error feedback restarts from zero, a safe transient.
-                if any(k.startswith("S|") for k in meta["leaves"]):
-                    logging.warning(
-                        "cross-topology restore: per-device compressor "
-                        "state reset to fresh init (residuals are "
-                        "topology-bound)")
-                sync_state = dstep.place_sync_state(sync_template)
+                # ZeRO-sharded optimizer shards are the exception: their
+                # rows are GLOBAL flat slices of the variable, so they
+                # re-lay-out exactly under the new replica count
+                # (_flex_zero_sync below) — losing adam moments on a
+                # shrink would not be a safe transient.
+                host_sync = self._flex_zero_sync(sync_template, meta,
+                                                 reader, dstep)
+                sync_state = dstep.place_sync_state(host_sync)
             store = dstep.ps_store
             if store is not None:
                 # a staged prefetch of pre-restore values must not survive
@@ -907,6 +916,79 @@ class ShardedSaver:
         logging.info("restored sharded checkpoint %s (step %d, local slices "
                      "only)", path, step)
         return state, step
+
+    def _flex_zero_sync(self, sync_template, meta, reader, dstep):
+        """Host sync_state for a cross-topology restore: ZeRO-sharded
+        optimizer shards (``sync_state['zero']``) re-lay-out from the
+        save topology's global flat slices onto the running replica
+        count — concatenate the save-time per-data-index rows, re-pad to
+        the new shard size, re-split — while every other per-device leaf
+        (compressor residuals, sentinel LR scale) resets to the fresh
+        template init (residuals are topology-bound transients)."""
+        names, leaves, treedef = variable_utils.flatten_named(sync_template)
+        zero_syncs = getattr(dstep, "zero_syncs", {}) or {}
+        old_axes = list(meta["mesh"]["axes"])
+        old_shape = [int(s) for s in meta["mesh"]["shape"]]
+        data_axis = dstep.mesh_axis
+        groups = _group_keys(meta)
+        relaid, reset = [], []
+
+        def owner_of(leaf_name):
+            best = None
+            for v in zero_syncs:
+                if (leaf_name == "zero/%s" % v
+                        or leaf_name.startswith("zero/%s/" % v)):
+                    if best is None or len(v) > len(best):
+                        best = v
+            return best
+
+        def read_full(leaf_name, lm):
+            shape = tuple(lm["shape"])
+            dtype = np.dtype(lm["dtype"])
+            pieces = []
+            for key in groups.get("S|%s" % leaf_name, []):
+                token = key.split("|", 2)[2]
+                ranges = ([(s.start, s.stop) for s in _token_slices(token)]
+                          if token != "-" else [])
+                pieces.append((key, ranges))
+            if not pieces:
+                return None
+            return self._assemble_flex_slice(
+                tuple(slice(0, d) for d in shape), shape, shape, dtype,
+                pieces, reader)
+
+        out = []
+        for name, tmpl in zip(names, leaves):
+            var = owner_of(name)
+            lm = meta["leaves"].get("S|%s" % name)
+            if var is None or lm is None or data_axis not in old_axes:
+                out.append(tmpl)
+                if name.startswith(("bucket/", "var/")) and lm is not None:
+                    reset.append(name)
+                continue
+            saved = read_full(name, lm)
+            if saved is None:
+                out.append(tmpl)
+                continue
+            from autodist_tpu.kernel.synchronization.zero_synchronizer \
+                import relayout_zero_sync_leaf
+            tmpl_np = np.asarray(tmpl)
+            full = relayout_zero_sync_leaf(saved, old_axes, old_shape,
+                                           data_axis, zero_syncs[var],
+                                           tmpl_np.shape, tmpl_np.dtype)
+            if full is None:
+                out.append(tmpl)
+                reset.append(name)
+                continue
+            out.append(full)
+            relaid.append(name)
+        if relaid or reset:
+            logging.warning(
+                "cross-topology restore: %d ZeRO opt-state leaves "
+                "re-laid-out onto %d replicas; %d per-device leaves "
+                "(compressor residuals) reset to fresh init",
+                len(relaid), int(dstep.mesh.shape[data_axis]), len(reset))
+        return variable_utils.unflatten_named(treedef, out)
 
     def _flex_ps_provider(self, meta, reader, groups, store):
         """Provider for :meth:`PSStore.load_shard_states` when the RUNNING

@@ -84,7 +84,8 @@ class DistributedStep:
                  eval_fn: Optional[Callable] = None,
                  ps_store=None, holed_params_template=None,
                  fused_builder: Optional[Callable] = None,
-                 forward_builder: Optional[Callable] = None):
+                 forward_builder: Optional[Callable] = None,
+                 zero_syncs: Optional[dict] = None):
         self.mesh = mesh
         self.mesh_axis = mesh_axis
         self.all_axes = tuple(mesh.axis_names)
@@ -136,6 +137,18 @@ class DistributedStep:
             self.metadata.get("wire_quant_bytes_per_step", 0.0))
         self._wire_fp_step = float(
             self.metadata.get("wire_fp32_bytes_per_step", 0.0))
+        # ZeRO-sharded update: per-variable kernels (shard math shared by
+        # the lowering, the checkpoint re-shard, and the byte
+        # accounting), static per-step rs/ag payloads for the zero.*
+        # counters, and the projected opt-state HBM saving as a gauge
+        self.zero_syncs = dict(zero_syncs or {})
+        self._zero_rs_step = float(
+            self.metadata.get("zero_rs_bytes_per_step", 0.0))
+        self._zero_ag_step = float(
+            self.metadata.get("zero_ag_bytes_per_step", 0.0))
+        saved = float(self.metadata.get("zero_hbm_saved_bytes", 0.0))
+        if saved:
+            tel.gauge_set("zero.hbm_saved_bytes", saved)
 
     def _count_wire(self, microsteps: int = 1) -> None:
         if self._wire_q_step:
@@ -144,6 +157,9 @@ class DistributedStep:
             tel.counter_add("wire.bytes_saved",
                             (self._wire_fp_step - self._wire_q_step)
                             * microsteps)
+        if self._zero_rs_step or self._zero_ag_step:
+            tel.counter_add("zero.rs_bytes", self._zero_rs_step * microsteps)
+            tel.counter_add("zero.ag_bytes", self._zero_ag_step * microsteps)
 
     # ---------------------------------------------------------- ps data path
 
@@ -557,11 +573,23 @@ class DistributedStep:
                 holed_opt_template = jax.eval_shape(item.optimizer.init,
                                                     self._holed_template)
                 opt_state = ps_lib.hole_like(holed_opt_template, opt_state)
+        if self.zero_syncs and item.optimizer is not None \
+                and opt_state is not None:
+            # ZeRO-sharded vars have no slot in the device optimizer tree
+            # (their state lives sharded in sync_state['zero']); a full
+            # (checkpoint-layout) opt_state is holed down to the device
+            # basis — idempotent when already holed
+            basis = ps_lib.hole_out_params(self._holed_template,
+                                           frozenset(self.zero_syncs))
+            opt_state = ps_lib.hole_like(
+                jax.eval_shape(item.optimizer.init, basis), opt_state)
         if opt_state is None:
             # step_fn mode has no framework-owned optimizer: whatever
             # optimizer state exists lives inside the user's opaque state
-            opt_state = (item.optimizer.init(params)
-                         if item.optimizer is not None else {})
+            opt_state = (item.optimizer.init(
+                ps_lib.hole_out_params(params, frozenset(self.zero_syncs))
+                if self.zero_syncs else params)
+                if item.optimizer is not None else {})
         # pad + place params. Device-resident leaves stay on device the
         # whole way: jnp.pad pads in an on-device op and _put reshards
         # device-side — np.pad would download every leaf first.
@@ -631,8 +659,32 @@ class DistributedStep:
             # value snapshot gather_params takes (not torn across an apply)
             self.flush_ps()
             self.ps_store.drain()
-            gathered = ps_lib.fill_holes_with_path(
-                gathered, self.ps_store.full_opt_leaf)
+
+            def ps_leaf(slot_path, var_name):
+                if var_name in self.zero_syncs:
+                    return ps_lib.PSHole(var_name)  # the zero pass fills it
+                return self.ps_store.full_opt_leaf(slot_path, var_name)
+            gathered = ps_lib.fill_holes_with_path(gathered, ps_leaf)
+        if self.zero_syncs:
+            # ZeRO-sharded slots reconstruct from the per-replica shards
+            # in sync_state['zero'] (gathered host-side with the leading
+            # device axis), concatenated in data-axis order — checkpoints
+            # keep the reference's 'original full layout' property
+            zero_host = self.gather_sync_state(state).get("zero", {})
+
+            def zero_leaf(slot_path: str, var_name: str):
+                zs = self.zero_syncs[var_name]
+                little = zero_host[var_name]
+                names, leaves, _ = variable_utils.flatten_named(little)
+                flat = dict(zip(names, leaves))
+                prefix = slot_path[: -len(var_name)].rstrip("/")
+                key = (prefix + "/v") if prefix else "v"
+                if key not in flat:
+                    raise KeyError(
+                        "sync_state['zero'] has no opt slot %r for %s"
+                        % (slot_path, var_name))
+                return zs.unshard_host(flat[key])
+            gathered = ps_lib.fill_holes_with_path(gathered, zero_leaf)
         return gathered
 
     def gather_sync_state(self, state: TrainState):
@@ -699,17 +751,25 @@ class GraphTransformer:
                                 self._strategy.graph_config.seq_feed_keys)
 
     def _build_synchronizers(self, layouts, ps_names=frozenset(),
-                             sparse_wire=frozenset()) -> Dict[str, Synchronizer]:
+                             sparse_wire=frozenset(),
+                             zero_names=frozenset()) -> Dict[str, Synchronizer]:
         """Per-variable synchronizer kernels from strategy node configs
         (reference ``graph_transformer.py:94-130``). Host-resident PS vars
         (``ps_names``) have no in-SPMD synchronizer — their gradient leaves
         the device and the store applies the update. Sparse-wire vars sync
         via the (ids, values) all-gather path in the lowering
-        (``ops/embedding.py``), not a dense collective."""
+        (``ops/embedding.py``), not a dense collective. ZeRO-sharded vars
+        (``zero_names``) own their whole update path through the
+        ZeroSynchronizer kernels; a ZeroSharded node NOT in that set
+        (single data replica) degrades to a plain AllReduce kernel."""
+        from autodist_tpu.strategy.base import (
+            AllReduceSynchronizer as ARConfig)
         syncs = {}
         for node in self._strategy.node_config:
             info = self._item.var_infos.get(node.var_name)
             if info is None:
+                continue
+            if node.var_name in zero_names:
                 continue
             if node.var_name in sparse_wire:
                 comp = getattr(node.synchronizer, "compressor",
@@ -744,6 +804,11 @@ class GraphTransformer:
                 cfg = node.part_configs[0].synchronizer
             if cfg is None:
                 raise ValueError("no synchronizer for var %s" % node.var_name)
+            if cfg.kind == "ZeroSharded":
+                # only reachable when the zero path is disarmed (one data
+                # replica): a plain mean all-reduce is the exact same
+                # update with nothing to shard
+                cfg = ARConfig()
             kind = ("AllReduceSynchronizer" if cfg.kind == "AllReduce"
                     else "PSSynchronizer")
             extra = tuple(a for a in self._axes if a != self._axis)
@@ -808,6 +873,12 @@ class GraphTransformer:
                         "step_fn mode ignores compressor %s on %s — no "
                         "gradient interception on the opaque path",
                         comp, node.var_name)
+                if getattr(sync, "kind", "") == "ZeroSharded":
+                    logging.warning(
+                        "step_fn mode ignores ZeroSharded on %s — the "
+                        "opaque step owns its optimizer, so storage "
+                        "stays replicated (no sharded update)",
+                        node.var_name)
 
         # storage shardings WITHOUT padding: the user's math must see the
         # original shapes (GSPMD shards uneven dims transparently); padding
@@ -954,6 +1025,62 @@ class GraphTransformer:
                     if ps_plans else None)
         holed_params = (ps_lib.hole_out_params(item.params, ps_names)
                         if ps_names else item.params)
+
+        # ----- ZeRO-sharded weight update (arXiv 2004.13336, stage 1):
+        # params stay stored FULL; the gradient reduce-scatters over the
+        # data axis, the optimizer applies to each replica's owned flat
+        # shard against sync_state-resident sharded opt state (created
+        # sharded, never materialized whole), and the update all-gathers
+        # back onto the replicated params. The same invalid combinations
+        # the linter reports as ADT312 raise here, so compile time and
+        # lint time agree.
+        from autodist_tpu.kernel.synchronization.zero_synchronizer import (
+            ZeroSynchronizer)
+        zero_syncs: Dict[str, ZeroSynchronizer] = {}
+        zero_stride = int(np.prod(
+            [self._mesh.shape[a] for a in self._axes[
+                self._axes.index(self._axis) + 1:]] or [1]))
+        for node in self._strategy.node_config:
+            cfg = node.synchronizer
+            if cfg is None or getattr(cfg, "kind", "") != "ZeroSharded":
+                continue
+            info = var_infos.get(node.var_name)
+            if info is None or not info.trainable:
+                continue
+            if getattr(info, "sparse", False):
+                raise ValueError(
+                    "var %s: ZeroSharded on a sparse (gather-indexed) "
+                    "variable — the reduce-scatter would densify its "
+                    "batch-row-sized gradient to the full table every "
+                    "step (ADT312); route it to PS or plain AllReduce"
+                    % node.var_name)
+            if node.mp_axes or node.partitioner:
+                raise ValueError(
+                    "var %s: ZeroSharded cannot combine with %s storage "
+                    "(ADT312) — the sharded update owns the whole flat "
+                    "variable" % (node.var_name,
+                                  "mp_axes" if node.mp_axes
+                                  else "partitioner"))
+            if self.num_replicas <= 1:
+                # one data replica: nothing to shard — the node degrades
+                # to plain AllReduce in _build_synchronizers below
+                logging.info(
+                    "var %s: ZeroSharded on a single data replica "
+                    "degrades to plain AllReduce sync", node.var_name)
+                continue
+            zero_syncs[node.var_name] = ZeroSynchronizer(
+                node.var_name, cfg, tuple(info.shape), info.dtype,
+                self._axis, self.num_replicas,
+                tuple(a for a in self._axes if a != self._axis),
+                self.total_devices, zero_stride)
+        zero_names = frozenset(zero_syncs)
+        # ZeRO-sharded vars have no slot in the device optimizer tree —
+        # the main optimizer.update runs on the holed basis, and their
+        # little-tree shard applies run against sync_state['zero']
+        opt_basis = (ps_lib.hole_out_params(holed_params, zero_names)
+                     if zero_names else holed_params)
+        zero_basis_template = (jax.eval_shape(lambda t: t, opt_basis)
+                               if zero_names else None)
 
         names, _, treedef = variable_utils.flatten_named(holed_params)
         layout_tree = variable_utils.unflatten_named(
@@ -1136,8 +1263,14 @@ class GraphTransformer:
             return (prod / float(self.total_devices)) if prod > 1 else None
         shard_frac = {n: f for n, lay in layouts.items()
                       if (f := _shard_frac(lay)) is not None}
+        # ZeRO-sharded gradients enter the verdict as the owned shard:
+        # sharded over the data axis (replicated over any extra axes),
+        # so the same local*S/N stacked-psum accounting applies
+        for n in zero_names:
+            shard_frac[n] = self.num_replicas / float(self.total_devices)
 
-        syncs = self._build_synchronizers(layouts, ps_names, sparse_wire)
+        syncs = self._build_synchronizers(layouts, ps_names, sparse_wire,
+                                          zero_names)
         # Route unpartitioned AllReduce vars with an *active* compressor into
         # concat buckets (payload transform needs the merged vector).
         # NoneCompressor vars psum individually — XLA's all-reduce combiner
@@ -1177,6 +1310,20 @@ class GraphTransformer:
                 st.pop("bucket")
             if not st["var"]:
                 st.pop("var")
+            if zero_syncs:
+                # per-replica optimizer-state shards, created sharded:
+                # every replica's shard inits identically (optax inits are
+                # shape functions — zeros/counters), so the leading-
+                # device-axis broadcast IS the correct sharded init; the
+                # full state is never materialized
+                zst = {}
+                for n, zs in sorted(zero_syncs.items()):
+                    init = zs.opt_state_init(optimizer)
+                    zst[n] = jax.tree_util.tree_map(
+                        lambda a: np.broadcast_to(
+                            np.asarray(a)[None],
+                            (N,) + np.asarray(a).shape).copy(), init)
+                st["zero"] = zst
             if guard:
                 # effective-LR scale for the sentinel's escalation ladder:
                 # rides the sync_state (same leading-device-axis layout as
@@ -1397,6 +1544,13 @@ class GraphTransformer:
                     s_ids, s_vals, int(info.shape[0]),
                     tuple(info.shape[1:]))
 
+            # ZeRO-sharded vars: reduce-scatter over the data axis — each
+            # replica holds only the mean gradient of the flat shard it
+            # owns; the sharded optimizer apply happens below, after the
+            # main (holed) optimizer update
+            for n in sorted(zero_names):
+                synced[n] = zero_syncs[n].reduce_scatter(g[n])
+
             for b in (buckets if N > 1 else []):
                 bst = new_bucket_state.get(b.key)
                 bst_local = bst[0] if bst is not None else None
@@ -1430,18 +1584,53 @@ class GraphTransformer:
                     synced[n] = psum(g[n]) / N
 
             # device-side update covers only device-resident leaves (the
-            # holed structure); PS leaves update on the host
-            h_names, _, h_treedef = variable_utils.flatten_named(state.params)
+            # holed structure); PS leaves update on the host, ZeRO-sharded
+            # leaves per-shard against sync_state['zero'] below
+            h_names, h_leaves, h_treedef = variable_utils.flatten_named(
+                state.params)
             grads_storage = variable_utils.unflatten_named(
                 h_treedef, [synced[n] for n in h_names])
+            if zero_names:
+                grads_basis = ps_lib.hole_like(zero_basis_template,
+                                               grads_storage)
+                params_basis = ps_lib.hole_like(zero_basis_template,
+                                                state.params)
+            else:
+                grads_basis, params_basis = grads_storage, state.params
             updates, new_opt = optimizer.update(
-                grads_storage, state.opt_state, state.params)
+                grads_basis, state.opt_state, params_basis)
+            lr_scale = (sync_state["sentinel"]["lr_scale"][0] if guard
+                        else None)
             if guard:
                 # sentinel escalation: effective-LR scale from sync_state
-                # (local slice of the leading-device-axis layout)
-                lr_scale = sync_state["sentinel"]["lr_scale"][0]
+                # (local slice of the leading-device-axis layout) — the
+                # zero deltas below scale pre-gather to the same value
                 updates = jax.tree_util.tree_map(
                     lambda u: (u * lr_scale).astype(u.dtype), updates)
+            new_zero_state = {}
+            if zero_names:
+                # the sharded weight update: optimizer on the owned 1/P
+                # shard only (per-var little trees, the SAME per-variable
+                # apply shape the host-PS store runs), then all-gather the
+                # UPDATE so every replica applies the identical delta to
+                # its full-precision replicated param copy
+                p_map = dict(zip(h_names, h_leaves))
+                zstate = sync_state["zero"]
+                zero_deltas = {}
+                for n in sorted(zero_names):
+                    zs = zero_syncs[n]
+                    opt_local = jax.tree_util.tree_map(
+                        lambda a: a[0], zstate[n])
+                    upd, nopt = optimizer.update(
+                        {"v": synced[n]}, opt_local,
+                        {"v": zs.local_shard(p_map[n])})
+                    d = upd["v"]
+                    if lr_scale is not None:
+                        d = (d * lr_scale).astype(d.dtype)
+                    zero_deltas[n] = zs.gather_update(d)
+                    new_zero_state[n] = jax.tree_util.tree_map(
+                        lambda a: jnp.expand_dims(a, 0), nopt)
+                updates = ps_lib.fill_holes(updates, zero_deltas)
             # mask non-trainable updates (guards vs. weight decay etc.)
             if frozen_names:
                 u_names, u_leaves, u_treedef = variable_utils.flatten_named(updates)
@@ -1462,6 +1651,8 @@ class GraphTransformer:
                 new_sync["bucket"] = new_bucket_state
             if new_var_state:
                 new_sync["var"] = new_var_state
+            if new_zero_state:
+                new_sync["zero"] = new_zero_state
             if guard:
                 new_sync["sentinel"] = sync_state["sentinel"]
                 verdict = _health_verdict(synced, ps_grads, new_params,
@@ -1490,8 +1681,9 @@ class GraphTransformer:
         # ----- spec trees for shard_map
         param_specs = _tree_map_layouts(lambda _leaf, lay: lay.pspec,
                                         holed_params, layout_tree)
-        opt_state_spec = (jax.eval_shape(item.optimizer.init, holed_params)
-                          if ps_names else item.opt_state_spec)
+        opt_state_spec = (jax.eval_shape(item.optimizer.init, opt_basis)
+                          if (ps_names or zero_names)
+                          else item.opt_state_spec)
         # quantized-wire PS values enter (and their grads leave) as the
         # {"q", "s"} container — both replicated, like the f32 values
         ps_specs = {n: ({"q": P(), "s": P()} if n in ps_quant else P())
@@ -1779,6 +1971,27 @@ class GraphTransformer:
                         b.total_size, np.dtype(b.dtype).itemsize)
                     wire_q_step += q_b
                     wire_fp_step += f_b
+        # ZeRO-sharded static accounting: per-step rs/ag payload bytes
+        # (zero.rs_bytes / zero.ag_bytes counters — same formula the cost
+        # model prices) and the projected per-chip opt-state saving
+        # ((P-1)/P of each zero var's share of the full optimizer state —
+        # the zero.hbm_saved_bytes gauge, and what the ADT501 plan gate
+        # stops charging)
+        zero_rs_step = sum(zs.rs_payload_bytes()
+                           for zs in zero_syncs.values())
+        zero_ag_step = sum(zs.ag_payload_bytes()
+                           for zs in zero_syncs.values())
+        zero_saved = 0.0
+        if zero_syncs and item.optimizer is not None:
+            opt_total = float(sum(
+                int(np.prod(tuple(l.shape) or (1,)))
+                * np.dtype(l.dtype).itemsize
+                for l in jax.tree_util.tree_leaves(item.opt_state_spec)))
+            params_total = float(item.total_bytes()) or 1.0
+            zero_saved = sum(
+                opt_total * var_infos[n].byte_size / params_total
+                * (self.num_replicas - 1) / self.num_replicas
+                for n in zero_names)
         metadata = {
             # proxied (device-cached) PS vars keep a single destination;
             # host-resident plans carry one owner per shard
@@ -1792,6 +2005,12 @@ class GraphTransformer:
             "per_var_compressors": per_var_comp,
             "wire_quant_bytes_per_step": wire_q_step,
             "wire_fp32_bytes_per_step": wire_fp_step,
+            "zero_sharded": sorted(zero_names),
+            "zero_wire_int8": sorted(n for n, zs in zero_syncs.items()
+                                     if zs.wire_dtype == "int8"),
+            "zero_rs_bytes_per_step": zero_rs_step,
+            "zero_ag_bytes_per_step": zero_ag_step,
+            "zero_hbm_saved_bytes": zero_saved,
             # staleness window for the runner's cross-process pacing
             "staleness": max(
                 [s.staleness for s in ps_syncs]
@@ -1804,14 +2023,16 @@ class GraphTransformer:
             "grad_fault_plan": grad_plan.describe(),
         }
         logging.info("GraphTransformer: lowered %d vars (%d partitioned, "
-                     "%d host-PS-resident, %d buckets) over %d replicas",
+                     "%d host-PS-resident, %d ZeRO-sharded, %d buckets) "
+                     "over %d replicas",
                      len(layouts),
                      sum(1 for l in layouts.values() if l.partitioned),
-                     len(ps_names), len(buckets), N)
+                     len(ps_names), len(zero_names), len(buckets), N)
         return DistributedStep(
             mesh=self._mesh, step_fn=step_fn, step_fn_nodonate=step_fn_nodonate,
             layouts=layouts, layout_tree=layout_tree, strategy=self._strategy,
             model_item=item, mesh_axis=axis, sync_state_init=sync_state_init,
             metadata=metadata, eval_fn=eval_fn, ps_store=ps_store,
             holed_params_template=holed_params,
-            fused_builder=fused_builder, forward_builder=forward_builder)
+            fused_builder=fused_builder, forward_builder=forward_builder,
+            zero_syncs=zero_syncs)

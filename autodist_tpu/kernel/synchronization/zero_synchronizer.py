@@ -1,0 +1,229 @@
+"""ZeRO-sharded weight-update kernel (arXiv 2004.13336, stage 1).
+
+Where the other synchronizer kernels contribute a gradient transform
+(``sync(grad, state) -> synced``), the sharded weight update owns the
+whole update path of its variable, so the lowering
+(``kernel/graph_transformer.py``) drives it through three in-graph
+phases instead:
+
+1. :meth:`reduce_scatter` — the full gradient flattens, pads to
+   ``n_data`` uniform flat shards, reduce-scatters over the data axis
+   (so each replica receives exactly the summed gradient of the shard it
+   owns), plain-psums over any extra mesh axes, and mean-normalizes.
+2. the lowering applies the optimizer to the owned shard only, against
+   the variable's per-replica optimizer-state shard (created sharded in
+   ``sync_state['zero']`` — never materialized whole) and the matching
+   :meth:`local_shard` slice of the replicated full param.
+3. :meth:`gather_update` — the shard's UPDATE (the optax delta, not the
+   param) all-gathers back; every replica applies the identical delta to
+   its replicated param copy, which therefore accumulates in full
+   precision and stays bit-identical across replicas.
+
+``wire_dtype="int8"`` swaps both crossings for the blockwise-quantized
+forms (``collectives.int8_block_reduce_scatter`` /
+``int8_block_all_gather``): the shard size rounds up to whole scale
+blocks so every shard's scales are self-contained, and gathering the
+*delta* (small magnitude, fine scale resolution) rather than the params
+keeps the lossy wire off the master weights.
+
+Wire accounting: rs + ag move the same ring bytes as one all-reduce
+(2(P-1)/P of the payload per link) — the cost model prices them with the
+same factor; the static per-step payload (:meth:`rs_payload_bytes` /
+:meth:`ag_payload_bytes`) feeds the ``zero.rs_bytes``/``zero.ag_bytes``
+telemetry counters so measured and predicted bytes share one formula.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu.parallel import collectives
+
+
+def zero_shard_elems(num_elements: int, n_data: int,
+                     wire_dtype: str = "fp32") -> int:
+    """Per-replica flat shard size: ceil split over the data axis,
+    rounded up to whole scale blocks on the int8 wire (so every shard's
+    scales are self-contained). The ONE shard-shape formula shared by
+    the kernel, the cost model's pricing, and the checkpoint re-layout."""
+    n_data = max(int(n_data), 1)
+    shard = -(-int(num_elements) // n_data)
+    if wire_dtype == "int8":
+        block = collectives.wire_block_size()
+        shard = -(-shard // block) * block
+    return int(shard)
+
+
+def zero_wire_payload_bytes(num_elements: int, n_data: int,
+                            wire_dtype: str = "fp32",
+                            itemsize: int = 4) -> float:
+    """Bytes ONE rs (or ag) crossing of a ZeRO-sharded variable ships:
+    the padded flat payload at full width, or the int8 body + f32 scale
+    sidecar over the per-shard-block-rounded padding. Shared by the
+    kernel's telemetry accounting and ``CostModel._wire_bytes`` so
+    predicted and measured bytes can only agree."""
+    padded = zero_shard_elems(num_elements, n_data, wire_dtype) \
+        * max(int(n_data), 1)
+    if wire_dtype == "int8":
+        q, _ = collectives.int8_wire_payload_bytes(padded, itemsize)
+        return float(q)
+    return float(padded) * 4.0
+
+
+def relayout_zero_sync_leaf(saved, old_axes, old_shape, data_axis, zs,
+                            tmpl_shape, tmpl_dtype):
+    """Re-lay one saved ``sync_state['zero']`` leaf (leading-device-axis
+    ``[N_old, ...]``) onto a NEW topology's template shape
+    ``[N_new, ...]``: concatenate the save-topology per-data-index shard
+    rows into the global flat value, re-pad to the new shard size, and
+    re-broadcast per new device row. Returns the new host array, or
+    ``None`` when the leaf is not re-layoutable (caller resets to fresh
+    init). Shared by the sharded checkpoint's cross-topology restore and
+    the in-run elastic snapshot adoption — one re-shard math, no drift.
+
+    ``zs`` is the NEW program's :class:`ZeroSynchronizer` for the
+    owning variable; ``old_axes``/``old_shape`` describe the SAVE-time
+    mesh."""
+    saved = np.asarray(saved)
+    tmpl_shape = tuple(tmpl_shape)
+    rest_old, rest_new = saved.shape[1:], tmpl_shape[1:]
+    if rest_old == () and rest_new == ():
+        # shared little-leaf (optimizer count): replica-identical
+        return np.broadcast_to(saved[0][None],
+                               tmpl_shape).astype(tmpl_dtype).copy()
+    if len(rest_old) != 1 or len(rest_new) != 1:
+        return None
+    if data_axis not in old_axes:
+        return None
+    p = list(old_axes).index(data_axis)
+    n_old = max(int(old_shape[p]), 1)
+    stride_old = int(np.prod(list(old_shape[p + 1:]) or [1]))
+    flat_old = np.concatenate(
+        [saved[i * stride_old] for i in range(n_old)])
+    flat_new = np.zeros(zs.n_data * zs.shard_elems, saved.dtype)
+    m = min(flat_old.shape[0], flat_new.shape[0])
+    flat_new[:m] = flat_old[:m]
+    blocks = flat_new.reshape(zs.n_data, zs.shard_elems)
+    out = np.empty(tmpl_shape, tmpl_dtype)
+    for r in range(tmpl_shape[0]):
+        out[r] = blocks[(r // zs.leading_stride) % zs.n_data]
+    return out
+
+
+class ZeroSynchronizer:
+    """Per-variable sharded-update kernel. Pure shape math is host-side
+    (shared by the lowering, the checkpoint re-shard, and the byte
+    accounting); the three phase methods trace into the step."""
+
+    def __init__(self, var_name: str, config, shape, dtype,
+                 mesh_axis: str, n_data: int, extra_axes: Tuple[str, ...],
+                 total_devices: int, leading_stride: int = 1):
+        self.var_name = var_name
+        self.shape = tuple(int(d) for d in shape)
+        self.dtype = np.dtype(dtype)
+        self.mesh_axis = mesh_axis
+        self.n_data = max(int(n_data), 1)
+        self.extra_axes = tuple(extra_axes)
+        self.total_devices = max(int(total_devices), 1)
+        # leading-axis stride of the data axis in the sync_state layout
+        # (P(all_axes) row-major over mesh axes): row i*stride holds data
+        # index i with every other axis at 0 — the host-side unshard and
+        # the cross-topology checkpoint re-shard both index with it
+        self.leading_stride = max(int(leading_stride), 1)
+        self.wire_dtype = getattr(config, "wire_dtype", "fp32") or "fp32"
+        self.num_elements = int(np.prod(self.shape or (1,)))
+        self.shard_elems = zero_shard_elems(self.num_elements, self.n_data,
+                                            self.wire_dtype)
+        self.padded_elems = self.shard_elems * self.n_data
+
+    # ------------------------------------------------------------ phases
+
+    def _pad_flat(self, arr):
+        flat = jnp.asarray(arr).astype(jnp.float32).reshape(-1)
+        return jnp.pad(flat, (0, self.padded_elems - self.num_elements))
+
+    def reduce_scatter(self, grad_full):
+        """Full gradient -> this replica's mean-normalized [shard_elems]
+        flat chunk (summed over the data axis via reduce-scatter, over
+        any extra axes via plain psum)."""
+        flat = self._pad_flat(grad_full)
+        if self.n_data > 1:
+            if self.wire_dtype == "int8":
+                local = collectives.int8_block_reduce_scatter(
+                    flat, self.mesh_axis, self.n_data)[:self.shard_elems]
+            else:
+                local = jax.lax.psum_scatter(
+                    flat, self.mesh_axis, scatter_dimension=0, tiled=True)
+        else:
+            local = flat
+        if self.extra_axes:
+            local = jax.lax.psum(local, self.extra_axes)
+        return local / self.total_devices
+
+    def local_shard(self, param_full):
+        """This replica's owned [shard_elems] flat slice of the
+        replicated full param (f32 — the little-tree optimizer apply
+        mirrors the full-precision master copy)."""
+        flat = self._pad_flat(param_full)
+        idx = (jax.lax.axis_index(self.mesh_axis) if self.n_data > 1
+               else jnp.int32(0))
+        return jax.lax.dynamic_slice(
+            flat, (idx * self.shard_elems,), (self.shard_elems,))
+
+    def gather_update(self, update_shard):
+        """Owned shard's update delta -> the full-shape delta every
+        replica applies (all-gathered; int8 wire dequantizes the SAME
+        bytes everywhere, so the applied delta is bit-identical)."""
+        upd = jnp.asarray(update_shard).astype(jnp.float32)
+        if self.n_data > 1:
+            if self.wire_dtype == "int8":
+                full = collectives.int8_block_all_gather(
+                    upd, self.mesh_axis, self.n_data)
+            else:
+                full = jax.lax.all_gather(upd, self.mesh_axis, axis=0,
+                                          tiled=True)
+        else:
+            full = upd
+        return (full[:self.num_elements]
+                .reshape(self.shape).astype(self.dtype))
+
+    # -------------------------------------------------- host-side helpers
+
+    def opt_state_init(self, optimizer):
+        """The per-replica optimizer-state shard template (a little
+        ``{"v": [shard_elems]}`` tree through ``optimizer.init``) —
+        host-side numpy leaves, broadcast by the lowering's
+        ``sync_state_init`` into the leading-device-axis layout."""
+        init = optimizer.init(
+            {"v": jnp.zeros((self.shard_elems,), self.dtype)})
+        return jax.tree_util.tree_map(np.asarray, init)
+
+    def unshard_host(self, leading_arr) -> np.ndarray:
+        """One gathered ``[N, ...]`` sync-state leaf -> the full
+        variable-shaped value (original-layout checkpoints): shard rows
+        concatenate in data-axis order; shared (count-like) leaves take
+        row 0."""
+        arr = np.asarray(leading_arr)
+        if arr.shape[1:] != (self.shard_elems,):
+            return arr[0]  # shared little-leaf (optimizer count, ...)
+        rows = [arr[i * self.leading_stride] for i in range(self.n_data)]
+        flat = np.concatenate(rows)[:self.num_elements]
+        return flat.reshape(self.shape)
+
+    # ------------------------------------------------------ byte accounting
+
+    def _wire_payload(self) -> float:
+        return zero_wire_payload_bytes(self.num_elements, self.n_data,
+                                       self.wire_dtype,
+                                       self.dtype.itemsize)
+
+    def rs_payload_bytes(self) -> float:
+        """Static per-step reduce-scatter payload bytes (int8 body +
+        scale sidecar on the quantized wire) — the zero.rs_bytes counter
+        and the cost model share this number."""
+        return self._wire_payload() if self.n_data > 1 else 0.0
+
+    def ag_payload_bytes(self) -> float:
+        """Static per-step update all-gather payload bytes."""
+        return self._wire_payload() if self.n_data > 1 else 0.0

@@ -331,6 +331,57 @@ def int8_block_all_reduce(x, axis_name: str, n: int, block: int = 0):
     return out.reshape(-1)[:L]
 
 
+def int8_block_reduce_scatter(x, axis_name: str, n: int, block: int = 0):
+    """Reduce-scatter a flat f32 vector over ``axis_name`` with a
+    blockwise int8 wire payload — phases 1+2 of the EQuARX two-phase
+    all-reduce (:func:`int8_block_all_reduce`), stopping before the
+    all-gather: each device blockwise-quantizes all ``n`` peer chunks,
+    ships them in ONE ``all_to_all`` (int8 body + f32 scale sidecar),
+    then dequant-accumulates its own chunk locally in f32 (accumulation
+    never overflows int8). Returns this device's summed chunk of
+    ``ceil-to-block(ceil(L/n))`` elements; chunk ``i`` lands on the
+    device at axis position ``i`` (matching ``lax.all_gather`` order).
+    This is the gradient wire of the ZeRO-sharded update
+    (``kernel/synchronization/zero_synchronizer.py``). Must run inside
+    shard_map with ``axis_name`` bound at size ``n``."""
+    block = block or wire_block_size()
+    L = x.shape[0]
+    chunk = -(-(-(-L // n)) // block) * block
+    nb = chunk // block
+    if n <= 1:
+        return jnp.pad(x.astype(jnp.float32), (0, chunk - L))
+    xp = jnp.pad(x.astype(jnp.float32),
+                 (0, n * chunk - L)).reshape(n, nb, block)
+    absmax = jnp.max(jnp.abs(xp), axis=2)
+    scale = jnp.where(jnp.isfinite(absmax),
+                      jnp.maximum(absmax, 1e-30), jnp.nan) / 127.0
+    safe = jnp.where(jnp.isfinite(scale), scale, 1.0)
+    q = jnp.clip(jnp.round(xp / safe[:, :, None]), -127, 127).astype(jnp.int8)
+    q = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+    s = jax.lax.all_to_all(scale.astype(jnp.float32), axis_name,
+                           split_axis=0, concat_axis=0)
+    acc = jnp.sum(q.astype(jnp.float32) * s[:, :, None], axis=0)  # [nb, block]
+    return acc.reshape(-1)
+
+
+def int8_block_all_gather(x, axis_name: str, n: int, block: int = 0):
+    """All-gather a flat f32 chunk over ``axis_name`` with a blockwise
+    int8 wire payload: quantize the local chunk once, all-gather body +
+    scales, and dequantize the SHARED bytes — every replica (including
+    the chunk's owner) reconstructs from the same int8 image, so the
+    result is bit-identical across replicas (the SPMD invariant). Pads
+    the chunk to a whole number of scale blocks; returns the
+    ``[n * padded_chunk]`` concatenation in axis order. This is the
+    update wire of the ZeRO-sharded weight update."""
+    block = block or wire_block_size()
+    if n <= 1:
+        return x.astype(jnp.float32)
+    q, s = quant_i8_block(x.astype(jnp.float32).reshape(-1), block)
+    qg = jax.lax.all_gather(q, axis_name, axis=0, tiled=True)   # [n*nb, block]
+    sg = jax.lax.all_gather(s, axis_name, axis=0, tiled=True)   # [n*nb]
+    return (qg.astype(jnp.float32) * sg[:, None]).reshape(-1)
+
+
 def int8_multi_axis_all_reduce(x, axes_sizes, block: int = 0):
     """Sum a flat f32 vector over MULTIPLE mesh axes with int8 wire
     payload: one two-phase quantized all-reduce per axis, sequentially —

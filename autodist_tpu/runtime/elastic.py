@@ -608,18 +608,83 @@ def snapshot_runner_state(runner) -> Optional[dict]:
                 "checkpoint", e)
             return None
     snapshot["step"] = int(np.asarray(_local_full_value(state.step)).ravel()[0])
+    # the snapshot-time mesh: per-device sync_state leaves (ZeRO opt
+    # shards, compressor residuals) are leading-device-axis arrays shaped
+    # by THIS topology — the post-reconfigure adopt needs it to re-lay
+    # the shards out onto the survivor mesh
+    snapshot["mesh"] = {"axes": list(dstep.mesh.axis_names),
+                        "shape": [int(dstep.mesh.shape[a])
+                                  for a in dstep.mesh.axis_names],
+                        "data_axis": dstep.mesh_axis}
     return snapshot
+
+
+def _align_sync_state(sync_host, saved_mesh, dstep):
+    """Align a snapshot's host sync_state to the REBUILT program's
+    template: same-shape leaves carry over verbatim, ZeRO-sharded
+    optimizer shards re-lay-out onto the new replica count (the same
+    math the sharded checkpoint's cross-topology restore uses —
+    shrinking a ZeroSharded job must not lose its adam moments), and
+    any other shape-mismatched per-device leaf (compressor residuals,
+    sentinel LR scale) resets to fresh init — topology-bound
+    transients, documented safe."""
+    import jax
+    from autodist_tpu.kernel.common import variable_utils
+    template = dstep._sync_state_init()
+    names, leaves, treedef = variable_utils.flatten_named(template)
+    have_names, have_leaves, _ = variable_utils.flatten_named(sync_host)
+    have = dict(zip(have_names, have_leaves))
+    zero_syncs = getattr(dstep, "zero_syncs", {}) or {}
+    saved_mesh = saved_mesh or {}
+    reset = []
+    out = []
+    for name, tmpl in zip(names, leaves):
+        tmpl_np = np.asarray(tmpl)
+        got = have.get(name)
+        if got is not None and np.shape(got) == tmpl_np.shape:
+            out.append(got)
+            continue
+        var = next((v for v in sorted(zero_syncs, key=len, reverse=True)
+                    if name == "zero/%s" % v
+                    or name.startswith("zero/%s/" % v)), None)
+        if got is not None and var is not None and saved_mesh:
+            from autodist_tpu.kernel.synchronization.zero_synchronizer \
+                import relayout_zero_sync_leaf
+            full = relayout_zero_sync_leaf(
+                got, saved_mesh.get("axes", []),
+                saved_mesh.get("shape", []),
+                saved_mesh.get("data_axis", dstep.mesh_axis),
+                zero_syncs[var], tmpl_np.shape, tmpl_np.dtype)
+            if full is not None:
+                out.append(full)
+                continue
+        out.append(tmpl)
+        if got is not None:
+            reset.append(name)
+    if reset:
+        logging.warning(
+            "elastic: %d per-device sync leaves reset to fresh init "
+            "across the topology change (topology-bound transients): %s",
+            len(reset), reset[:4])
+    return variable_utils.unflatten_named(treedef, out)
 
 
 def adopt_snapshot(runner, snapshot: dict):
     """Re-lay the in-memory snapshot out onto the runner's (rebuilt) mesh
     — the same placement path the checkpoint restore uses
-    (``Saver._restore_at``), minus the disk."""
+    (``Saver._restore_at``), minus the disk. Per-device sync_state
+    leaves align through :func:`_align_sync_state` (ZeRO optimizer
+    shards re-shard; residuals reset) — the snapshot was taken on the
+    PRE-reconfigure topology."""
     import jax
     from autodist_tpu.train_state import TrainState
     dstep = runner.distributed_step
+    sync_host = snapshot.get("sync_state")
+    if sync_host is not None:
+        sync_host = _align_sync_state(sync_host, snapshot.get("mesh"),
+                                      dstep)
     state = dstep.init_state(snapshot["params"], snapshot["opt_state"],
-                             snapshot.get("sync_state"))
+                             sync_host)
     step = snapshot.get("step") or 0
     state = TrainState(
         step=dstep._put(np.asarray(step, np.int32),

@@ -30,7 +30,7 @@ from typing import Dict, List, Optional, Tuple
 
 from autodist_tpu.strategy.base import (AllReduceSynchronizer, GraphConfig,
                                         PSSynchronizer, Strategy,
-                                        VarConfig)
+                                        VarConfig, ZeroShardedSynchronizer)
 from autodist_tpu.strategy.partitioned_ps_strategy import (
     make_partition_str, smallest_divisor_shards)
 from autodist_tpu.strategy.ps_lb_strategy import byte_size_load_fn, greedy_assign
@@ -59,12 +59,19 @@ WIRE_DTYPES = ("fp32", "int8")
 class VarChoice:
     """One variable's synchronization decision.
 
-    ``shards``/``axis`` describe ZeRO-style storage partitioning (the
-    ``partitioner`` string of the strategy IR); ``shards == 1`` means
-    unpartitioned. ``compressor`` only applies to unpartitioned dense
-    AllReduce wires; ``ps_proxy`` only to PS. ``wire_dtype`` ("fp32" |
-    "int8") selects the blockwise-quantized collective/PS wire — dense
-    float variables of at least one scale block, mutually exclusive with
+    ``shards``/``axis`` describe partitioned storage (the ``partitioner``
+    string of the strategy IR — params sharded, gathered per step);
+    ``shards == 1`` means unpartitioned. ``zero`` selects the
+    ZeRO-sharded weight update instead (``ZeroShardedSynchronizer``):
+    params stay replicated, the gradient reduce-scatters, the optimizer
+    applies on the owned 1/P shard (opt state created sharded) and the
+    update all-gathers — the memory/speed trade axis for dense variables
+    of at least one element per replica (ADT312/313 by construction);
+    mutually exclusive with ``shards > 1``, PS, and ``compressor``.
+    ``compressor`` only applies to unpartitioned dense AllReduce wires;
+    ``ps_proxy`` only to PS. ``wire_dtype`` ("fp32" | "int8") selects
+    the blockwise-quantized collective/PS/zero wire — dense float
+    variables of at least one scale block, mutually exclusive with
     ``compressor`` (canon resolves conflicts compressor-first)."""
     sync: str = "AllReduce"               # "AllReduce" | "PS"
     compressor: str = "NoneCompressor"
@@ -72,6 +79,7 @@ class VarChoice:
     axis: int = 0
     ps_proxy: bool = False
     wire_dtype: str = "fp32"
+    zero: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,6 +110,7 @@ class PlanSpec:
                    if c.compressor != "NoneCompressor")
         sharded = sum(1 for _, c in self.choices if c.shards > 1)
         wired = sum(1 for _, c in self.choices if c.wire_dtype == "int8")
+        zeroed = sum(1 for _, c in self.choices if c.zero)
         bits = ["ar=%d" % ar, "ps=%d" % ps]
         if comp:
             bits.append("comp=%d" % comp)
@@ -109,6 +118,8 @@ class PlanSpec:
             bits.append("int8w=%d" % wired)
         if sharded:
             bits.append("sharded=%d" % sharded)
+        if zeroed:
+            bits.append("zero=%d" % zeroed)
         bits.append("chunk=%d" % self.chunk_size)
         if self.staleness:
             bits.append("stale=%d" % self.staleness)
@@ -151,6 +162,13 @@ class PlanSpace:
             for n in self.var_names}
         self.compressor_options: Dict[str, Tuple[str, ...]] = {}
         self.wire_options: Dict[str, Tuple[str, ...]] = {}
+        # ZeRO-sharded update eligibility (the builder's gate, shared so
+        # ADT312/313 are excluded from the space by construction)
+        from autodist_tpu.strategy.zero_sharded_strategy import (
+            zero_shardable)
+        self.zero_ok: Dict[str, bool] = {
+            n: zero_shardable(self.infos[n], self.n_replicas)
+            for n in self.var_names}
         from autodist_tpu.parallel.collectives import wire_quantizable
         for n in self.var_names:
             info = self.infos[n]
@@ -183,14 +201,22 @@ class PlanSpace:
             # ADT309: a partitioned reduce-scatter densifies the
             # row-sparse gradient to the full table every step
             shards, axis = 1, 0
+        # ZeRO-sharded update: AllReduce family only, no partitioner on
+        # top (ADT312), dense vars of >= one element per replica
+        # (ADT313) — the same gate the ZeroSharded builder applies
+        zero = (bool(choice.zero) and sync == "AllReduce"
+                and shards <= 1 and self.zero_ok[name])
         compressor = choice.compressor
-        if (sync != "AllReduce" or shards > 1
+        if (sync != "AllReduce" or shards > 1 or zero
                 or compressor not in self.compressor_options[name]):
+            # the sharded update owns the payload end to end — a gradient
+            # compressor cannot ride it (mirror of the partitioned path)
             compressor = "NoneCompressor"
         proxy = bool(choice.ps_proxy) if sync == "PS" else False
         # wire codec: dense float >= one block only (ADT310/311), never on
         # the AR reduce-scatter path (shards > 1), never on a proxied PS
-        # var (no host wire), and compressor-first on conflicts
+        # var (no host wire), and compressor-first on conflicts; the
+        # ZeroSharded rs/ag wire quantizes like the PS wire
         wire = choice.wire_dtype if choice.wire_dtype in WIRE_DTYPES else "fp32"
         if wire == "int8":
             if ("int8" not in self.wire_options[name]
@@ -198,15 +224,30 @@ class PlanSpace:
                     or (sync == "AllReduce" and shards > 1)
                     or (sync == "PS" and proxy)):
                 wire = "fp32"
+        if wire == "int8" and zero:
+            # the zero kernel rounds each shard to whole scale blocks:
+            # below P x block elements the padded int8 wire is WORSE
+            # than fp32 (and the cost model prices the padded truth)
+            from autodist_tpu.strategy.zero_sharded_strategy import (
+                zero_wire_quantizable)
+            if not zero_wire_quantizable(info, self.n_replicas):
+                wire = "fp32"
         return VarChoice(sync=sync, compressor=compressor, shards=shards,
-                         axis=axis, ps_proxy=proxy, wire_dtype=wire)
+                         axis=axis, ps_proxy=proxy, wire_dtype=wire,
+                         zero=zero)
 
     def make_plan(self, choices: Dict[str, VarChoice], chunk_size: int = 128,
                   staleness: int = 0, remat: Optional[str] = None) -> PlanSpec:
-        return PlanSpec(
-            choices=tuple((n, self.canon(choices.get(n, VarChoice()), n))
-                          for n in self.var_names),
-            chunk_size=chunk_size, staleness=staleness, remat=remat)
+        canon = tuple((n, self.canon(choices.get(n, VarChoice()), n))
+                      for n in self.var_names)
+        if any(c.zero for _, c in canon):
+            # ADT312 by construction: the ZeRO rs+ag pair is lockstep
+            # every step, so a staleness window cannot coexist — drop it
+            # in the SPEC (not just at materialization) so describe(),
+            # dedup, and the built strategy all agree
+            staleness = 0
+        return PlanSpec(choices=canon, chunk_size=chunk_size,
+                        staleness=staleness, remat=remat)
 
     # ---------------------------------------------------------------- seeds
 
@@ -237,12 +278,18 @@ class PlanSpace:
             k = smallest_divisor_shards(dim0, cap) if dim0 > 1 else 1
             part_ps[n] = (VarChoice(sync="PS", shards=k, axis=0)
                           if k > 1 else VarChoice(sync="PS"))
-        zero = {}
+        part_ar = {}
         for n in self.var_names:
             dim0 = self.infos[n].shape[0] if self.infos[n].shape else 0
             k = (smallest_divisor_shards(dim0, self.n_replicas)
                  if dim0 > 1 and not self.infos[n].sparse else 1)
-            zero[n] = (VarChoice(shards=k, axis=0) if k > 1 else VarChoice())
+            part_ar[n] = (VarChoice(shards=k, axis=0) if k > 1
+                          else VarChoice())
+        # the ZeRO-sharded update families: canon strips ineligible vars
+        # (sparse, sub-replica-sized) back to plain AllReduce
+        zero = {n: VarChoice(zero=True) for n in self.var_names}
+        zero_int8 = {n: VarChoice(zero=True, wire_dtype="int8")
+                     for n in self.var_names}
         def wired(base=None, sync="AllReduce"):
             """``base`` (or all-``sync``) with the int8 wire on every
             variable whose sub-space allows it (canon strips the rest) —
@@ -269,7 +316,9 @@ class PlanSpace:
                 compressed("HorovodCompressor", base=sparse_ps))),
             ("seed:parallax-int8w", self.make_plan(wired(base=sparse_ps))),
             ("seed:part-ps", self.make_plan(part_ps)),
+            ("seed:part-ar", self.make_plan(part_ar)),
             ("seed:zero", self.make_plan(zero)),
+            ("seed:zero-int8w", self.make_plan(zero_int8)),
             ("seed:ar-remat", self.make_plan(ar, chunk_size=512,
                                              remat="dots")),
         ]
@@ -297,6 +346,16 @@ class PlanSpace:
             first = syncs[0]
             shards = node.num_shards if node.partitioner else 1
             axis = (node.partition_axis or 0) if node.partitioner else 0
+            if isinstance(first, ZeroShardedSynchronizer):
+                if node.partitioner:
+                    return None  # ADT312 combination: outside the space
+                choice = VarChoice(zero=True,
+                                   wire_dtype=first.wire_dtype or "fp32")
+                canon = self.canon(choice, name)
+                if not canon.zero:
+                    return None  # ineligible var: not expressible here
+                choices[name] = canon
+                continue
             if isinstance(first, AllReduceSynchronizer):
                 comp = first.compressor or "NoneCompressor"
                 wire = first.wire_dtype or "fp32"
@@ -382,6 +441,28 @@ class PlanSpace:
                         "wire[%s]=%s" % (n, target))
             ops.append(set_wire_dtype)
 
+        zero_vars = [n for n in names if self.zero_ok[n]]
+        if zero_vars:
+            def set_zero():
+                n = zero_vars[rng.randrange(len(zero_vars))]
+                target = not cm[n].zero
+                # arming the sharded update clears partitioning, the
+                # compressor, AND any plan-level staleness window
+                # (ADT312; canon would strip zero otherwise — the
+                # operator states its intent, mirroring set_wire)
+                new = self.canon(dataclasses.replace(
+                    cm[n], zero=target,
+                    sync="AllReduce" if target else cm[n].sync,
+                    shards=1 if target else cm[n].shards,
+                    axis=0 if target else cm[n].axis,
+                    compressor=("NoneCompressor" if target
+                                else cm[n].compressor)), n)
+                out = plan.replace_choice(n, new)
+                if new.zero and out.staleness:
+                    out = dataclasses.replace(out, staleness=0)
+                return out, "zero[%s]=%s" % (n, target)
+            ops.append(set_zero)
+
         ps_vars = [n for n in names if cm[n].sync == "PS"]
         if ps_vars:
             def toggle_proxy():
@@ -417,7 +498,9 @@ class PlanSpace:
 
         host_ps = [n for n in names
                    if cm[n].sync == "PS" and not cm[n].ps_proxy]
-        if host_ps:
+        # the staleness window is a lockstep conflict with the ZeRO
+        # rs+ag pair (ADT312): not offered while any zero var is armed
+        if host_ps and not any(cm[n].zero for n in names):
             def set_staleness():
                 opts = [s for s in STALENESS_CHOICES if s != plan.staleness]
                 s = opts[rng.randrange(len(opts))]
@@ -453,6 +536,13 @@ class PlanSpace:
                     if cm[n].sync == "PS" and cm[n].shards <= 1]
         assignment = greedy_assign(ps_infos, self.destinations,
                                    byte_size_load_fn)
+        # validity by construction (ADT312): the ZeRO-sharded rs+ag pair
+        # is lockstep every step, so a plan mixing zero vars with a
+        # staleness window materializes with the window dropped — the
+        # per-var choices stay free to mutate independently of the
+        # plan-level knob
+        plan_staleness = (0 if any(c.zero for c in cm.values())
+                          else plan.staleness)
         nodes: List[VarConfig] = []
         ar_index = 0   # bucket index over AllReduce-synced vars
         rr = 0         # round-robin pointer for partitioned-PS shards
@@ -460,6 +550,12 @@ class PlanSpace:
             c = cm[name]
             info = self.infos[name]
             rank = len(info.shape)
+            if c.zero:
+                nodes.append(VarConfig(
+                    var_name=name,
+                    synchronizer=ZeroShardedSynchronizer(
+                        wire_dtype=c.wire_dtype)))
+                continue
             if c.sync == "AllReduce":
                 group = ar_index // max(plan.chunk_size, 1)
                 ar_index += 1
@@ -480,7 +576,7 @@ class PlanSpace:
                             compressor=c.compressor, group=group,
                             wire_dtype=c.wire_dtype)))
                 continue
-            staleness = 0 if c.ps_proxy else plan.staleness
+            staleness = 0 if c.ps_proxy else plan_staleness
             if c.shards > 1:
                 parts = []
                 for i in range(c.shards):

@@ -16,7 +16,7 @@ import dataclasses
 from typing import Dict, Optional
 
 from autodist_tpu.strategy.base import (AllReduceSynchronizer, PSSynchronizer,
-                                        Strategy)
+                                        Strategy, ZeroShardedSynchronizer)
 from autodist_tpu.utils import logging
 
 # Peak dense bf16 FLOP/s per chip by generation (public figures).
@@ -456,9 +456,13 @@ class CostModel:
         params + optimizer state + one gradient buffer + activations.
         Host-PS (no proxy) offloads optimizer state (values are still
         pulled to device each step); partitioned storage divides by the
-        replica count (ZeRO); ``graph_config.remat`` shrinks the
-        activation term ("dots": contraction outputs only; "full":
-        batch residuals plus the peak recompute window)."""
+        replica count (ZeRO-3-style); ZeroSharded sync keeps params full
+        but divides the optimizer-state share by the replica count (the
+        ~(P-1)/P drop the ADT501 gate must project, or sharded plans
+        would be refused the memory they just freed);
+        ``graph_config.remat`` shrinks the activation term ("dots":
+        contraction outputs only; "full": batch residuals plus the peak
+        recompute window)."""
         infos = self._item.var_infos
         n = max(len(strategy.graph_config.replicas), 1)
         opt_total = self.opt_state_bytes()
@@ -475,6 +479,8 @@ class CostModel:
                      [p.synchronizer for p in node.part_configs])
             host_ps = any(isinstance(s, PSSynchronizer)
                           and not s.local_replication for s in syncs)
+            zero = any(isinstance(s, ZeroShardedSynchronizer)
+                       for s in syncs)
             share = (1.0 / n) if node.partitioner and not host_ps else 1.0
             if node.mp_axes:
                 # model-parallel storage: each device holds 1/extent of
@@ -485,6 +491,12 @@ class CostModel:
                 # pulled copy lives on device during the step, but the
                 # optimizer state does not
                 device_params += info.byte_size
+            elif zero:
+                # ZeRO-sharded update: params (and the gradient buffer)
+                # stay full, but optimizer state is created sharded —
+                # each chip holds 1/P of this variable's opt-state share
+                device_params += info.byte_size
+                device_param_fraction_num += info.byte_size / n
             else:
                 device_params += info.byte_size * share
                 device_param_fraction_num += info.byte_size * share
@@ -538,7 +550,9 @@ class CostModel:
             # path the host wire quantizes regardless of partitioning
             # (shards split host-side after dequant); on AllReduce only
             # the unpartitioned collective honors it (the reduce-scatter
-            # path ignores wire codecs — ADT310 warns). Callers pass
+            # path ignores wire codecs — ADT310 warns). The ZeroSharded
+            # rs/ag pair is priced separately in :meth:`estimate`
+            # through the kernel's padded formula. Callers pass
             # ``wire_ok=False`` on paths the runtime never quantizes
             # (proxied PS, model-parallel complement reductions) so a
             # mispinned plan is not priced 4x cheaper than it runs.
@@ -594,6 +608,7 @@ class CostModel:
         ps_load: Dict[str, float] = {}
         groups = set()
         num_ps_transfers = 0
+        num_zero_colls = 0
         mesh_cfg = strategy.graph_config.mesh_shape or {}
         for node in strategy.node_config:
             info = infos.get(node.var_name)
@@ -615,7 +630,25 @@ class CostModel:
                 mp_extent *= e
             complement = max(n // mp_extent, 1)
             for sync in syncs:
-                if isinstance(sync, AllReduceSynchronizer):
+                if isinstance(sync, ZeroShardedSynchronizer):
+                    # rs + ag move the same ring bytes as one all-reduce
+                    # (2(n-1)/n of the payload per link — the factor
+                    # applied to ar_bytes below), at lower HBM: the
+                    # memory side is priced in hbm_bytes. Two extra
+                    # collective launches per variable (no bucketing).
+                    # Payload priced through the kernel's own padded
+                    # formula (per-shard block rounding on the int8
+                    # wire) so predicted and telemetry bytes agree.
+                    from autodist_tpu.kernel.synchronization.\
+                        zero_synchronizer import zero_wire_payload_bytes
+                    from autodist_tpu.parallel.collectives import (
+                        wire_quantizable)
+                    wd = (sync.wire_dtype or "fp32"
+                          if wire_quantizable(info) else "fp32")
+                    ar_bytes += zero_wire_payload_bytes(
+                        info.num_elements, n, wd) / max(len(syncs), 1)
+                    num_zero_colls += 2
+                elif isinstance(sync, AllReduceSynchronizer):
                     if node.mp_axes and complement == 1:
                         continue  # whole mesh is model axes: no grad sync
                     ar_bytes += mp_share * self._wire_bytes(
@@ -665,7 +698,8 @@ class CostModel:
                   if ps_load else 0.0)
         ps_s = pcie_s + (ps_bytes * 2.0 * (n - 1) / n / dcn_bw
                          if (n > 1 and not single) else 0.0)
-        latency_s = PER_COLLECTIVE_LATENCY_S * (len(groups) + num_ps_transfers)
+        latency_s = PER_COLLECTIVE_LATENCY_S * (len(groups) + num_ps_transfers
+                                                + num_zero_colls)
         remat_factor = REMAT_COMPUTE_FACTOR.get(
             strategy.graph_config.remat, 1.0)
         compute_s = self.compute_time(n) * remat_factor

@@ -1,7 +1,8 @@
 """Strategy builders (reference ``autodist/strategy/``)."""
 from autodist_tpu.strategy.base import (AllReduceSynchronizer, GraphConfig,
                                         PSSynchronizer, Strategy, StrategyBuilder,
-                                        StrategyCompiler, VarConfig)
+                                        StrategyCompiler, VarConfig,
+                                        ZeroShardedSynchronizer)
 from autodist_tpu.strategy.ps_strategy import PS
 from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing
 from autodist_tpu.strategy.partitioned_ps_strategy import PartitionedPS
@@ -15,12 +16,14 @@ from autodist_tpu.strategy.sequence_parallel_strategy import SequenceParallelAR
 from autodist_tpu.strategy.tensor_parallel_strategy import TensorParallel
 from autodist_tpu.strategy.pipeline_parallel_strategy import PipelineParallel
 from autodist_tpu.strategy.expert_parallel_strategy import ExpertParallel
+from autodist_tpu.strategy.zero_sharded_strategy import ZeroSharded
 from autodist_tpu.strategy.auto_strategy import AutoStrategy
 from autodist_tpu.strategy.remat import WithRemat
 
 __all__ = ["Strategy", "StrategyBuilder", "StrategyCompiler", "VarConfig",
            "GraphConfig", "PSSynchronizer", "AllReduceSynchronizer",
+           "ZeroShardedSynchronizer",
            "PS", "PSLoadBalancing", "PartitionedPS", "UnevenPartitionedPS",
            "AllReduce", "PartitionedAR", "RandomAxisPartitionAR", "Parallax",
            "SequenceParallelAR", "TensorParallel", "PipelineParallel",
-           "ExpertParallel", "AutoStrategy", "WithRemat"]
+           "ExpertParallel", "ZeroSharded", "AutoStrategy", "WithRemat"]

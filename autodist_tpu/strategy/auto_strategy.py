@@ -26,6 +26,7 @@ def default_candidates() -> List[Tuple[str, StrategyBuilder]]:
     from autodist_tpu.strategy.ps_lb_strategy import PSLoadBalancing
     from autodist_tpu.strategy.ps_strategy import PS
     from autodist_tpu.strategy.remat import WithRemat
+    from autodist_tpu.strategy.zero_sharded_strategy import ZeroSharded
     return [
         # host-resident PS (no proxy: 1/HBM in exchange for PCIe per step)
         ("PS", PS()),
@@ -42,6 +43,12 @@ def default_candidates() -> List[Tuple[str, StrategyBuilder]]:
         # rank-2 PowerSGD: 10-100x wire compression for DCN-bound clusters
         ("AllReduce/psgd2", AllReduce(compressor="PowerSGDCompressor:2")),
         ("PartitionedAR", PartitionedAR()),
+        # ZeRO-style sharded weight update: same wire as AllReduce, but
+        # optimizer state is stored 1/P per chip — ranks behind plain AR
+        # on launch latency, ahead on the HBM feasibility gate whenever
+        # optimizer state is what does not fit
+        ("ZeroSharded", ZeroSharded()),
+        ("ZeroSharded/int8", ZeroSharded(wire_dtype="int8")),
         ("Parallax", Parallax()),
         ("Parallax/bf16", Parallax(compressor="HorovodCompressor")),
         ("Parallax/int8", Parallax(compressor="Int8CompressorEF")),

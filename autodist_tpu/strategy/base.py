@@ -97,10 +97,41 @@ class AllReduceSynchronizer:
                 "wire_dtype": self.wire_dtype}
 
 
-Synchronizer = Union[PSSynchronizer, AllReduceSynchronizer]
+@dataclasses.dataclass
+class ZeroShardedSynchronizer:
+    """ZeRO-style sharded weight update (arXiv 2004.13336, stage 1).
+
+    Params stay stored FULL (replicated) — the forward pass never pays a
+    gather — but the gradient is reduce-scattered across the data axis,
+    each replica applies the optimizer update to its owned 1/P flat shard
+    only (optimizer state is *created* sharded, never materialized
+    whole), and the updated shard's delta is all-gathered back onto the
+    replicated params. Wire bytes equal an all-reduce (rs + ag = the same
+    2(P-1)/P ring factor); per-chip optimizer-state footprint drops by
+    ~(P-1)/P.
+
+    ``wire_dtype`` ("fp32" | "int8") quantizes both wire crossings
+    through the blockwise codec (``parallel/collectives.py``): the
+    reduce-scatter payload ships int8 + f32 scales (local accumulation
+    stays f32) and the all-gathered UPDATE ships the same way — the
+    delta, not the params, so replicated param copies accumulate in full
+    precision and stay bit-identical across replicas. Dense float
+    variables of at least one scale block only (the linter's
+    ADT310/311); sparse / model-parallel / partitioned variables cannot
+    zero-shard at all (ADT312)."""
+    wire_dtype: str = "fp32"
+
+    kind = "ZeroSharded"
+
+    def to_dict(self):
+        return {"kind": self.kind, "wire_dtype": self.wire_dtype}
 
 
-SYNCHRONIZER_KINDS = ("PS", "AllReduce")
+Synchronizer = Union[PSSynchronizer, AllReduceSynchronizer,
+                     ZeroShardedSynchronizer]
+
+
+SYNCHRONIZER_KINDS = ("PS", "AllReduce", "ZeroSharded")
 
 
 def synchronizer_from_dict(d: dict, var_name: str = "") -> Synchronizer:
@@ -113,14 +144,16 @@ def synchronizer_from_dict(d: dict, var_name: str = "") -> Synchronizer:
     """
     d = dict(d)
     kind = d.pop("kind", None)
-    ctor = {"PS": PSSynchronizer, "AllReduce": AllReduceSynchronizer}.get(kind)
+    ctor = {"PS": PSSynchronizer, "AllReduce": AllReduceSynchronizer,
+            "ZeroSharded": ZeroShardedSynchronizer}.get(kind)
     if ctor is None:
         raise DiagnosticError(error(
             "ADT301",
             "unknown synchronizer kind %r (allowed kinds: %s)"
             % (kind, ", ".join(SYNCHRONIZER_KINDS)), var=var_name,
-            fixit="serialize synchronizers through "
-                  "PSSynchronizer/AllReduceSynchronizer.to_dict()"))
+            fixit="serialize synchronizers through PSSynchronizer/"
+                  "AllReduceSynchronizer/ZeroShardedSynchronizer"
+                  ".to_dict()"))
     try:
         return ctor(**d)
     except TypeError as e:

@@ -124,6 +124,7 @@ DEFAULT_COUNTERS = (
     "ps.bytes_pulled", "ps.bytes_pushed", "ps.degraded_pulls",
     "ps.dropped_pushes", "ps_service.applied", "ps_service.published",
     "wire.bytes_quantized", "wire.bytes_saved",
+    "zero.rs_bytes", "zero.ag_bytes",
     "coord.retries", "coord.reconnects", "coord.breaker_opens",
     "coord.backoff_s",
     "prefetch.batches", "prefetch.dropped_batches",

@@ -235,6 +235,8 @@ def bench_model(label, pairs=8, iters=4, deadline=None, batch_size=None):
                                       iters)
     wire_extra = _wire_dtype_phases(loss_fn, opt, params, batch_np,
                                     run_fw, iters)
+    zero_extra = _zero_phases(loss_fn, opt, params, batch_np, run_fw,
+                              iters)
     adt.reset()
     search_extra = _search_phases(loss_fn, opt, params, batch_np, iters,
                                   fw_rates, deadline)
@@ -258,8 +260,68 @@ def bench_model(label, pairs=8, iters=4, deadline=None, batch_size=None):
     }
     out.update(fused_extra)
     out.update(wire_extra)
+    out.update(zero_extra)
     out.update(search_extra)
     return out
+
+
+def _paired_strategy_phases(builder, loss_fn, opt, params, batch_np,
+                            run_fw, iters, steps, tol, leg):
+    """Shared mechanics of the opt-in paired strategy harnesses
+    (`_wire_dtype_phases`, `_zero_phases`): build the SAME model under
+    ``builder``, train a short accuracy leg, snapshot the telemetry
+    counters, train a FRESH fp32 `AllReduce()` reference from identical
+    params on the identical batch (the main `run_fw` runner has already
+    trained through warmup/probe/pair phases — comparing against it
+    would measure training progress, not the variant's error), assert
+    final-loss parity within ``tol``, then run order-alternated paired
+    throughput phases against the main framework path. Returns
+    ``(variant_losses, ref_losses, median_ratio, counters,
+    variant_runner)`` — callers add their leg-specific assertions."""
+    import autodist_tpu as adt
+    from autodist_tpu import strategy
+    from autodist_tpu.telemetry import spans as tel
+    adt.reset()
+    ad = adt.AutoDist(strategy_builder=builder)
+    vrunner = ad.build(loss_fn, opt, params, batch_np)
+    vrunner.init(params)
+    vsharded = vrunner.remapper.remap_feed(batch_np)
+    vbox = [vrunner.state]
+
+    def run_v():
+        st, m = vrunner.distributed_step(vbox[0], vsharded)
+        vbox[0] = st
+        return m["loss"]
+
+    v_losses = [_sync(run_v()) for _ in range(steps)]
+    counters = dict(tel.counters())
+    adt.reset()
+    ad_fp = adt.AutoDist(strategy_builder=strategy.AllReduce())
+    frunner = ad_fp.build(loss_fn, opt, params, batch_np)
+    frunner.init(params)
+    fsharded = frunner.remapper.remap_feed(batch_np)
+    fbox = [frunner.state]
+    f_losses = []
+    for _ in range(steps):
+        st, m = frunner.distributed_step(fbox[0], fsharded)
+        fbox[0] = st
+        f_losses.append(_sync(m["loss"]))
+    final_gap = abs(v_losses[-1] - f_losses[-1]) / max(
+        abs(f_losses[-1]), 1e-9)
+    assert final_gap <= tol, (
+        "%s broke loss parity: %.6g vs fp32 %.6g (gap %.3f > tol %.3f)"
+        % (leg, v_losses[-1], f_losses[-1], final_gap, tol))
+    ratios = []
+    for j in range(4):
+        if j % 2 == 0:
+            rv = _phase_rate(run_v, iters)
+            rf = _phase_rate(run_fw, iters)
+        else:
+            rf = _phase_rate(run_fw, iters)
+            rv = _phase_rate(run_v, iters)
+        ratios.append(rv / rf)
+    return (v_losses, f_losses, statistics.median(ratios), counters,
+            vrunner)
 
 
 def _wire_dtype_phases(loss_fn, opt, params, batch_np, run_fw, iters):
@@ -276,76 +338,69 @@ def _wire_dtype_phases(loss_fn, opt, params, batch_np, run_fw, iters):
     mode = (os.environ.get("ADT_BENCH_WIRE_DTYPE", "") or "").strip()
     if mode not in ("int8", "1"):
         return {}
-    import jax
-    import autodist_tpu as adt
     from autodist_tpu import strategy
-    from autodist_tpu.telemetry import spans as tel
     tol = float(os.environ.get("ADT_BENCH_WIRE_TOL", "0.1"))
     steps = int(os.environ.get("ADT_BENCH_WIRE_STEPS", "8"))
     try:
-        adt.reset()
-        ad = adt.AutoDist(strategy_builder=strategy.AllReduce(
-            wire_dtype="int8"))
-        qrunner = ad.build(loss_fn, opt, params, batch_np)
-        qrunner.init(params)
-        qsharded = qrunner.remapper.remap_feed(batch_np)
-        qbox = [qrunner.state]
-
-        def run_q():
-            st, m = qrunner.distributed_step(qbox[0], qsharded)
-            qbox[0] = st
-            return m["loss"]
-
-        # paired accuracy leg: N steps each from the IDENTICAL init on
-        # the identical batch. The fp32 reference is a FRESH runner (the
-        # main `run_fw` runner has already trained through warmup/probe/
-        # pair phases — comparing against it would measure training
-        # progress, not quantization error).
-        q_losses = [_sync(run_q()) for _ in range(steps)]
-        counters = dict(tel.counters())
+        q_losses, f_losses, ratio, counters, _ = _paired_strategy_phases(
+            strategy.AllReduce(wire_dtype="int8"), loss_fn, opt, params,
+            batch_np, run_fw, iters, steps, tol, "quantized wire")
         quantized = counters.get("wire.bytes_quantized", 0.0)
         saved = counters.get("wire.bytes_saved", 0.0)
         assert quantized > 0 and saved > 0, counters
         reduction = (quantized + saved) / quantized
-        adt.reset()
-        ad_fp = adt.AutoDist(strategy_builder=strategy.AllReduce())
-        frunner = ad_fp.build(loss_fn, opt, params, batch_np)
-        frunner.init(params)
-        fsharded = frunner.remapper.remap_feed(batch_np)
-        fbox = [frunner.state]
-        f_losses = []
-        for _ in range(steps):
-            st, m = frunner.distributed_step(fbox[0], fsharded)
-            fbox[0] = st
-            f_losses.append(_sync(m["loss"]))
-        final_gap = abs(q_losses[-1] - f_losses[-1]) / max(
-            abs(f_losses[-1]), 1e-9)
-        assert final_gap <= tol, (
-            "quantized wire broke loss parity: int8 %.6g vs fp32 %.6g "
-            "(gap %.3f > tol %.3f)"
-            % (q_losses[-1], f_losses[-1], final_gap, tol))
-        # throughput: order-alternated paired phases, quantized vs fp32
-        ratios = []
-        for j in range(4):
-            if j % 2 == 0:
-                rq = _phase_rate(run_q, iters)
-                rf = _phase_rate(run_fw, iters)
-            else:
-                rf = _phase_rate(run_fw, iters)
-                rq = _phase_rate(run_q, iters)
-            ratios.append(rq / rf)
         return {"wire_dtype": "int8",
                 "wire_reduction_x": round(reduction, 3),
                 "wire_bytes_quantized": quantized,
                 "wire_bytes_saved": saved,
                 "wire_loss_final": [round(q_losses[-1], 6),
                                     round(f_losses[-1], 6)],
-                "wire_vs_fp32": round(statistics.median(ratios), 4)}
+                "wire_vs_fp32": round(ratio, 4)}
     except Exception as e:  # noqa: BLE001 — opt-in extra, never fatal
         print("  wire-dtype phases failed: %s" % e, file=sys.stderr,
               flush=True)
         return {"wire_dtype": "int8",
                 "wire_error": "%s: %s" % (type(e).__name__, str(e)[:160])}
+
+
+def _zero_phases(loss_fn, opt, params, batch_np, run_fw, iters):
+    """Opt-in (ADT_BENCH_ZERO=1) ZeRO-sharded-update harness for the
+    artifact rounds: builds the SAME model under ``ZeroSharded()``,
+    trains a short paired leg from identical params on identical batches
+    and ASSERTS loss parity with the fp32 AllReduce path (the fp32
+    sharded update is exact modulo float reassociation — tolerance
+    ADT_BENCH_ZERO_TOL, default 2%), checks the projected per-chip
+    opt-state saving is positive (zero.hbm_saved_bytes — the number the
+    ADT501 gate stops charging), and runs order-alternated paired
+    throughput phases against the plain AllReduce framework path (rs+ag
+    move the same ring bytes, so the ratio isolates launch overhead).
+    Best-effort: a failure is recorded, never fatal."""
+    if (os.environ.get("ADT_BENCH_ZERO", "") or "").strip() not in ("1",):
+        return {}
+    from autodist_tpu import strategy
+    tol = float(os.environ.get("ADT_BENCH_ZERO_TOL", "0.02"))
+    steps = int(os.environ.get("ADT_BENCH_ZERO_STEPS", "8"))
+    try:
+        z_losses, f_losses, ratio, counters, zrunner = \
+            _paired_strategy_phases(
+                strategy.ZeroSharded(), loss_fn, opt, params, batch_np,
+                run_fw, iters, steps, tol, "sharded update")
+        meta = zrunner.distributed_step.metadata
+        saved = float(meta.get("zero_hbm_saved_bytes", 0.0))
+        assert meta.get("zero_sharded"), "no variable took the zero path"
+        assert saved > 0, "zero leg projects no opt-state HBM saving"
+        assert counters.get("zero.rs_bytes", 0.0) > 0, counters
+        assert counters.get("zero.ag_bytes", 0.0) > 0, counters
+        return {"zero_sharded_vars": len(meta["zero_sharded"]),
+                "zero_hbm_saved_bytes": saved,
+                "zero_rs_bytes": counters.get("zero.rs_bytes", 0.0),
+                "zero_ag_bytes": counters.get("zero.ag_bytes", 0.0),
+                "zero_loss_final": [round(z_losses[-1], 6),
+                                    round(f_losses[-1], 6)],
+                "zero_vs_allreduce": round(ratio, 4)}
+    except Exception as e:  # noqa: BLE001 — opt-in extra, never fatal
+        print("  zero phases failed: %s" % e, file=sys.stderr, flush=True)
+        return {"zero_error": "%s: %s" % (type(e).__name__, str(e)[:160])}
 
 
 def _maybe_fused_phases(runner, state_box, sharded, run_fw, iters):
@@ -546,6 +601,7 @@ def smoke_main(fused: bool = False):
     sentinel_result = _smoke_sentinel(loss_fn, params, batches,
                                       len(batches))
     quantized_result = _smoke_quantized_wire(loss_fn, params, batches)
+    zero_result = _smoke_zero(loss_fn, params, batches)
 
     t0 = time.perf_counter()
     r1 = build()
@@ -582,6 +638,7 @@ def smoke_main(fused: bool = False):
                       stats=fused_stats)
     result["sentinel"] = sentinel_result
     result["quantized_wire"] = quantized_result
+    result["zero_sharded"] = zero_result
     result["search"] = _smoke_search(loss_fn, params, batches[0])
     # trace export BEFORE the elastic leg: its builds reset the recorder
     # (and its reconfigure clears the XLA backend — rebuilt on demand,
@@ -876,6 +933,61 @@ def _smoke_quantized_wire(loss_fn, params, batches):
             "bytes_quantized": quantized, "bytes_saved": saved,
             "wire_reduction_x": round(reduction, 3),
             "dispatches": q_dispatches}
+
+
+def _smoke_zero(loss_fn, params, batches):
+    """ZeRO-sharded-update leg of the smoke bench: train the smoke MLP
+    under ``ZeroSharded()`` and ASSERT (a) per-step parity with the
+    AllReduce loop (the fp32 sharded update is exact modulo float
+    reassociation), (b) fused k=4 matches the per-step zero loop with
+    the k x dispatch reduction (the sharded opt state rides the scan
+    carry), (c) dispatch parity with AllReduce (rs + sharded apply + ag
+    all live inside the one program), and (d) the projected per-chip
+    opt-state saving is positive (zero.hbm_saved_bytes — what the
+    ADT501 plan gate stops charging). Gates every PR on the sharded
+    update compiling and staying numerically honest."""
+    import numpy as np
+    import optax
+    import autodist_tpu as adt
+    from autodist_tpu import strategy
+    from autodist_tpu.telemetry import spans as tel
+
+    def leg(builder, fuse=0):
+        adt.reset()
+        ad = adt.AutoDist(strategy_builder=builder)
+        runner = ad.build(loss_fn, optax.adam(1e-2), params, batches[0])
+        runner.init(params)
+        if fuse:
+            hist = runner.fit(list(batches), fuse_steps=fuse,
+                              metrics_every=1)
+        else:
+            hist = runner.fit(list(batches))
+        return ([float(m["loss"]) for m in hist], runner,
+                dict(tel.counters()))
+
+    ar_losses, ar_runner, _ = leg(strategy.AllReduce())
+    z_losses, z_runner, counters = leg(strategy.ZeroSharded())
+    meta = z_runner.distributed_step.metadata
+    assert meta["zero_sharded"], "no variable took the zero path"
+    saved = float(meta.get("zero_hbm_saved_bytes", 0.0))
+    assert saved > 0, "zero leg projects no opt-state HBM saving"
+    assert counters.get("zero.rs_bytes", 0.0) > 0, counters
+    assert counters.get("zero.ag_bytes", 0.0) > 0, counters
+    assert (z_runner.distributed_step.dispatches
+            == ar_runner.distributed_step.dispatches), (
+        "sharded update changed the dispatch count")
+    np.testing.assert_allclose(z_losses, ar_losses, rtol=1e-4, atol=1e-6)
+    zf_losses, zf_runner, _ = leg(strategy.ZeroSharded(), fuse=4)
+    np.testing.assert_allclose(zf_losses, z_losses, rtol=1e-5, atol=1e-6)
+    assert zf_runner.distributed_step.dispatches == \
+        z_runner.distributed_step.dispatches // 4
+    return {"final_loss_allreduce": round(ar_losses[-1], 6),
+            "final_loss_zero": round(z_losses[-1], 6),
+            "zero_sharded_vars": len(meta["zero_sharded"]),
+            "hbm_saved_bytes": saved,
+            "rs_bytes": counters.get("zero.rs_bytes", 0.0),
+            "ag_bytes": counters.get("zero.ag_bytes", 0.0),
+            "dispatches": z_runner.distributed_step.dispatches}
 
 
 def _smoke_search(loss_fn, params, batch):
