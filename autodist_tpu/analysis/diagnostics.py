@@ -195,6 +195,11 @@ CODES = {
               "shrink",
     "ADT431": "in-run elastic shrink loses a PS owner (checkpoint "
               "fallback required)",
+    "ADT432": "preemption handoff armed on a fail-fast (model-parallel) "
+              "topology",
+    "ADT440": "autoscale bounds unsound for this strategy (shrink below "
+              "the safe replica floor)",
+    "ADT441": "autoscale thresholds cannot work as configured",
     # ADT5xx — memory footprint & collective schedule (analysis/hlo.py,
     # analysis/memory.py)
     "ADT501": "projected per-device OOM: peak HBM exceeds the budget",

@@ -137,6 +137,11 @@ class ENV(Enum):
     # Retry-After (seconds) a draining serving tier attaches to its typed
     # sheds, so load balancers re-route instead of hammering the leaver
     ADT_DRAIN_RETRY_AFTER_S = ("ADT_DRAIN_RETRY_AFTER_S", float, 5.0)
+    # FleetAutoscaler.start() control-loop period (seconds): how often the
+    # serving autoscaler samples queue depth/p99 and re-decides; the
+    # policy's sustain window and cooldowns gate actual scale events, so
+    # a fast poll sharpens reaction time without causing flap
+    ADT_AUTOSCALE_POLL_S = ("ADT_AUTOSCALE_POLL_S", float, 2.0)
     # cloud maintenance-event poll hook: a path whose EXISTENCE signals a
     # pending maintenance eviction for this host (its JSON body may carry
     # {"deadline_s": ..., "reason": ...}). Cloud integrations materialize

@@ -208,6 +208,28 @@ def clear_join(client, worker: str):
     client.put("elastic/join/%s" % worker, "0")
 
 
+def admit_worker(client, worker: str) -> int:
+    """Chief-side grow-on-join admission as one move: publish the next
+    epoch with ``worker`` appended to the current roster and consume its
+    join announcement (if any). This is the actuator the chief's
+    watchdog and the serving autoscaler share — the admitted worker's
+    Runner adopts the grown mesh at its next epoch poll. Returns the new
+    epoch. No-op (returns the current epoch) when the worker is already
+    a member. Raises :class:`RuntimeError` when no epoch was ever
+    published — there is no roster to grow."""
+    info = read_epoch(client)
+    if info is None:
+        raise RuntimeError(
+            "admit_worker(%r): no membership epoch published — "
+            "publish_epoch a launch roster first" % worker)
+    epoch, roster = info
+    if worker in roster:
+        return epoch
+    publish_epoch(client, epoch + 1, list(roster) + [worker])
+    clear_join(client, worker)
+    return epoch + 1
+
+
 def gc_worker_marks(client, worker: str):
     """Watchdog hygiene: scrub every liveness record a dead incarnation of
     ``worker`` may have left — its heartbeat (GOODBYE), its ``compiling``

@@ -216,6 +216,37 @@ def publish_notice(client, worker: str, deadline_s: Optional[float] = None,
     return notice
 
 
+def retire_worker(client, worker: str, deadline_s: Optional[float] = None,
+                  reason: str = "autoscale") -> int:
+    """Planned drain-then-shrink of ONE worker as one move: publish an
+    advance preemption notice for ``worker`` (arming its graceful-
+    departure path — rescue handoff, serving drain with typed
+    Retry-After, zero ``ckpt.fallback``) and then the survivor epoch
+    without it. This is the shrink actuator the serving autoscaler
+    drives; ``preempt.planned_shrinks`` is counted by the survivors'
+    reconfigure path when they adopt the shrunk mesh. Returns the new
+    epoch. Raises :class:`RuntimeError` when no epoch is published or
+    ``worker`` is not a member (retiring a non-member would burn an
+    epoch for nothing)."""
+    from autodist_tpu.runtime import elastic
+    info = elastic.read_epoch(client)
+    if info is None:
+        raise RuntimeError(
+            "retire_worker(%r): no membership epoch published" % worker)
+    epoch, roster = info
+    if worker not in roster:
+        raise RuntimeError(
+            "retire_worker(%r): not in the current roster %s"
+            % (worker, roster))
+    publish_notice(client, worker, deadline_s=deadline_s, reason=reason)
+    survivors = [w for w in roster if w != worker]
+    elastic.publish_epoch(client, epoch + 1, survivors)
+    from autodist_tpu.telemetry import blackbox
+    blackbox.record("preempt.retire", worker=worker, reason=reason,
+                    epoch=epoch + 1, survivors=len(survivors))
+    return epoch + 1
+
+
 def read_notice(client, worker: str) -> Optional[PreemptionNotice]:
     raw = client.get(NOTICE_PREFIX + worker)
     if not raw or raw == "0":

@@ -24,10 +24,21 @@ the resilient control plane — applied to inference traffic
   in-flight groups complete, and sheds queued work with a typed
   ``Retry-After`` (``ADT_DRAIN_RETRY_AFTER_S``) so load balancers
   re-route instead of hammering a leaving replica.
+- load-adaptive fleet sizing:
+  :class:`~autodist_tpu.serving.autoscale.FleetAutoscaler` +
+  :class:`~autodist_tpu.serving.autoscale.AutoscalePolicy` close the
+  loop from the serving telemetry (queue depth, p99, batch fill) to the
+  elastic actuators — epoch-fenced grow-on-join under sustained
+  overload, planned drain-then-shrink under sustained idle — with
+  hysteresis bands and per-direction cooldowns so the fleet never
+  flaps (docs/serving.md#autoscaling).
 """
 from autodist_tpu.serving.engine import (InferenceEngine, ServingConfig,
                                          ServingUnavailable)
 from autodist_tpu.serving.batcher import MicroBatcher, active_batchers
+from autodist_tpu.serving.autoscale import (AutoscalePolicy, AutoscaleSignals,
+                                            FleetAutoscaler)
 
 __all__ = ["InferenceEngine", "MicroBatcher", "ServingConfig",
-           "ServingUnavailable", "active_batchers"]
+           "ServingUnavailable", "active_batchers", "AutoscalePolicy",
+           "AutoscaleSignals", "FleetAutoscaler"]

@@ -13,10 +13,22 @@ Failure behavior is SHED, NEVER HANG: a full queue rejects the submit
 with :class:`ServingUnavailable`; an exhausted PS-degradation window
 fails the GROUP's futures with the engine's typed error and the worker
 keeps serving (the next snapshot refresh may succeed — e.g. after the
-circuit breaker's cooldown). Every request is accounted: ``serve.
-requests/batches/shed/degraded/padded_rows`` counters, the
-``serve.queue_depth`` gauge, and the ``serve.latency_ms`` histogram
-(submit -> fan-out) feeding the p50/p99 readout in :meth:`stats`.
+circuit breaker's cooldown). Every shed carries a populated
+``retry_after_s``: queue-full sheds compute it from the measured drain
+rate (an EWMA over recent group service times — the honest answer to
+"when will there be room"), drain/close sheds carry the operator knob
+``ADT_DRAIN_RETRY_AFTER_S``. Requests may carry a per-request
+``deadline_s``: one that would already be expired when its group
+dispatches is shed immediately instead of consuming a dispatch slot on
+an answer nobody is waiting for. Under SUSTAINED overload (queue near
+``max_queue`` for ``brownout_sustain_s``) the batcher enters
+**brownout**: the group deadline widens by ``brownout_delay_factor`` so
+dispatches run at full buckets — maximum throughput at bounded p99 —
+until the backlog recedes. Every request is accounted: ``serve.
+requests/batches/shed/deadline_shed/brownouts/degraded/padded_rows``
+counters, the ``serve.queue_depth`` gauge, and the ``serve.latency_ms``
+histogram (submit -> fan-out) feeding the p50/p99 readout in
+:meth:`stats`.
 """
 import queue
 import threading
@@ -44,12 +56,23 @@ def active_batchers() -> list:
 
 
 class _Pending:
-    __slots__ = ("example", "future", "t0")
+    __slots__ = ("example", "future", "t0", "deadline")
 
-    def __init__(self, example):
+    def __init__(self, example, deadline_s: Optional[float] = None):
         self.example = example
         self.future = Future()
         self.t0 = time.perf_counter()
+        # absolute expiry on the worker clock (None = no deadline)
+        self.deadline = (self.t0 + deadline_s
+                         if deadline_s is not None else None)
+
+
+# clamp on every computed Retry-After: never tell a client to hammer
+# back in microseconds, never park it for longer than any drain window
+_RETRY_AFTER_MIN_S = 0.05
+_RETRY_AFTER_MAX_S = 60.0
+# EWMA smoothing for the measured drain rate (requests/s)
+_DRAIN_RATE_ALPHA = 0.3
 
 
 class MicroBatcher:
@@ -80,10 +103,21 @@ class MicroBatcher:
         # this module promises never happens
         self._submit_lock = threading.Lock()
         self.stats_local = {"requests": 0, "batches": 0, "shed": 0,
-                            "errors": 0, "fan_out": 0, "drained": 0}
+                            "errors": 0, "fan_out": 0, "drained": 0,
+                            "deadline_shed": 0}
         # set while draining/closed: the Retry-After attached to every
-        # typed shed (None = plain close, no retry hint)
+        # typed shed past that point
         self._retry_after: Optional[float] = None
+        # measured drain rate (requests/s EWMA over group service times);
+        # None until the first group completes — the honest source of the
+        # queue-full Retry-After
+        self._drain_rate: Optional[float] = None
+        # brownout: sustained near-full queue widens the group deadline
+        # so dispatches run at full buckets (throughput over p50)
+        self._brownout = False
+        self._brownout_entries = 0
+        self._overload_since: Optional[float] = None
+        self._effective_delay_s = self.max_delay_s
         self._worker = threading.Thread(target=self._run,
                                         name="adt-serve-batcher",
                                         daemon=True)
@@ -92,31 +126,105 @@ class MicroBatcher:
 
     # ------------------------------------------------------------- submit
 
-    def submit(self, example) -> Future:
+    def submit(self, example, deadline_s: Optional[float] = None) -> Future:
         """Enqueue one single-example request; resolves to its fetch tree
         (row of every batch-dim leaf). Sheds with
         :class:`ServingUnavailable` when the queue is full or the
-        batcher is closed — backpressure is synchronous and typed, so an
-        overloaded tier fails fast instead of buffering unboundedly."""
+        batcher is closed — backpressure is synchronous and typed, and
+        every shed carries a populated ``retry_after_s`` (measured
+        drain-rate estimate on queue-full, the drain knob when
+        closed/draining) so an overloaded tier fails fast with an honest
+        back-off hint instead of buffering unboundedly. ``deadline_s``
+        (optional, seconds from now) arms a per-request deadline: if the
+        request would already be expired when its group dispatches, it
+        is shed then instead of consuming a dispatch slot."""
         with tel.span("serve.enqueue", "serve"), self._submit_lock:
             if self._closed:
+                retry = (const.ENV.ADT_DRAIN_RETRY_AFTER_S.val
+                         if self._retry_after is None else self._retry_after)
                 raise ServingUnavailable(
-                    "micro-batcher is %s" % ("draining"
-                                             if self._retry_after is not None
-                                             else "closed"),
-                    retry_after_s=self._retry_after)
-            if self._queue.qsize() >= self.max_queue:
+                    "micro-batcher is %s (Retry-After %.1fs)"
+                    % ("draining" if self._retry_after is not None
+                       else "closed", retry),
+                    retry_after_s=retry)
+            depth = self._queue.qsize()
+            if depth >= self.max_queue:
+                retry = self._computed_retry_after(depth)
                 self.stats_local["shed"] += 1
                 tel.counter_add("serve.shed")
                 raise ServingUnavailable(
-                    "serving queue full (%d pending) — shedding"
-                    % self.max_queue)
-            pending = _Pending(example)
+                    "serving queue full (%d pending) — shedding "
+                    "(Retry-After %.2fs)" % (self.max_queue, retry),
+                    retry_after_s=retry)
+            self._maybe_brownout(depth)
+            pending = _Pending(example, deadline_s)
             self._queue.put(pending)
             self.stats_local["requests"] += 1
             tel.counter_add("serve.requests")
             tel.gauge_set("serve.queue_depth", self._queue.qsize())
         return pending.future
+
+    def queue_depth(self) -> int:
+        """Currently queued (not yet grouped) requests — the live signal
+        behind the ``serve.queue_depth`` gauge."""
+        return self._queue.qsize()
+
+    def _computed_retry_after(self, depth: int) -> float:
+        """Retry-After from the MEASURED drain rate: the current backlog
+        over the smoothed requests/s the worker is actually clearing,
+        clamped to a sane band. Before any group has completed there is
+        no measurement — fall back to the operator knob rather than
+        invent a number."""
+        rate = self._drain_rate
+        if not rate or rate <= 0:
+            base = const.ENV.ADT_DRAIN_RETRY_AFTER_S.val
+        else:
+            base = depth / rate
+        return min(max(base, _RETRY_AFTER_MIN_S), _RETRY_AFTER_MAX_S)
+
+    def _maybe_brownout(self, depth: int):
+        """Brownout state machine, driven from BOTH submit and the
+        worker loop (the worker may be parked inside a long dispatch, so
+        admission must be able to flip the state without it). Enter when
+        the queue has sat above ``brownout_queue_frac * max_queue`` for
+        ``brownout_sustain_s``; exit at half the entry threshold —
+        hysteresis, so a backlog hovering at the line does not strobe
+        the group deadline."""
+        cfg = self._engine.config
+        factor = getattr(cfg, "brownout_delay_factor", 1.0)
+        if factor <= 1.0:
+            return
+        high = getattr(cfg, "brownout_queue_frac", 0.75) * self.max_queue
+        now = time.perf_counter()
+        if not self._brownout:
+            if depth >= high:
+                if self._overload_since is None:
+                    self._overload_since = now
+                elif (now - self._overload_since
+                      >= getattr(cfg, "brownout_sustain_s", 1.0)):
+                    self._brownout = True
+                    self._brownout_entries += 1
+                    self._effective_delay_s = self.max_delay_s * factor
+                    tel.counter_add("serve.brownouts")
+                    tel.gauge_set("serve.brownout", 1)
+                    tel.instant("serve.brownout", "serve", depth=depth,
+                                delay_ms=self._effective_delay_s * 1e3)
+                    logging.warning(
+                        "serving: entering brownout — queue %d/%d "
+                        "sustained; widening group deadline to %.1fms "
+                        "for full-bucket dispatches", depth,
+                        self.max_queue, self._effective_delay_s * 1e3)
+            else:
+                self._overload_since = None
+        elif depth <= high / 2:
+            self._brownout = False
+            self._overload_since = None
+            self._effective_delay_s = self.max_delay_s
+            tel.gauge_set("serve.brownout", 0)
+            tel.instant("serve.brownout_exit", "serve", depth=depth)
+            logging.warning("serving: exiting brownout — queue depth %d "
+                            "receded; restoring %.1fms group deadline",
+                            depth, self.max_delay_s * 1e3)
 
     def predict_one(self, example, timeout: Optional[float] = None):
         """Blocking convenience: ``submit(example).result(timeout)``."""
@@ -135,7 +243,9 @@ class MicroBatcher:
         if first is _SENTINEL:
             return [], True
         group = [first]
-        deadline = first.t0 + self.max_delay_s
+        # _effective_delay_s, not max_delay_s: under brownout the group
+        # deadline is widened so dispatches run at full buckets
+        deadline = first.t0 + self._effective_delay_s
         while len(group) < self.max_batch:
             remaining = deadline - time.perf_counter()
             try:
@@ -158,7 +268,14 @@ class MicroBatcher:
             if group:
                 with tel.span("serve.batch", "serve", n=len(group)):
                     self._serve_group(group)
-                tel.gauge_set("serve.queue_depth", self._queue.qsize())
+            # gauge updated UNCONDITIONALLY after every wakeup — a gauge
+            # written only on submit reads stale-high forever once
+            # traffic stops, and an empty group is exactly the moment
+            # the queue went quiet
+            depth = self._queue.qsize()
+            tel.gauge_set("serve.queue_depth", depth)
+            with self._submit_lock:
+                self._maybe_brownout(depth)
             if stop:
                 break
 
@@ -167,6 +284,27 @@ class MicroBatcher:
         # submit → group start, per request (the other two buckets —
         # dispatch and readback — are observed inside the engine)
         t_start = time.perf_counter()
+        # deadline sweep BEFORE the dispatch: a request whose deadline
+        # already passed in queue gets an immediate typed shed instead
+        # of burning a padded dispatch row on an answer nobody waits for
+        expired = [p for p in group
+                   if p.deadline is not None and t_start > p.deadline]
+        if expired:
+            retry = self._computed_retry_after(self._queue.qsize())
+            exc = ServingUnavailable(
+                "request deadline expired in queue — shedding "
+                "(Retry-After %.2fs)" % retry, retry_after_s=retry)
+            dead = set(map(id, expired))
+            group = [p for p in group if id(p) not in dead]
+            self.stats_local["shed"] += len(expired)
+            self.stats_local["deadline_shed"] += len(expired)
+            tel.counter_add("serve.shed", len(expired))
+            tel.counter_add("serve.deadline_shed", len(expired))
+            tel.instant("serve.deadline_shed", "serve", n=len(expired))
+            for p in expired:
+                p.future.set_exception(exc)
+            if not group:
+                return
         for p in group:
             tel.hist_observe("serve.queue_ms", (t_start - p.t0) * 1e3)
         try:
@@ -191,6 +329,15 @@ class MicroBatcher:
         self.stats_local["batches"] += 1
         self.stats_local["fan_out"] += n
         now = time.perf_counter()
+        # drain-rate EWMA (requests/s actually cleared): the measured
+        # basis for the queue-full Retry-After
+        elapsed = now - t_start
+        if elapsed > 0:
+            rate = len(group) / elapsed
+            self._drain_rate = (rate if self._drain_rate is None else
+                                _DRAIN_RATE_ALPHA * rate
+                                + (1 - _DRAIN_RATE_ALPHA)
+                                * self._drain_rate)
         for p, row in zip(group, self._engine.fan_out(fetched, n)):
             tel.hist_observe("serve.latency_ms", (now - p.t0) * 1e3)
             p.future.set_result(row)
@@ -206,8 +353,15 @@ class MicroBatcher:
         # engine's also counts warmup dispatches and other callers)
         out = dict(self._engine.stats)
         out.update(self.stats_local)
+        from autodist_tpu.serving import autoscale as autoscale_lib
         out.update(
             queue_depth=self._queue.qsize(),
+            drain_rate_rps=self._drain_rate,
+            brownout={"active": self._brownout,
+                      "entries": self._brownout_entries},
+            # process-wide controller accounting from the pre-registered
+            # counters — stable keys even with no autoscaler running
+            autoscale=autoscale_lib.stats_snapshot(),
             buckets=list(self._engine.buckets),
             recompiles_after_warmup=self._engine.recompiles_after_warmup(),
             p50_ms=tel.hist_quantile("serve.latency_ms", 0.50),
@@ -268,6 +422,7 @@ class MicroBatcher:
                 shed += 1
         for item in requeue:
             self._queue.put(item)
+        tel.gauge_set("serve.queue_depth", self._queue.qsize())
         fan0 = self.stats_local["fan_out"]
         self._queue.put(_SENTINEL)
         self._worker.join(timeout=timeout)
@@ -302,7 +457,12 @@ class MicroBatcher:
         # same lock), so the drain below cannot race a late put
         self._queue.put(_SENTINEL)
         self._worker.join(timeout=timeout)
-        shed = ServingUnavailable("micro-batcher closed while queued")
+        # even a plain close carries a Retry-After: the caller's retry
+        # loop should back off the same way it would for a drain, not
+        # special-case a None hint
+        shed = ServingUnavailable(
+            "micro-batcher closed while queued",
+            retry_after_s=const.ENV.ADT_DRAIN_RETRY_AFTER_S.val)
         while True:
             try:
                 item = self._queue.get_nowait()
@@ -312,6 +472,7 @@ class MicroBatcher:
                 self.stats_local["shed"] += 1
                 tel.counter_add("serve.shed")
                 item.future.set_exception(shed)
+        tel.gauge_set("serve.queue_depth", self._queue.qsize())
         if self._worker.is_alive():
             # join timed out mid-group and the drain may have eaten the
             # sentinel — re-post it so the worker exits instead of

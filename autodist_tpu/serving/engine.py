@@ -68,13 +68,23 @@ class ServingConfig:
     ``snapshot_max_age_s``: host-PS snapshot refresh period.
     ``degraded_batches``: consecutive batches that may serve the last
     good snapshot while refresh fails (None = max(strategy staleness,
-    ``ADT_PS_MAX_LAG``, 1))."""
+    ``ADT_PS_MAX_LAG``, 1)).
+
+    Brownout (overload-graceful degradation, docs/serving.md): when the
+    queue sits above ``brownout_queue_frac * max_queue`` for
+    ``brownout_sustain_s``, the batcher widens the group deadline by
+    ``brownout_delay_factor`` so dispatches run at full buckets —
+    maximum throughput at bounded p99 instead of shedding earlier than
+    necessary. ``brownout_delay_factor=1.0`` disables the mode."""
 
     buckets: Optional[Sequence[int]] = None
     max_delay_ms: float = 2.0
     max_queue: int = 1024
     snapshot_max_age_s: float = 0.1
     degraded_batches: Optional[int] = None
+    brownout_queue_frac: float = 0.75
+    brownout_sustain_s: float = 1.0
+    brownout_delay_factor: float = 4.0
 
     def __post_init__(self):
         if self.max_delay_ms < 0:
@@ -84,6 +94,13 @@ class ServingConfig:
         if (self.degraded_batches is not None
                 and self.degraded_batches < 0):
             raise ValueError("degraded_batches must be >= 0")
+        if not 0.0 < self.brownout_queue_frac <= 1.0:
+            raise ValueError("brownout_queue_frac must be in (0, 1]")
+        if self.brownout_sustain_s < 0:
+            raise ValueError("brownout_sustain_s must be >= 0")
+        if self.brownout_delay_factor < 1.0:
+            raise ValueError("brownout_delay_factor must be >= 1.0 "
+                             "(1.0 disables brownout)")
 
 
 DEFAULT_BUCKETS = (1, 8, 32, 128)

@@ -138,6 +138,9 @@ DEFAULT_COUNTERS = (
     "search.candidates", "search.pruned",
     "serve.requests", "serve.batches", "serve.compiles",
     "serve.padded_rows", "serve.degraded", "serve.shed", "serve.drained",
+    "serve.deadline_shed", "serve.brownouts",
+    "autoscale.grows", "autoscale.shrinks", "autoscale.holds",
+    "autoscale.refusals",
     "preempt.notices", "preempt.rescue_saves", "preempt.rescue_skips",
     "preempt.handoffs", "preempt.planned_shrinks",
     "telemetry.straggler_flags", "blackbox.dumps", "profiler.windows",
@@ -531,6 +534,10 @@ def histograms() -> Dict[str, dict]:
 
 def counters() -> Dict[str, float]:
     return get_recorder().counters()
+
+
+def gauges() -> Dict[str, float]:
+    return get_recorder().gauges()
 
 
 def current_span_id() -> int:

@@ -647,6 +647,7 @@ def smoke_main(fused: bool = False):
     result.update(_smoke_telemetry())
     result["elastic"] = _smoke_elastic(loss_fn, params, batches)
     result["preempt"] = _smoke_preempt(loss_fn, params, batches)
+    result["autoscale"] = _smoke_autoscale(loss_fn, params, batches)
     adt.reset()
     print(RESULT_TAG + json.dumps(result), flush=True)
 
@@ -783,6 +784,206 @@ def _smoke_preempt(loss_fn, params, batches):
         # not sink the whole smoke round; surface it in the json instead
         print("[bench] preempt smoke leg failed: %s" % e, file=sys.stderr,
               flush=True)
+        return {"error": "%s: %s" % (type(e).__name__, str(e)[:160])}
+
+
+def _smoke_autoscale(loss_fn, params, batches, osc=False):
+    """Autoscale leg (``bench.py --autoscale``, and the smoke round):
+    the REAL serving stack (engine + micro-batcher) under a seeded load
+    ramp, with a :class:`FleetAutoscaler` closing the loop against a
+    phantom-peer fleet — launch roster ``[me, replica-b]``, pool
+    ``[replica-c, replica-d]``, so the 2→4→2 ramp exercises the real
+    admission/retirement wire without extra processes (the phantom
+    pattern the preempt leg established). The engine gets a synthetic
+    per-batch service time so a burst SUSTAINS a backlog on CPU.
+
+    Ramp leg asserts: >= 1 grow under sustained queue depth, >= 1
+    planned shrink (preemption notice + survivor epoch) back down, zero
+    ``ckpt.fallback``, zero sheds OUTSIDE the overload window, at least
+    one brownout entry and one deadline shed (the degradation paths),
+    and every observed shed carrying a populated ``retry_after_s``.
+    Oscillating leg (``osc=True``): bursts shorter than the policy's
+    sustain window must produce at most 2 scale events — the hysteresis
+    band + sustain window bound flap, which is the whole point."""
+    import optax
+    import autodist_tpu as adt
+    from autodist_tpu import strategy
+    from autodist_tpu.runtime import elastic
+    from autodist_tpu.runtime.coordination import CoordinationClient
+    from autodist_tpu.serving import (AutoscalePolicy, FleetAutoscaler,
+                                      InferenceEngine, MicroBatcher,
+                                      ServingConfig, ServingUnavailable)
+    from autodist_tpu.telemetry import spans as tel
+
+    try:
+        with _inrun_elastic_sandbox({"ADT_PREEMPT_POLL_S": "0.01"}) as port:
+            client = CoordinationClient("127.0.0.1", port)
+            me = "127.0.0.1"
+            elastic.publish_epoch(client, 1, [me, "replica-b"])
+            ad = adt.AutoDist(strategy_builder=strategy.AllReduce())
+            runner = ad.build(loss_fn, optax.adam(1e-2), params,
+                              batches[0])
+            runner.init(params)
+            import jax.numpy as jnp
+
+            def serve_fn(p, b):
+                h = jnp.tanh(b["x"] @ p["w1"] + p["b1"])
+                return {"y": h @ p["w2"]}
+
+            replicas = runner.remapper.num_replicas
+            engine = InferenceEngine(
+                runner, serve_fn, {"x": batches[0]["x"][0]},
+                ServingConfig(buckets=(replicas, 8 * replicas),
+                              max_delay_ms=2.0, max_queue=64,
+                              brownout_queue_frac=0.5,
+                              brownout_sustain_s=0.02,
+                              brownout_delay_factor=4.0)).warmup()
+            mb = MicroBatcher(engine)
+            # synthetic service time: the smoke MLP would drain any
+            # burst instantly on CPU, and the controller needs a backlog
+            # that SUSTAINS past its window to have anything to measure
+            real_run = engine.run_batch
+
+            def slow_run(reqs):
+                time.sleep(0.015)
+                return real_run(reqs)
+
+            engine.run_batch = slow_run
+            if osc:
+                # sustain window LONGER than any burst: the leg proves
+                # the window + hysteresis band bound scale events
+                policy = AutoscalePolicy(min_replicas=2, max_replicas=4,
+                                         queue_high=8, queue_low=2,
+                                         sustain_s=0.5,
+                                         grow_cooldown_s=30.0,
+                                         shrink_cooldown_s=30.0)
+            else:
+                policy = AutoscalePolicy(min_replicas=2, max_replicas=4,
+                                         queue_high=8, queue_low=2,
+                                         sustain_s=0.05,
+                                         grow_cooldown_s=0.02,
+                                         shrink_cooldown_s=0.02)
+            scaler = FleetAutoscaler(client, policy, me,
+                                     pool=["replica-c", "replica-d"],
+                                     notice_deadline_s=60.0)
+            shed_hints, unset_hints = [], 0
+            futures = []
+
+            def burst(n, deadline_every=0):
+                for i in range(n):
+                    dl = (0.001 if deadline_every
+                          and i % deadline_every == 0 else None)
+                    try:
+                        futures.append(mb.submit(
+                            {"x": batches[i % len(batches)]["x"][0]},
+                            deadline_s=dl))
+                    except ServingUnavailable as e:
+                        shed_hints.append(e.retry_after_s)
+
+            def settle(fs):
+                nonlocal unset_hints
+                for f in fs:
+                    try:
+                        f.result(timeout=30)
+                    except ServingUnavailable as e:
+                        shed_hints.append(e.retry_after_s)
+                        if e.retry_after_s is None:
+                            unset_hints += 1
+                fs.clear()
+
+            try:
+                if osc:
+                    # bursts shorter than the sustain window, drained
+                    # between spikes — the fleet must NOT move
+                    deadline = time.perf_counter() + 2.0
+                    while time.perf_counter() < deadline:
+                        burst(12)
+                        scaler.step()
+                        time.sleep(0.05)
+                    settle(futures)
+                    st = scaler.stats()
+                    events = st["grows"] + st["shrinks"]
+                    assert events <= 2, (
+                        "oscillating load flapped the fleet: %d scale "
+                        "events despite sustain %.1fs > burst length"
+                        % (events, policy.sustain_s))
+                    assert st["holds"] >= 10, st
+                    mb.close()
+                    return {"mode": "oscillating",
+                            "scale_events": events,
+                            "holds": st["holds"],
+                            "decisions": st["decisions"]}
+                # ---- overload window: sustained backlog, fleet 2 -> 4
+                overload_t0 = time.perf_counter()
+                grow_deadline = overload_t0 + 10.0
+                while ((scaler.stats()["grows"] < 2
+                        or mb.stats()["brownout"]["entries"] < 1)
+                       and time.perf_counter() < grow_deadline):
+                    burst(24, deadline_every=8)
+                    scaler.step()
+                    time.sleep(0.01)
+                shed_in_overload = len(shed_hints)
+                settle(futures)
+                overload_s = time.perf_counter() - overload_t0
+                c_shed_after_overload = tel.counters().get("serve.shed",
+                                                           0.0)
+                # ---- idle window: no traffic, fleet 4 -> 2 via the
+                # planned-departure path
+                idle_deadline = time.perf_counter() + 10.0
+                while (scaler.stats()["shrinks"] < 2
+                       and time.perf_counter() < idle_deadline):
+                    scaler.step()
+                    time.sleep(0.02)
+                idle_shed = (tel.counters().get("serve.shed", 0.0)
+                             - c_shed_after_overload)
+                st = scaler.stats()
+                info = elastic.read_epoch(client)
+                stats = mb.stats()
+                counters = tel.counters()
+                mb.close()
+                assert st["grows"] >= 1, "no grow under sustained load: %s" % st
+                assert st["shrinks"] >= 1, "no shrink under idle: %s" % st
+                assert counters.get("preempt.notices", 0.0) >= 1, (
+                    "shrink did not go through the planned-departure "
+                    "notice path")
+                assert counters.get("ckpt.fallback", 0.0) == 0, (
+                    "autoscale shrink touched the checkpoint fallback")
+                assert idle_shed == 0, (
+                    "%d sheds OUTSIDE the overload window" % idle_shed)
+                assert unset_hints == 0 and all(
+                    h is not None for h in shed_hints), (
+                    "a shed was raised without a populated retry_after_s")
+                assert info is not None and len(info[1]) == 2, (
+                    "fleet did not return to 2 replicas: %s" % (info,))
+                assert stats["brownout"]["entries"] >= 1, (
+                    "sustained overload never entered brownout: %s"
+                    % stats["brownout"])
+                assert stats["deadline_shed"] >= 1, (
+                    "expired-deadline requests were not shed: %s"
+                    % stats["deadline_shed"])
+                return {
+                    "mode": "ramp",
+                    "grows": st["grows"], "shrinks": st["shrinks"],
+                    "holds": st["holds"], "refusals": st["refusals"],
+                    "final_epoch": info[0],
+                    "final_replicas": len(info[1]),
+                    "overload_window_s": round(overload_s, 3),
+                    "sheds_in_overload": shed_in_overload,
+                    "sheds_outside_overload": idle_shed,
+                    "deadline_sheds": stats["deadline_shed"],
+                    "brownout_entries": stats["brownout"]["entries"],
+                    "notices": counters.get("preempt.notices", 0.0),
+                    "ckpt_fallback": counters.get("ckpt.fallback", 0.0),
+                    "retry_after_hints": len(shed_hints),
+                }
+            finally:
+                mb.close()  # idempotent; a failed assert must not leak
+                # the worker thread into the next leg
+                client.close()
+    except Exception as e:  # noqa: BLE001 — surfaced in the json; the
+        # CLI entry (autoscale_main) re-raises so CI stays strict
+        print("[bench] autoscale smoke leg failed: %s" % e,
+              file=sys.stderr, flush=True)
         return {"error": "%s: %s" % (type(e).__name__, str(e)[:160])}
 
 
@@ -1346,6 +1547,45 @@ def serve_main(smoke: bool):
     print(RESULT_TAG + json.dumps(result), flush=True)
 
 
+def autoscale_main(osc: bool = False):
+    """``bench.py --autoscale [--osc]`` — the load-adaptive serving leg
+    standalone: the seeded 2→4→2 phantom-peer ramp (CI), or the
+    oscillating-load hysteresis leg (``--osc``, nightly chaos). Unlike
+    the best-effort smoke wiring, a failed assertion here FAILS the
+    process — this is the enforcement entry CI runs."""
+    if "--xla_force_host_platform_device_count" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2").strip()
+    import jax
+    jax.config.update("jax_platforms",
+                      os.environ.get("ADT_BENCH_PLATFORM") or "cpu")
+    rng = np.random.RandomState(0)
+    params = {"w1": rng.randn(16, 32).astype(np.float32) * 0.1,
+              "b1": np.zeros((32,), np.float32),
+              "w2": rng.randn(32, 4).astype(np.float32) * 0.1}
+
+    def loss_fn(p, b):
+        import jax.numpy as jnp
+        h = jnp.tanh(b["x"] @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - b["y"]) ** 2)
+
+    batches = [{"x": rng.randn(32, 16).astype(np.float32),
+                "y": rng.randn(32, 4).astype(np.float32)}
+               for _ in range(16)]
+    result = {"metric": "autoscale",
+              "autoscale": _smoke_autoscale(loss_fn, params, batches,
+                                            osc=osc)}
+    if "error" in result["autoscale"]:
+        print(RESULT_TAG + json.dumps(result), flush=True)
+        raise SystemExit("autoscale leg failed: %s"
+                         % result["autoscale"]["error"])
+    import autodist_tpu as adt
+    adt.reset()
+    print(RESULT_TAG + json.dumps(result), flush=True)
+
+
 def probe_main():
     """Trivial device matmul — the parent's preflight. A tunnel that
     cannot run this will time out every model; recording that fact in
@@ -1576,6 +1816,8 @@ if __name__ == "__main__":
         child_main(sys.argv[2])
     elif len(sys.argv) >= 2 and sys.argv[1] == "--probe":
         probe_main()
+    elif "--autoscale" in sys.argv[1:]:
+        autoscale_main(osc="--osc" in sys.argv[1:])
     elif "--serve" in sys.argv[1:]:
         serve_main(smoke="--smoke" in sys.argv[1:])
     elif len(sys.argv) >= 2 and sys.argv[1] == "--smoke":

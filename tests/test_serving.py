@@ -391,3 +391,182 @@ def test_evaluate_weights_scalars_by_example_count():
     naive = (loss_big + loss_small) / 2
     np.testing.assert_allclose(combined, weighted, rtol=1e-6)
     assert abs(combined - naive) > 1e-3  # the bias the fix removes
+
+
+# ----------------------------------------------- batcher degradation paths
+# (pure-python fake engine: these contracts are the BATCHER's — queue
+# accounting, Retry-After population, deadlines, brownout — and must be
+# testable without compiling a program)
+
+
+class _FakeEngine:
+    """Minimal engine surface the MicroBatcher consumes. ``block`` (a
+    threading.Event) parks the FIRST dispatch until set, so tests can
+    pile up a queue behind a busy worker deterministically."""
+
+    def __init__(self, config=None, block=None):
+        self.config = config or ServingConfig(buckets=(8,),
+                                              max_delay_ms=0.0)
+        self.max_batch = 8
+        self.buckets = (8,)
+        self.stats = {"padded_rows": 0}
+        self._block = block
+
+    def run_batch(self, requests):
+        if self._block is not None:
+            self._block.wait(timeout=30)
+        return list(requests), len(requests)
+
+    def fan_out(self, fetched, n):
+        return fetched
+
+    def recompiles_after_warmup(self):
+        return 0
+
+
+def _gauge():
+    return tel.gauges().get("serve.queue_depth")
+
+
+def test_queue_depth_gauge_fresh_after_traffic_stops():
+    """Regression: the gauge was only written on submit(), so it read
+    stale-high forever once traffic stopped. The worker loop now writes
+    it after EVERY wakeup, so an idle tier reads 0."""
+    mb = MicroBatcher(_FakeEngine())
+    futs = [mb.submit({"x": i}) for i in range(6)]
+    for f in futs:
+        f.result(timeout=5)
+    deadline = time.perf_counter() + 5
+    while _gauge() != 0 and time.perf_counter() < deadline:
+        time.sleep(0.005)
+    assert _gauge() == 0
+    mb.close()
+
+
+def test_queue_depth_gauge_zero_after_drain():
+    block = threading.Event()
+    mb = MicroBatcher(_FakeEngine(block=block))
+    mb.submit({"x": 0})              # in-flight, parked
+    time.sleep(0.05)
+    for i in range(4):
+        mb.submit({"x": i})          # queued behind the parked worker
+    assert _gauge() >= 1
+    threading.Timer(0.1, block.set).start()
+    mb.drain(timeout=10)
+    assert _gauge() == 0
+
+
+def test_queue_full_shed_carries_computed_clamped_retry_after():
+    """Regression: queue-full sheds raised with retry_after_s=None.
+    Every shed now carries a populated hint — the drain knob before any
+    measurement exists, the measured drain-rate estimate after."""
+    block = threading.Event()
+    mb = MicroBatcher(_FakeEngine(block=block), max_queue=2)
+    mb.submit({"x": 0})              # in-flight, parked
+    time.sleep(0.05)
+    mb.submit({"x": 1})
+    mb.submit({"x": 2})              # queue now at max_queue
+    with pytest.raises(ServingUnavailable) as ei:
+        mb.submit({"x": 3})
+    # no group has completed yet: the knob is the honest fallback
+    assert ei.value.retry_after_s == pytest.approx(5.0)
+    block.set()
+    deadline = time.perf_counter() + 5
+    while mb._drain_rate is None and time.perf_counter() < deadline:
+        time.sleep(0.005)
+    # measured now: still populated, and clamped to the sane band
+    retry = mb._computed_retry_after(depth=4)
+    assert retry is not None and 0.05 <= retry <= 60.0
+    mb.close()
+
+
+def test_closed_batcher_shed_carries_retry_after():
+    """Regression: a submit against a plainly closed (not draining)
+    batcher shed with retry_after_s=None."""
+    mb = MicroBatcher(_FakeEngine())
+    mb.close()
+    with pytest.raises(ServingUnavailable) as ei:
+        mb.submit({"x": 0})
+    assert ei.value.retry_after_s == pytest.approx(5.0)
+
+
+def test_close_while_queued_sheds_with_retry_after():
+    """Close with the worker wedged mid-dispatch: whatever is still
+    queued when the join times out sheds typed WITH a Retry-After (the
+    regression: it shed with None)."""
+    block = threading.Event()
+    mb = MicroBatcher(_FakeEngine(block=block))
+    f0 = mb.submit({"x": 0})         # in-flight, parked for the whole close
+    time.sleep(0.05)
+    queued = [mb.submit({"x": i}) for i in range(2)]
+    mb.close(timeout=0.2)            # join times out; queue must shed
+    for f in queued:
+        with pytest.raises(ServingUnavailable) as ei:
+            f.result(timeout=5)
+        assert ei.value.retry_after_s == pytest.approx(5.0)
+    block.set()                      # release the worker; in-flight lands
+    f0.result(timeout=5)
+
+
+def test_expired_deadline_sheds_before_dispatch():
+    """A request whose deadline passed while it queued is shed at group
+    time — typed, with a populated Retry-After — instead of consuming a
+    dispatch slot."""
+    block = threading.Event()
+    mb = MicroBatcher(_FakeEngine(block=block))
+    before = tel.counters().get("serve.deadline_shed", 0.0)
+    mb.submit({"x": 0})              # in-flight, parked
+    time.sleep(0.05)
+    doomed = mb.submit({"x": 1}, deadline_s=0.01)
+    alive = mb.submit({"x": 2})
+    time.sleep(0.05)                 # the deadline lapses in queue
+    block.set()
+    assert alive.result(timeout=5) == {"x": 2}
+    with pytest.raises(ServingUnavailable) as ei:
+        doomed.result(timeout=5)
+    assert ei.value.retry_after_s is not None
+    assert mb.stats_local["deadline_shed"] == 1
+    assert tel.counters()["serve.deadline_shed"] == before + 1
+    mb.close()
+
+
+def test_brownout_widens_group_deadline_under_sustained_overload():
+    block = threading.Event()
+    cfg = ServingConfig(buckets=(8,), max_delay_ms=1.0, max_queue=8,
+                        brownout_queue_frac=0.5, brownout_sustain_s=0.0,
+                        brownout_delay_factor=3.0)
+    mb = MicroBatcher(_FakeEngine(config=cfg, block=block))
+    mb.submit({"x": 0})              # in-flight, parked
+    time.sleep(0.05)
+    for i in range(6):               # queue past frac*max_queue, twice
+        mb.submit({"x": i})          # observed (arm, then enter)
+    assert mb.stats()["brownout"] == {"active": True, "entries": 1}
+    assert mb._effective_delay_s == pytest.approx(3.0 * mb.max_delay_s)
+    tel_entries = tel.counters().get("serve.brownouts", 0.0)
+    assert tel_entries >= 1
+    block.set()
+    # backlog recedes: the worker loop exits brownout at half the entry
+    # threshold and restores the configured deadline
+    deadline = time.perf_counter() + 5
+    while (mb.stats()["brownout"]["active"]
+           and time.perf_counter() < deadline):
+        time.sleep(0.005)
+    assert mb.stats()["brownout"]["active"] is False
+    assert mb._effective_delay_s == pytest.approx(mb.max_delay_s)
+    mb.close()
+
+
+def test_stats_autoscale_subdict_stable_keys():
+    """The autoscale sub-dict rides stats() with stable keys whether or
+    not a controller runs in this process (pre-registered counters)."""
+    mb = MicroBatcher(_FakeEngine())
+    sub = mb.stats()["autoscale"]
+    assert set(sub) == {"grows", "shrinks", "holds", "refusals"}
+    mb.close()
+
+
+def test_brownout_config_validation():
+    with pytest.raises(ValueError, match="brownout_queue_frac"):
+        ServingConfig(brownout_queue_frac=0.0)
+    with pytest.raises(ValueError, match="brownout_delay_factor"):
+        ServingConfig(brownout_delay_factor=0.5)
