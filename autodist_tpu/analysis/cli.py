@@ -22,9 +22,17 @@ top:
     python -m autodist_tpu.analysis lm1b --strategy PS --hbm-budget 16
     python -m autodist_tpu.analysis lm1b --strategy PS --hbm-budget 16 --fuse-steps 8
 
+``--numerics`` adds the plan-level numerics-safety gate (ADT601/602
+errors plus the sentinel-aware ADT603/604 warnings) — and
+``--compute-dtype bf16`` overrides the built plan's compute tier so the
+bf16 shape of ANY builder can be linted without editing code:
+
+    python -m autodist_tpu.analysis lm1b --strategy AllReduce --numerics --compute-dtype bf16
+
 ``--programs`` lints saved lowered-program dumps instead (per-program
-memory/donation/communication findings, plus the cross-program
-collective-schedule checks ADT510/511 against the FIRST file):
+memory/donation/communication findings and the ADT60x dtype-flow pass,
+plus the cross-program collective-schedule checks ADT510/511 — and the
+ADT605 collective-dtype check — against the FIRST file):
 
     python -m autodist_tpu.analysis --programs train.hlo eval.hlo fused.hlo --hbm-budget 16
 """
@@ -238,6 +246,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "memory + communication findings, plus cross-"
                         "program collective-schedule checks (ADT510/511) "
                         "against the FIRST file")
+    p.add_argument("--numerics", action="store_true",
+                   help="add the plan-level numerics-safety gate "
+                        "(rules.verify_numerics): ADT601/602 errors plus "
+                        "the ADT603 loss-tier and ADT604 sentinel-less "
+                        "half-precision warnings")
+    p.add_argument("--compute-dtype", choices=("f32", "bf16"), default=None,
+                   help="override the built strategy's compute tier "
+                        "before linting (lint the bf16 shape of any "
+                        "builder without a dedicated builder flag)")
     p.add_argument("--quiet", action="store_true",
                    help="print nothing on a clean plan")
     p.add_argument("--list", action="store_true",
@@ -252,6 +269,7 @@ def _programs_mode(args) -> int:
     import os
     from autodist_tpu.analysis import hlo as hlo_lib
     from autodist_tpu.analysis import memory as memory_lib
+    from autodist_tpu.analysis import numerics as numerics_lib
     from autodist_tpu.analysis.diagnostics import (Severity, format_table,
                                                    sort_diagnostics)
     from autodist_tpu.analysis.lowered import lint_lowered_text
@@ -270,6 +288,7 @@ def _programs_mode(args) -> int:
         est = memory_lib.estimate_from_text(prog)
         sched = hlo_lib.collective_schedule(prog)
         diags = list(lint_lowered_text(text))
+        diags += numerics_lib.lint_text(prog, label=label)
         diags += memory_lib.donation_diagnostics(
             prog, fuse_steps=args.fuse_steps)
         if budget is not None:
@@ -281,6 +300,8 @@ def _programs_mode(args) -> int:
     for label, _, sched, _ in per_program[1:]:
         cross += hlo_lib.compare_schedules(ref_sched, sched,
                                            ref_label, label)
+        cross += numerics_lib.compare_schedule_dtypes(ref_sched, sched,
+                                                      ref_label, label)
     all_diags = [d for (_, _, _, ds) in per_program for d in ds] + cross
     n_errors = sum(1 for d in all_diags if d.severity >= Severity.ERROR)
     if args.format == "json":
@@ -374,7 +395,19 @@ def main(argv=None) -> int:
             return 2
         label = args.strategy
 
+    if args.compute_dtype is not None:
+        # GraphConfig is a mutable plan object; overriding the tier here
+        # lints exactly the strategy the builder would emit with
+        # compute_dtype=..., no per-builder CLI flag needed
+        strategy.graph_config.compute_dtype = args.compute_dtype
+        label += "[%s]" % args.compute_dtype
+
     diags = list(verify(strategy, item, spec))
+    if args.numerics:
+        from autodist_tpu.analysis.rules import verify_numerics
+        seen = {(d.code, d.message) for d in diags}
+        diags += [d for d in verify_numerics(strategy, item, spec)
+                  if (d.code, d.message) not in seen]
     memory = None
     if args.hbm_budget is not None:
         from autodist_tpu.analysis import memory as memory_lib

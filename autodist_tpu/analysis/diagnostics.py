@@ -207,4 +207,13 @@ CODES = {
     "ADT503": "un-donated superstep carry doubles state residency",
     "ADT510": "same-mesh programs issue incompatible collective orders",
     "ADT511": "cross-program replica-group mismatch on a collective",
+    # ADT6xx — numerics safety (analysis/numerics.py, rules.verify_numerics):
+    # the static gate that makes the bf16 compute tier shippable — low-
+    # precision compute is allowed, low-precision ACCUMULATION and low-
+    # precision MASTER STATE are not
+    "ADT601": "half-precision accumulation in a reduction/psum",
+    "ADT602": "optimizer state or master params stored in half precision",
+    "ADT603": "loss/verdict computed in half precision",
+    "ADT604": "bf16 compute armed without a sentinel policy",
+    "ADT605": "cross-program dtype mismatch on order-compatible collectives",
 }

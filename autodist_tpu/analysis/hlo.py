@@ -57,8 +57,38 @@ def tensor_type_bytes(spec: str) -> int:
     return n * _DTYPE_BYTES.get(dtype, 4)
 
 
+def tensor_type_dtype(spec: str) -> str:
+    """Element dtype of one ``tensor<...>`` type spec, e.g. ``8x4xbf16``
+    -> ``bf16``; a bare dtype (``i32``) is its own element type."""
+    return spec.split("x")[-1].strip()
+
+
+def tensor_type_elems(spec: str) -> int:
+    """Element count of one ``tensor<...>`` type spec (1 for scalars)."""
+    n = 1
+    for p in spec.split("x")[:-1]:
+        try:
+            n *= int(p)
+        except ValueError:
+            pass  # dynamic dim "?"
+    return n
+
+
+HALF_DTYPES = frozenset({"bf16", "f16"})
+
+
 def _types_bytes(segment: str) -> List[int]:
     return [tensor_type_bytes(m.group(1))
+            for m in _TENSOR_TYPE_RE.finditer(segment)]
+
+
+def _types_dtypes(segment: str) -> List[str]:
+    return [tensor_type_dtype(m.group(1))
+            for m in _TENSOR_TYPE_RE.finditer(segment)]
+
+
+def _types_elems(segment: str) -> List[int]:
+    return [tensor_type_elems(m.group(1))
             for m in _TENSOR_TYPE_RE.finditer(segment)]
 
 
@@ -91,6 +121,7 @@ class HloArg:
     index: int
     type_bytes: int
     sharding: str = ""
+    dtype: str = ""  # element dtype, e.g. "f32"/"bf16" ("" when unparsed)
     # index of the output this arg's buffer is donated to (tf.aliasing_output),
     # or None when the caller keeps ownership
     aliased_output: Optional[int] = None
@@ -113,6 +144,7 @@ class HloResult:
     type_bytes: int
     sharding: str = ""
     result_info: str = ""  # jax.result_info label, e.g. "[0].params['w']"
+    dtype: str = ""        # element dtype ("" when unparsed)
 
     @property
     def per_device_bytes(self) -> float:
@@ -130,10 +162,18 @@ class HloStatement:
     lineno: int
     loop_depth: int                # while/scan regions enclosing it
     call_target: str = ""          # @target of call/func.call/custom_call
+    # element dtype of each result (parallel to out_bytes) — the SSA
+    # seed values the numerics dtype-flow pass propagates
+    out_dtypes: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def total_out_bytes(self) -> int:
         return sum(self.out_bytes)
+
+    @property
+    def out_dtype(self) -> str:
+        """First result's element dtype ("" for terminators)."""
+        return self.out_dtypes[0] if self.out_dtypes else ""
 
 
 # StableHLO / MHLO / jaxpr spellings -> the cost model's collective classes
@@ -161,6 +201,8 @@ class CollectiveOp:
     channel: int
     lineno: int
     loop_depth: int                              # >0: inside a while/scan
+    elem_dtype: str = ""                         # payload element dtype
+    payload_elems: int = 0                       # payload element count
 
     @property
     def group_size(self) -> int:
@@ -268,6 +310,15 @@ def _statement_out_bytes(line: str) -> List[int]:
     return []
 
 
+def _statement_out_dtypes(line: str) -> List[str]:
+    """Result element dtypes, parallel to :func:`_statement_out_bytes`."""
+    if "->" in line:
+        return _types_dtypes(line.rsplit("->", 1)[1])
+    if " : " in line:
+        return _types_dtypes(line.rsplit(" : ", 1)[1])
+    return []
+
+
 def parse_hlo_text(text: str) -> HloProgram:
     """Parse a lowered-program dump into functions, statements and
     regions. Forgiving by design: lines that match no construct are
@@ -312,6 +363,7 @@ def parse_hlo_text(text: str) -> HloProgram:
                     index=int(am.group(1)),
                     type_bytes=tensor_type_bytes(am.group(2)),
                     sharding=shard.group(1) if shard else "",
+                    dtype=tensor_type_dtype(am.group(2)),
                     aliased_output=(int(alias.group(1)) if alias
                                     else None),
                     buffer_donor=bool(_DONOR_RE.search(attrs))))
@@ -324,7 +376,8 @@ def parse_hlo_text(text: str) -> HloProgram:
                     index=i,
                     type_bytes=tensor_type_bytes(rm.group(1)),
                     sharding=shard.group(1) if shard else "",
-                    result_info=info_m.group(1) if info_m else ""))
+                    result_info=info_m.group(1) if info_m else "",
+                    dtype=tensor_type_dtype(rm.group(1))))
             cur = HloFunction(name=name, args=args, results=results,
                               statements=[], lineno=lineno)
             funcs[name] = cur
@@ -351,9 +404,12 @@ def parse_hlo_text(text: str) -> HloProgram:
             if cur_depth <= pending_region_depth:
                 # region closed: the `}) : (A) -> R` line carries the types
                 pending_stmt["out_bytes"] = _statement_out_bytes(line)
-                pending_stmt["payload_bytes"] = (
-                    _types_bytes(line.rsplit(":", 1)[1].split("->")[0])
-                    if ":" in line else [])
+                pending_stmt["out_dtypes"] = _statement_out_dtypes(line)
+                operand_seg = (line.rsplit(":", 1)[1].split("->")[0]
+                               if ":" in line else "")
+                pending_stmt["payload_bytes"] = _types_bytes(operand_seg)
+                pending_stmt["payload_dtypes"] = _types_dtypes(operand_seg)
+                pending_stmt["payload_elems"] = _types_elems(operand_seg)
                 _finish_statement(cur, pending_stmt)
                 pending_stmt = None
             continue
@@ -388,7 +444,8 @@ def parse_hlo_text(text: str) -> HloProgram:
                 out_bytes=_statement_out_bytes(line),
                 lineno=lineno,
                 loop_depth=len(loop_starts) + pending_loops,
-                call_target=target_m.group(1) if target_m else "")
+                call_target=target_m.group(1) if target_m else "",
+                out_dtypes=_statement_out_dtypes(line))
             cls = COLLECTIVE_CLASS.get(op)
             if cls is not None and opens > closes:
                 # region-carrying collective: its `(A) -> R` signature is
@@ -402,10 +459,13 @@ def parse_hlo_text(text: str) -> HloProgram:
                 continue
             if cls is not None:
                 # region-free collective (collective_permute, all_to_all)
-                payload = _types_bytes(line.split("->")[0].rsplit(":", 1)[-1]
-                                       if ":" in line else "")
+                operand_seg = (line.split("->")[0].rsplit(":", 1)[-1]
+                               if ":" in line else "")
                 _attach_collective(stmt, cls, _parse_replica_groups(line),
-                                   _channel_of(line), payload)
+                                   _channel_of(line),
+                                   _types_bytes(operand_seg),
+                                   _types_dtypes(operand_seg),
+                                   _types_elems(operand_seg))
             cur.statements.append(stmt)
 
         # -------- region bookkeeping (lowered.py's brace machinery,
@@ -442,20 +502,28 @@ def _channel_of(line: str) -> int:
 
 
 def _attach_collective(stmt: HloStatement, cls: str, groups, channel,
-                       payload: List[int]):
+                       payload: List[int],
+                       payload_dtypes: Optional[List[str]] = None,
+                       payload_elems: Optional[List[int]] = None):
+    dtypes = payload_dtypes or stmt.out_dtypes
     stmt.collective = CollectiveOp(  # type: ignore[attr-defined]
         kind=cls, op=stmt.op,
         payload_bytes=sum(payload) or stmt.total_out_bytes,
         result_bytes=stmt.total_out_bytes,
         replica_groups=groups, channel=channel,
-        lineno=stmt.lineno, loop_depth=stmt.loop_depth)
+        lineno=stmt.lineno, loop_depth=stmt.loop_depth,
+        elem_dtype=dtypes[0] if dtypes else "",
+        payload_elems=sum(payload_elems or []))
 
 
 def _finish_statement(func: HloFunction, pending: dict):
     stmt: HloStatement = pending["stmt"]
     stmt.out_bytes = pending["out_bytes"]
+    stmt.out_dtypes = pending.get("out_dtypes", [])
     _attach_collective(stmt, pending["class"], pending["groups"],
-                       pending["channel"], pending["payload_bytes"])
+                       pending["channel"], pending["payload_bytes"],
+                       pending.get("payload_dtypes"),
+                       pending.get("payload_elems"))
     func.statements.append(stmt)
 
 

@@ -217,6 +217,10 @@ def lint_runner(runner, batch, state=None,
     two entry points from drifting. ``fuse_steps=k > 1`` lints the fused
     k-microstep scan program instead: its scan body is the microstep, so
     ADT408 findings there mean a per-microstep host round-trip survived
-    the fusion."""
+    the fusion. The ADT60x numerics dtype-flow pass
+    (``analysis/numerics.py``) rides the same lowered text."""
+    from autodist_tpu.analysis import numerics
     text = runner.lowered_text(batch, state, fuse_steps=fuse_steps)
-    return lint_lowered_text(text, mp_full_shapes_of(runner.distributed_step))
+    out = lint_lowered_text(text, mp_full_shapes_of(runner.distributed_step))
+    out.extend(numerics.lint_text(text))
+    return sort_diagnostics(out)

@@ -1,0 +1,238 @@
+"""ADT6xx numerics-safety analysis: a dtype-flow pass over the lowering.
+
+The bf16 compute tier (``GraphConfig.compute_dtype = "bf16"``) is only
+shippable if something *static* proves a plan is numerically sound before
+a single compile — the same contract ADT501 provides for memory and
+ADT310 for the quantized wire. The discipline being certified is the
+f32-master rule of mixed-precision training (arXiv 2004.13336): **low-
+precision compute is allowed, low-precision ACCUMULATION and low-
+precision MASTER STATE are not.** Concretely:
+
+- gradients may be *computed* in bf16, but the cross-replica sum
+  (psum / reduce-scatter) must run on f32 values — summing P bf16
+  gradients loses low-order bits at every hop (ADT601);
+- the authoritative parameter copy and the optimizer state must live in
+  f32; a parameter that round-trips ``f32 -> bf16 -> f32`` has silently
+  absorbed bf16 rounding into its master (ADT602);
+- the loss / sentinel verdict must be f32 — the divergence sentinel's
+  EWMA judges these values, and judging rounded values moves the
+  skip/rollback thresholds (ADT603);
+- two programs on one mesh must agree on collective *dtypes*, not just
+  kinds and groups — an f32 sender rendezvousing with a bf16 receiver is
+  the ADT511 deadlock with a sharper diagnosis (ADT605).
+
+Two layers, matching the memory analyzer's split:
+
+- :func:`lint_text` — the dtype-flow pass over one lowered program's
+  text (ADT601/602/603). Works on any ``as_text()`` dump; no re-lowering.
+- :func:`compare_schedule_dtypes` — the cross-program check (ADT605),
+  the dtype analog of ``hlo.compare_schedules``.
+
+Plan-level rules (ADT601/602/604 before any trace) live in
+``analysis/rules.py`` (``verify_numerics``); both layers report through
+the same :class:`Diagnostic` shape and stable codes.
+"""
+from typing import Dict, List, Mapping, Optional
+
+from autodist_tpu.analysis.diagnostics import (Diagnostic, error,
+                                               sort_diagnostics, warning)
+from autodist_tpu.analysis.hlo import (HALF_DTYPES, CollectiveSchedule,
+                                       HloProgram, _as_schedule,
+                                       parse_hlo_text)
+
+# Ops that carry a value through unchanged (element-for-element) — the
+# only edges the f32-master taint may propagate across. Anything doing
+# arithmetic (dot, add, reduce) legitimately *derives* a new value, so a
+# later cast back to f32 is not a master round-trip.
+VALUE_PRESERVING_OPS = frozenset({
+    "convert", "reshape", "transpose", "copy", "optimization_barrier",
+})
+
+# Collective classes that ACCUMULATE (sum across replicas) — the ones
+# whose element dtype is an accumulator dtype. Gathers/permutes only move
+# bits, so a half-precision payload there is lossless.
+_ACCUMULATING_KINDS = frozenset({"reduce", "scatter"})
+
+
+def _half_width(dtype: str) -> int:
+    return 2 if dtype in HALF_DTYPES else 4
+
+
+def lint_text(text_or_program, label: str = "") -> List[Diagnostic]:
+    """Dtype-flow lint of one lowered program (ADT601/602/603).
+
+    Accepts program text or a pre-parsed :class:`HloProgram`. Forgiving
+    like the parser: a dump without dtype annotations produces no
+    findings rather than an exception.
+    """
+    program = (text_or_program if isinstance(text_or_program, HloProgram)
+               else parse_hlo_text(text_or_program))
+    where = " in %s" % label if label else ""
+    out: List[Diagnostic] = []
+
+    # ---- ADT601 / ADT603: accumulating collectives in half precision
+    for coll in program.collectives():
+        if coll.kind not in _ACCUMULATING_KINDS:
+            continue
+        if coll.elem_dtype not in HALF_DTYPES:
+            continue
+        elems = coll.payload_elems
+        if elems == 0 and coll.payload_bytes:
+            elems = coll.payload_bytes // _half_width(coll.elem_dtype)
+        if elems > 1:
+            out.append(error(
+                "ADT601",
+                "%s accumulation in %s%s: %s at line %d sums %d %s "
+                "elements across replicas — every hop of the reduction "
+                "rounds, so the gradient sum loses low-order bits that "
+                "f32 accumulation would keep" % (
+                    coll.elem_dtype, coll.op, where, coll.describe(),
+                    coll.lineno, elems, coll.elem_dtype),
+                fixit="cast the operand to f32 before the collective "
+                      "(bf16 compute, f32 accumulation) — the built-in "
+                      "bf16 lowering does this"))
+        else:
+            # a SCALAR half-precision cross-replica sum is almost
+            # certainly the loss / grad-norm mean — rounded before the
+            # sentinel ever sees it
+            out.append(warning(
+                "ADT603",
+                "scalar %s %s%s at line %d: a cross-replica scalar sum "
+                "in half precision is a loss/verdict computed on rounded "
+                "values — the sentinel's EWMA judges what it is given" % (
+                    coll.elem_dtype, coll.op, where, coll.lineno),
+                fixit="cast the loss to f32 before the pmean"))
+
+    # ---- ADT602: f32 master destroyed by a value-preserving round-trip
+    out.extend(_master_roundtrips(program, where))
+
+    # ---- ADT603: entry returns a half-precision scalar (rounded loss)
+    entry = program.entry
+    if entry is not None:
+        for res in entry.results:
+            if res.dtype in HALF_DTYPES and res.type_bytes <= 2:
+                out.append(warning(
+                    "ADT603",
+                    "entry result #%d%s is a %s scalar — a loss/metric "
+                    "returned in half precision feeds rounded values to "
+                    "everything that judges it (sentinel EWMA, early "
+                    "stopping, logging)" % (res.index, where, res.dtype),
+                    fixit="compute and return the loss in f32"))
+    return sort_diagnostics(out)
+
+
+def _master_roundtrips(program: HloProgram, where: str) -> List[Diagnostic]:
+    """Find f32 entry values that flow ``f32 -> half -> f32`` through
+    value-preserving ops only: the produced f32 value *is* the rounded
+    half value, so any consumer (a returned "updated" param above all)
+    has lost the master copy."""
+    entry = program.entry
+    if entry is None:
+        return []
+    # taint: value id -> ("master", origin) | ("half", origin)
+    taint: Dict[str, tuple] = {}
+    for a in entry.args:
+        if a.dtype == "f32":
+            taint["arg%d" % a.index] = ("master", a.index)
+    if not taint:
+        return []
+    used: set = set()
+    for st in entry.statements:
+        used.update(st.operand_ids)
+    out: List[Diagnostic] = []
+    flagged: set = set()
+    for st in entry.statements:
+        if st.op not in VALUE_PRESERVING_OPS or not st.result_id:
+            continue
+        src = next((taint[o] for o in st.operand_ids if o in taint), None)
+        if src is None:
+            continue
+        state, origin = src
+        dt = st.out_dtype
+        if st.op == "convert":
+            if state == "master" and dt in HALF_DTYPES:
+                taint[st.result_id] = ("half", origin)
+            elif state == "half" and dt == "f32":
+                if (st.result_id in used
+                        or st.result_id in entry.returned_ids):
+                    if origin not in flagged:
+                        flagged.add(origin)
+                        out.append(error(
+                            "ADT602",
+                            "f32 master destroyed%s: %%arg%d round-trips "
+                            "f32 -> half -> f32 through value-preserving "
+                            "ops (cast back at line %d) — the 'f32' "
+                            "result carries bf16 rounding, so no "
+                            "authoritative copy survives the step" % (
+                                where, origin, st.lineno),
+                            fixit="keep the f32 master out of the half "
+                                  "cast chain: update params from f32 "
+                                  "grads and only cast a COPY down for "
+                                  "compute"))
+            elif dt == "f32" or dt in HALF_DTYPES:
+                # convert within the same precision class keeps the state
+                taint[st.result_id] = (state, origin)
+        else:
+            taint[st.result_id] = (state, origin)
+    return out
+
+
+def compare_schedule_dtypes(ref, other, ref_label: str = "train",
+                            other_label: str = "eval") -> List[Diagnostic]:
+    """Cross-program collective DTYPE consistency (ADT605).
+
+    The dtype analog of ``hlo.compare_schedules``: two programs whose
+    collectives are order-compatible (same kind, groups and element
+    count at matching positions) but disagree on the element dtype will
+    pass the shape-level checks right up until one side feeds bf16 words
+    into an f32 rendezvous. Accepts schedules, programs, or raw text.
+    """
+    ref_sched: CollectiveSchedule = _as_schedule(ref).per_step()
+    other_sched: CollectiveSchedule = _as_schedule(other).per_step()
+    out: List[Diagnostic] = []
+    it = iter(ref_sched)
+    for oc in other_sched:
+        if not (oc.elem_dtype and oc.payload_elems):
+            continue
+        for rc in it:
+            if (rc.kind == oc.kind
+                    and rc.replica_groups == oc.replica_groups
+                    and rc.payload_elems == oc.payload_elems
+                    and rc.elem_dtype and rc.payload_elems):
+                if rc.elem_dtype != oc.elem_dtype:
+                    out.append(error(
+                        "ADT605",
+                        "%s and %s programs disagree on the element dtype "
+                        "of an order-compatible %s collective: %s sends "
+                        "%s, %s sends %s (%d elements, lines %d/%d) — the "
+                        "rendezvous exchanges mistyped words" % (
+                            ref_label, other_label, oc.kind, ref_label,
+                            rc.elem_dtype, other_label, oc.elem_dtype,
+                            oc.payload_elems, rc.lineno, oc.lineno),
+                        fixit="build both programs from one compiled "
+                              "strategy with one compute_dtype"))
+                break
+    return sort_diagnostics(out)
+
+
+def lint_programs(programs: Mapping[str, str],
+                  parsed: Optional[Dict[str, HloProgram]] = None
+                  ) -> List[Diagnostic]:
+    """Numerics lint over a set of same-mesh programs: the per-program
+    dtype-flow pass on each, plus pairwise dtype alignment (ADT605)
+    against the first program (the reference, mirroring the CLI's
+    cross-program schedule mode)."""
+    out: List[Diagnostic] = []
+    names = list(programs)
+    progs = {}
+    for name in names:
+        prog = (parsed or {}).get(name)
+        if prog is None:
+            prog = parse_hlo_text(programs[name])
+        progs[name] = prog
+        out.extend(lint_text(prog, label=name))
+    for name in names[1:]:
+        out.extend(compare_schedule_dtypes(
+            progs[names[0]], progs[name],
+            ref_label=names[0], other_label=name))
+    return sort_diagnostics(out)

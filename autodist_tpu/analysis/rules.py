@@ -700,6 +700,121 @@ def _r_zero_sharded(ctx: Context) -> Iterable[Diagnostic]:
                   "the whole plan on one discipline")
 
 
+# ----------------------------------------------------- ADT6xx numerics rules
+
+_COMPUTE_DTYPES = ("f32", "bf16")
+
+
+def _stored_half(info_) -> bool:
+    """Is this variable's RESIDENT storage half precision? (``VarInfo``
+    dtypes stringify as numpy names: ``bfloat16`` / ``float16``.)"""
+    dt = str(getattr(info_, "dtype", "float32"))
+    return "bfloat16" in dt or "float16" in dt
+
+
+@rule
+def _r_numerics(ctx: Context) -> Iterable[Diagnostic]:
+    """ADT601/ADT602 at plan level — the f32-master discipline, provable
+    before any trace (docs/performance.md):
+
+    - a trainable variable STORED in bf16/f16 accumulates its gradient in
+      that dtype (psum / PS-sum of half words — ADT601) *and* has no
+      authoritative f32 copy to update (ADT602). ``ZeroSharded`` is
+      exempt from both: its flat-shard math runs in f32 (``_pad_flat``
+      casts up before the reduce-scatter) and the sharded optimizer step
+      owns an f32 view — the arXiv 2004.13336 contract.
+    - the managed bf16 tier (``compute_dtype="bf16"``) keeps params f32
+      and casts a COPY down inside the loss, so it trips neither; an
+      unknown tier is an ADT602 error because the lowering can guarantee
+      nothing about it.
+    """
+    gc = ctx.strategy.graph_config
+    cd = getattr(gc, "compute_dtype", "f32") or "f32"
+    if cd not in _COMPUTE_DTYPES:
+        yield error(
+            "ADT602",
+            "unknown compute_dtype %r (allowed: %s) — the lowering "
+            "cannot guarantee an f32 master for an unknown compute tier"
+            % (cd, "/".join(_COMPUTE_DTYPES)),
+            fixit="use compute_dtype='bf16' (f32 master, bf16 compute) "
+                  "or leave it 'f32'")
+    for node in ctx.strategy.node_config:
+        info_ = ctx.var_infos.get(node.var_name)
+        if info_ is None or node.var_name not in ctx.trainable:
+            continue
+        if not _stored_half(info_):
+            continue
+        syncs = [s for _, s in ctx.synchronizers(node)]
+        if syncs and all(_is_zero(s) for s in syncs):
+            continue  # f32 shard math + f32 opt state: master survives
+        dt = str(getattr(info_, "dtype", ""))
+        yield error(
+            "ADT601",
+            "trainable %r is stored in %s: its gradient accumulates in "
+            "half precision (every psum/PS-sum hop rounds the running "
+            "sum)" % (node.var_name, dt), var=node.var_name,
+            fixit="store params in f32 and set compute_dtype='bf16' "
+                  "(the lowering casts a copy down for compute), or "
+                  "sync via ZeroSharded (f32 shard accumulation)")
+        yield error(
+            "ADT602",
+            "trainable %r is stored in %s with no f32 master copy — "
+            "every optimizer apply rounds into the only copy of the "
+            "weights" % (node.var_name, dt), var=node.var_name,
+            fixit="keep the resident params f32 (compute_dtype='bf16' "
+                  "gives the speed without losing the master), or use "
+                  "ZeroSharded for an f32-sharded update")
+
+
+def verify_numerics(strategy, model_item=None, resource_spec=None,
+                    sentinel_policy=None, metadata=None) -> List[Diagnostic]:
+    """ADT6xx — full plan-level numerics verdict for one strategy, no
+    trace/lower/compile (the ADT501 pattern). Runs the registered
+    ADT601/602 rule plus the two checks that need context :func:`verify`
+    does not carry:
+
+    - ``ADT603`` (warning): half-stored params WITHOUT the managed bf16
+      tier — the loss inherits the compute dtype, so the value the
+      divergence sentinel's EWMA judges is rounded before it is seen.
+      (The managed tier casts the loss to f32 by construction, so
+      ``compute_dtype="bf16"`` alone never trips this.)
+    - ``ADT604`` (warning): half-precision compute armed with no enabled
+      sentinel policy — aggressive precision with no skip/rollback net.
+
+    ``metadata`` (a lowered ``DistributedStep.metadata``) is optional; it
+    only sharpens messages, never gates a finding.
+    """
+    ctx = Context(strategy, model_item, resource_spec)
+    out = list(_r_numerics(ctx))
+    gc = strategy.graph_config
+    cd = getattr(gc, "compute_dtype", "f32") or "f32"
+    half_vars = sorted(
+        n.var_name for n in strategy.node_config
+        if n.var_name in ctx.trainable
+        and _stored_half(ctx.var_infos.get(n.var_name)))
+    half_armed = cd == "bf16" or bool(half_vars)
+    if half_vars and cd != "bf16":
+        out.append(warning(
+            "ADT603",
+            "loss/verdict will be computed in half precision: trainable "
+            "%s stored in bf16/f16 without the managed compute tier — "
+            "the sentinel's EWMA judges rounded loss values"
+            % (half_vars[:3],), var=half_vars[0],
+            fixit="store params f32 with compute_dtype='bf16' (the "
+                  "lowering keeps the loss f32)"))
+    if half_armed and not getattr(sentinel_policy, "enabled", False):
+        out.append(warning(
+            "ADT604",
+            "half-precision compute (%s) is armed without an enabled "
+            "sentinel policy — a loss spike from precision loss has no "
+            "skip/rollback net"
+            % ("compute_dtype=bf16" if cd == "bf16"
+               else "bf16/f16 params"),
+            fixit="arm SentinelPolicy(enabled=True) (docs/sentinel.md) "
+                  "when training in half precision"))
+    return sort_diagnostics(out)
+
+
 # ------------------------------------------------------------- ADT4xx rules
 
 

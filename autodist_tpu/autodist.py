@@ -186,12 +186,14 @@ class AutoDist:
 
     # ------------------------------------------------------------- build path
 
-    def _verify_strategy(self, strategy: Strategy, item: ModelItem):
+    def _verify_strategy(self, strategy: Strategy, item: ModelItem,
+                         sentinel_policy=None):
         """Static verification BEFORE kernel transformation
         (``analysis/rules.py`` + the plan-level memory gate of
         ``analysis/memory.py``): whole failure classes — malformed
         partitioners, dangling PS destinations, sync/compressor
-        mismatches, and a projected per-device OOM against the chip's
+        mismatches, numerics-safety violations of the bf16 compute tier
+        (ADT60x), and a projected per-device OOM against the chip's
         HBM capacity (ADT501) — surface here as typed diagnostics
         instead of ``ValueError``s deep in the lowering (or collective
         deadlocks / allocation failures at runtime)."""
@@ -201,6 +203,16 @@ class AutoDist:
         from autodist_tpu.analysis.diagnostics import (
             Severity, StrategyVerificationError)
         diags = list(verify(strategy, item, self._resource_spec))
+        # the registered rules already cover the ADT601/602 errors; the
+        # numerics entry point adds the sentinel-aware warnings (ADT603
+        # loss-tier, ADT604 sentinel-less half precision) that need the
+        # resolved policy this build is actually arming
+        from autodist_tpu.analysis.rules import verify_numerics
+        seen = {(d.code, d.message) for d in diags}
+        diags += [d for d in verify_numerics(
+            strategy, item, self._resource_spec,
+            sentinel_policy=sentinel_policy)
+            if (d.code, d.message) not in seen]
         try:
             from autodist_tpu.analysis import memory as memory_lib
             diags += memory_lib.plan_memory_report(
@@ -320,7 +332,7 @@ class AutoDist:
                          trainable_filter=trainable_filter,
                          mp_rules=mp_rules, mp_meta=mp_meta).prepare()
         strategy = self._build_or_load_strategy(item)
-        self._verify_strategy(strategy, item)
+        self._verify_strategy(strategy, item, sentinel_policy=policy)
         compiled = StrategyCompiler(item, self._resource_spec).compile(strategy)
         logging.info("compiled %r", compiled)
         logging.debug("compiled strategy:\n%s", compiled)
@@ -550,7 +562,7 @@ class AutoDist:
         item = ModelItem(step_fn=step_fn, params=state,
                          example_batch=example_batch).prepare()
         strategy = self._build_or_load_strategy(item)
-        self._verify_strategy(strategy, item)
+        self._verify_strategy(strategy, item, sentinel_policy=policy)
         compiled = StrategyCompiler(item, self._resource_spec).compile(strategy)
         logging.info("compiled %r (step_fn mode)", compiled)
         if self._validate_async(compiled, item):

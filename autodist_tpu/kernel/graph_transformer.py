@@ -1346,12 +1346,44 @@ class GraphTransformer:
             from autodist_tpu.strategy.remat import remat_transform
             return remat_transform(remat)(f)
 
-        grad_fn = jax.value_and_grad(remat_wrap(item.loss_fn),
+        # ----- managed bf16 compute tier (graph_config.compute_dtype):
+        # cast f32 params and float batch leaves down INSIDE the loss, so
+        # grads w.r.t. the f32 master come back f32 (the convert's
+        # transpose casts up) and every gradient psum accumulates in f32;
+        # cast the loss (and bf16 aux) back up so the pmean and the
+        # sentinel verdict judge full-precision values — exactly the
+        # shape the ADT601/602/603 numerics rules certify
+        compute_dtype = (getattr(self._strategy.graph_config,
+                                 "compute_dtype", "f32") or "f32")
+        if compute_dtype == "bf16":
+            def _cd_down(x):
+                x = jnp.asarray(x)
+                return (x.astype(jnp.bfloat16)
+                        if x.dtype == jnp.float32 else x)
+
+            def _cd_up(x):
+                x = jnp.asarray(x)
+                return (x.astype(jnp.float32)
+                        if x.dtype == jnp.bfloat16 else x)
+
+            def loss_fn_cd(params, batch):
+                out = item.loss_fn(
+                    jax.tree_util.tree_map(_cd_down, params),
+                    jax.tree_util.tree_map(_cd_down, batch))
+                if item.has_aux:
+                    loss, aux = out
+                    return (_cd_up(loss),
+                            jax.tree_util.tree_map(_cd_up, aux))
+                return _cd_up(out)
+        else:
+            loss_fn_cd = item.loss_fn
+
+        grad_fn = jax.value_and_grad(remat_wrap(loss_fn_cd),
                                      has_aux=item.has_aux)
         if sparse_wire:
             def loss_with_taps(full_params, taps, batch):
                 with embedding_lib.capture(taps) as cap:
-                    out = item.loss_fn(full_params, batch)
+                    out = loss_fn_cd(full_params, batch)
                 loss, aux = (out if item.has_aux else (out, None))
                 return loss, (aux, cap.ids)
             sparse_grad_fn = jax.value_and_grad(
@@ -1730,7 +1762,7 @@ class GraphTransformer:
                 layout_tree)
             full_params = (ps_lib.fill_holes(gathered, ps_vals)
                            if ps_names else gathered)
-            out = item.loss_fn(full_params, batch)
+            out = loss_fn_cd(full_params, batch)
             loss, aux = (out if has_aux else (out, None))
             metrics = {"loss": jax.lax.pmean(loss, all_axes)}
             if aux is not None:
@@ -2020,6 +2052,10 @@ class GraphTransformer:
             # health guards compiled into the program? (the ADT420 lint
             # and the Runner's policy both consult this)
             "sentinel_guards": guard,
+            # "f32" | "bf16" — the compute tier this program lowered with
+            # (f32 master params/opt-state/accumulation either way; the
+            # ADT60x numerics lints and step_stats report it)
+            "compute_dtype": compute_dtype,
             "grad_fault_plan": grad_plan.describe(),
         }
         logging.info("GraphTransformer: lowered %d vars (%d partitioned, "

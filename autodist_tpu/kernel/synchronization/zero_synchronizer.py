@@ -194,9 +194,14 @@ class ZeroSynchronizer:
         """The per-replica optimizer-state shard template (a little
         ``{"v": [shard_elems]}`` tree through ``optimizer.init``) —
         host-side numpy leaves, broadcast by the lowering's
-        ``sync_state_init`` into the leading-device-axis layout."""
+        ``sync_state_init`` into the leading-device-axis layout.
+
+        Always f32, whatever the resident param dtype: ``local_shard``
+        hands the optimizer an f32 view and the ADT602 numerics rule
+        exempts ZeroSharded precisely because the sharded update's state
+        and arithmetic keep full precision (arXiv 2004.13336)."""
         init = optimizer.init(
-            {"v": jnp.zeros((self.shard_elems,), self.dtype)})
+            {"v": jnp.zeros((self.shard_elems,), jnp.float32)})
         return jax.tree_util.tree_map(np.asarray, init)
 
     def unshard_host(self, leading_arr) -> np.ndarray:

@@ -1055,6 +1055,12 @@ class Runner:
         import statistics
         micro, sup = self._step_count, self._superstep_count
         out = {"steps": micro, "supersteps": sup, "microsteps": micro,
+               # which compute tier the step program runs in ("f32" or
+               # "bf16") — monitoring needs it to interpret loss jitter
+               # and examples/s side by side across precision configs
+               "compute_dtype": getattr(
+                   getattr(self, "_dstep", None), "metadata",
+                   {}).get("compute_dtype", "f32"),
                "total_s": round(self._total_step_s, 6),
                "first_step_s": (round(self._first_step_s, 6)
                                 if self._first_step_s is not None else None),

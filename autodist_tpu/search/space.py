@@ -53,6 +53,12 @@ _MATRIX_COMPRESSORS = _DENSE_COMPRESSORS + ("PowerSGDCompressor:2",)
 # wire formats the search offers per variable (dense float, >= one scale
 # block — ADT310/311 are excluded BY CONSTRUCTION, never emitted)
 WIRE_DTYPES = ("fp32", "int8")
+# plan-level compute tiers (GraphConfig.compute_dtype): "bf16" lowers the
+# forward/backward in bfloat16 while master params, optimizer state, the
+# gradient collectives and the loss stay f32 — the only combination the
+# ADT60x numerics rules accept, so the knob is a single safe bit and
+# every invalid mixed-precision shape is excluded BY CONSTRUCTION
+COMPUTE_DTYPES = ("f32", "bf16")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -94,6 +100,7 @@ class PlanSpec:
     chunk_size: int = 128
     staleness: int = 0
     remat: Optional[str] = None
+    compute_dtype: str = "f32"
 
     def choice_map(self) -> Dict[str, VarChoice]:
         return dict(self.choices)
@@ -125,6 +132,8 @@ class PlanSpec:
             bits.append("stale=%d" % self.staleness)
         if self.remat:
             bits.append("remat=%s" % self.remat)
+        if self.compute_dtype != "f32":
+            bits.append("compute=%s" % self.compute_dtype)
         return "plan[%s]" % ",".join(bits)
 
 
@@ -237,7 +246,8 @@ class PlanSpace:
                          zero=zero)
 
     def make_plan(self, choices: Dict[str, VarChoice], chunk_size: int = 128,
-                  staleness: int = 0, remat: Optional[str] = None) -> PlanSpec:
+                  staleness: int = 0, remat: Optional[str] = None,
+                  compute_dtype: str = "f32") -> PlanSpec:
         canon = tuple((n, self.canon(choices.get(n, VarChoice()), n))
                       for n in self.var_names)
         if any(c.zero for _, c in canon):
@@ -246,8 +256,14 @@ class PlanSpace:
             # in the SPEC (not just at materialization) so describe(),
             # dedup, and the built strategy all agree
             staleness = 0
+        if compute_dtype not in COMPUTE_DTYPES:
+            # ADT602 by construction: an unknown compute tier has no
+            # f32-master guarantee — clamp rather than emit an invalid
+            # plan (only the managed tiers exist in this space)
+            compute_dtype = "f32"
         return PlanSpec(choices=canon, chunk_size=chunk_size,
-                        staleness=staleness, remat=remat)
+                        staleness=staleness, remat=remat,
+                        compute_dtype=compute_dtype)
 
     # ---------------------------------------------------------------- seeds
 
@@ -321,6 +337,11 @@ class PlanSpace:
             ("seed:zero-int8w", self.make_plan(zero_int8)),
             ("seed:ar-remat", self.make_plan(ar, chunk_size=512,
                                              remat="dots")),
+            # the managed bf16 compute tier (f32 master — ADT60x-clean by
+            # construction), alone and beside the ZeRO f32-sharded update
+            ("seed:ar-bf16c", self.make_plan(ar, compute_dtype="bf16")),
+            ("seed:zero-bf16c", self.make_plan(zero,
+                                               compute_dtype="bf16")),
         ]
         return out
 
@@ -381,7 +402,11 @@ class PlanSpace:
             if canon.shards != choice.shards:
                 return None  # partitioning this space cannot express
             choices[name] = canon
-        return self.make_plan(choices, staleness=staleness, remat=gc.remat)
+        cd = getattr(gc, "compute_dtype", "f32") or "f32"
+        if cd not in COMPUTE_DTYPES:
+            return None  # an unmanaged compute tier: outside the space
+        return self.make_plan(choices, staleness=staleness, remat=gc.remat,
+                              compute_dtype=cd)
 
     # ------------------------------------------------------------ mutations
 
@@ -514,6 +539,14 @@ class PlanSpace:
 
         ops.append(set_remat)
 
+        def set_compute_dtype():
+            opts = [d for d in COMPUTE_DTYPES if d != plan.compute_dtype]
+            d = opts[rng.randrange(len(opts))]
+            return (dataclasses.replace(plan, compute_dtype=d),
+                    "compute=%s" % d)
+
+        ops.append(set_compute_dtype)
+
         if not ops:
             return None
         op = ops[rng.randrange(len(ops))]
@@ -602,5 +635,6 @@ class PlanSpace:
                         sync=True, staleness=staleness,
                         wire_dtype=c.wire_dtype)))
         return Strategy(node_config=nodes,
-                        graph_config=GraphConfig(replicas=list(self.replicas),
-                                                 remat=plan.remat))
+                        graph_config=GraphConfig(
+                            replicas=list(self.replicas), remat=plan.remat,
+                            compute_dtype=plan.compute_dtype))

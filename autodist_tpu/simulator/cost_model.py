@@ -33,6 +33,14 @@ from autodist_tpu.resource_spec import CHIP_HBM_BYTES  # noqa: E402,F401
 # forward in the backward (fwd+bwd ~3x fwd -> ~4x), "dots" recomputes
 # only the cheap non-contraction work (~3.5x)
 REMAT_COMPUTE_FACTOR = {None: 1.0, "full": 4.0 / 3.0, "dots": 3.5 / 3.0}
+# step-time gain of the managed bf16 compute tier
+# (graph_config.compute_dtype="bf16") over the f32 baseline the model is
+# calibrated against: the MXU runs bf16 matmuls at ~2x the f32 rate and
+# halves the activation traffic, but the f32 master update, the casts and
+# the f32 gradient collectives claw some back — ~1.8x is the typical
+# measured envelope, conservative enough that the searcher only picks
+# bf16 when the plan is genuinely compute-bound
+BF16_COMPUTE_SPEEDUP = 1.8
 # Price of the fused 1F1B implementation (parallel/pipeline._run_1f1b):
 # 2(M+S-1) ticks whose lax.cond body executes ONE of {stage forward,
 # recompute+backward vjp} per tick (parity is uniform over model/data
@@ -513,6 +521,10 @@ class CostModel:
         else:
             act = total_act + batch_in
         act /= n  # activations scale with the per-device batch shard
+        if getattr(strategy.graph_config, "compute_dtype", "f32") == "bf16":
+            # the managed bf16 tier stores residuals at half width (params,
+            # opt state, and the gradient buffer stay f32 — the master)
+            act *= 0.5
         # 1F1B pipeline schedule: at most S microbatches in flight per
         # rank vs GPipe's all-M residency (Narayanan et al. 1806.03377)
         from autodist_tpu import const as _const
@@ -703,6 +715,12 @@ class CostModel:
         remat_factor = REMAT_COMPUTE_FACTOR.get(
             strategy.graph_config.remat, 1.0)
         compute_s = self.compute_time(n) * remat_factor
+        if getattr(strategy.graph_config, "compute_dtype",
+                   "f32") == "bf16":
+            # managed bf16 tier: forward/backward at the bf16 MXU rate;
+            # master params, opt state and gradient collectives stay f32,
+            # so only the compute term moves (wire terms are unchanged)
+            compute_s /= BF16_COMPUTE_SPEEDUP
         # GPipe bubble: S stages over M microbatches keep each device
         # busy M/(S-1+M) of the schedule (Huang et al. 1811.06965)
         from autodist_tpu import const as _const

@@ -20,7 +20,7 @@ from autodist_tpu.strategy.ps_strategy import replica_devices
 class AllReduce(StrategyBuilder):
     def __init__(self, chunk_size: int = 128, all_reduce_spec: str = "AUTO",
                  compressor: str = "NoneCompressor",
-                 wire_dtype: str = "fp32"):
+                 wire_dtype: str = "fp32", compute_dtype: str = "f32"):
         if chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
         self.chunk_size = chunk_size
@@ -29,6 +29,9 @@ class AllReduce(StrategyBuilder):
         # "int8": blockwise-quantized two-phase all-reduce wire (dense
         # float vars only; sparse/integer vars keep fp32 — ADT310)
         self.wire_dtype = wire_dtype
+        # "bf16": managed bf16 compute tier (f32 master params/opt-state/
+        # accumulation — the shape rules.verify_numerics certifies)
+        self.compute_dtype = compute_dtype
 
     def build(self, model_item, resource_spec) -> Strategy:
         from autodist_tpu.parallel.collectives import wire_quantizable
@@ -46,4 +49,6 @@ class AllReduce(StrategyBuilder):
                     group=idx // self.chunk_size,
                     wire_dtype=(self.wire_dtype if quantizable else "fp32"))))
         return Strategy(node_config=nodes,
-                        graph_config=GraphConfig(replicas=replica_devices(resource_spec)))
+                        graph_config=GraphConfig(
+                            replicas=replica_devices(resource_spec),
+                            compute_dtype=self.compute_dtype))

@@ -275,6 +275,13 @@ class GraphConfig:
     # fallback to dense sync — a >10x wire regression — raises in the
     # lowering instead of logging a warning. ADT_IS_TESTING implies it.
     require_sparse: bool = False
+    # compute tier: "f32" (default) or "bf16" — with "bf16" the lowering
+    # casts params and float batch leaves to bfloat16 for the forward/
+    # backward, while master params, optimizer state, gradient
+    # accumulation (every psum/reduce-scatter) and the loss/sentinel
+    # verdict stay f32 — the f32-master discipline the ADT60x numerics
+    # rules certify (analysis/numerics.py, rules.verify_numerics)
+    compute_dtype: str = "f32"
 
     def to_dict(self):
         return {"replicas": list(self.replicas), "mesh_shape": self.mesh_shape,
@@ -283,7 +290,8 @@ class GraphConfig:
                 "remat": self.remat, "pp_microbatches": self.pp_microbatches,
                 "pp_schedule": self.pp_schedule,
                 "pp_virtual": self.pp_virtual,
-                "require_sparse": self.require_sparse}
+                "require_sparse": self.require_sparse,
+                "compute_dtype": self.compute_dtype}
 
     @classmethod
     def from_dict(cls, d):
@@ -296,7 +304,8 @@ class GraphConfig:
                    pp_microbatches=d.get("pp_microbatches"),
                    pp_schedule=d.get("pp_schedule"),
                    pp_virtual=d.get("pp_virtual"),
-                   require_sparse=bool(d.get("require_sparse", False)))
+                   require_sparse=bool(d.get("require_sparse", False)),
+                   compute_dtype=d.get("compute_dtype", "f32") or "f32")
 
 
 # ----------------------------------------------------------------- strategy

@@ -21,7 +21,7 @@ def replica_devices(resource_spec):
 class PS(StrategyBuilder):
     def __init__(self, local_proxy_variable: bool = False, sync: bool = True,
                  staleness: int = 0, require_sparse: bool = False,
-                 wire_dtype: str = "fp32"):
+                 wire_dtype: str = "fp32", compute_dtype: str = "f32"):
         self._local_proxy_variable = local_proxy_variable
         self._sync = sync
         self._staleness = staleness
@@ -29,6 +29,8 @@ class PS(StrategyBuilder):
         # "int8": host<->device PS wire ships blockwise int8 + scales
         # (no-proxy dense float vars only; others keep fp32 — ADT310)
         self._wire_dtype = wire_dtype
+        # "bf16": managed bf16 compute tier (f32 master stays on the PS)
+        self._compute_dtype = compute_dtype
         if staleness > 0:
             assert sync, "staleness is only meaningful for sync training"
 
@@ -59,4 +61,5 @@ class PS(StrategyBuilder):
         return Strategy(node_config=nodes,
                         graph_config=GraphConfig(
                             replicas=replica_devices(resource_spec),
-                            require_sparse=self._require_sparse))
+                            require_sparse=self._require_sparse,
+                            compute_dtype=self._compute_dtype))

@@ -57,7 +57,8 @@ def zero_wire_quantizable(info, num_replicas: int) -> bool:
 
 
 class ZeroSharded(StrategyBuilder):
-    def __init__(self, chunk_size: int = 128, wire_dtype: str = "fp32"):
+    def __init__(self, chunk_size: int = 128, wire_dtype: str = "fp32",
+                 compute_dtype: str = "f32"):
         if chunk_size < 1:
             raise ValueError("chunk_size must be >= 1")
         # chunk_size buckets the AllReduce FALLBACK vars (small/sparse)
@@ -65,6 +66,9 @@ class ZeroSharded(StrategyBuilder):
         # "int8": blockwise-quantized rs + update all-gather wire (dense
         # float vars of >= one scale block only — ADT310/311)
         self.wire_dtype = wire_dtype
+        # "bf16": managed bf16 compute beside the f32 sharded master —
+        # the 2004.13336 pairing (bf16 compute, f32 shard update)
+        self.compute_dtype = compute_dtype
 
     def build(self, model_item, resource_spec) -> Strategy:
         n_replicas = max(len(resource_spec.devices), 1)
@@ -85,4 +89,5 @@ class ZeroSharded(StrategyBuilder):
                         group=idx // self.chunk_size)))
         return Strategy(node_config=nodes,
                         graph_config=GraphConfig(
-                            replicas=replica_devices(resource_spec)))
+                            replicas=replica_devices(resource_spec),
+                            compute_dtype=self.compute_dtype))

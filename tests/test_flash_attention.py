@@ -66,7 +66,44 @@ def test_bfloat16_forward():
     assert out.dtype == jnp.bfloat16
     ref = reference_attention(q.astype(jnp.float32), k.astype(jnp.float32),
                               v.astype(jnp.float32), _mask(shape[1], True))
+    # atol 3e-2: bf16 has 8 mantissa bits (~2-3 decimal digits); outputs
+    # are O(1) softmax-weighted averages, so one-ulp rounding is ~4e-3
+    # and the row-sum accumulation ~1e-2 — 3e-2 holds across seeds
     np.testing.assert_allclose(out.astype(jnp.float32), ref, atol=3e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_bfloat16_grads_match_f32_reference(causal):
+    """bf16 grads vs the f32 XLA reference — the correctness baseline
+    the analyzer's future attention-impl axis (and today's bf16 compute
+    tier, which runs this kernel in half precision) needs. Tolerances:
+    bf16 carries 8 mantissa bits, so single ops round at ~4e-3 relative;
+    the backward pass chains two matmuls and a softmax rescale per
+    block, compounding to ~1e-2 relative on O(1) gradients — atol/rtol
+    5e-2 gives ~4x margin over the observed worst case without masking a
+    wrong-formula bug (any algebraic error is O(1), not O(1e-2))."""
+    shape = (1, 256, 2, 32)
+    q, k, v = (_rand(shape, jnp.bfloat16, seed=i) for i in range(3))
+    mask = _mask(shape[1], causal)
+
+    def loss_flash(q, k, v):
+        return jnp.mean(flash_attention(q, k, v, causal)
+                        .astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.mean(reference_attention(q.astype(jnp.float32),
+                                            k.astype(jnp.float32),
+                                            v.astype(jnp.float32),
+                                            mask) ** 2)
+
+    gf = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, (0, 1, 2))(
+        *(x.astype(jnp.float32) for x in (q, k, v)))
+    for a, b in zip(gf, gr):
+        # grads w.r.t. bf16 inputs come out bf16 — compare in f32
+        assert a.dtype == jnp.bfloat16
+        np.testing.assert_allclose(a.astype(jnp.float32), b,
+                                   atol=5e-2, rtol=5e-2)
 
 
 def test_untileable_seq_falls_back():

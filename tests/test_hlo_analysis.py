@@ -463,3 +463,69 @@ def test_cli_format_json_memory_and_programs(tmp_path, capsys):
     assert doc["schedule_check"]["diagnostics"] == []
     assert doc["programs"][0]["memory"]["peak_hbm_bytes"] > 0
     assert doc["programs"][0]["collectives"] == 3
+
+
+def test_bf16_program_priced_at_half_widths():
+    """Byte-size audit regression (bf16 must never be priced at f32
+    widths): lower a REAL bf16-compute program and check the parser's
+    dtype->width table end to end — bf16 statements at 2 B/element in
+    the liveness estimate, and the StaticCollectiveProfile wire bytes
+    of a bf16 payload at exactly half its f32 twin."""
+    import optax
+    import autodist_tpu
+    from autodist_tpu import strategy as S
+    from autodist_tpu.simulator.cost_model import StaticCollectiveProfile
+
+    params = {"w": jnp.zeros((64, 32), jnp.float32)}
+
+    def loss_fn(p, b):
+        return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+    batch = {"x": np.zeros((16, 64), np.float32),
+             "y": np.zeros((16, 32), np.float32)}
+    autodist_tpu.reset()
+    ad = autodist_tpu.AutoDist(
+        strategy_builder=S.AllReduce(compute_dtype="bf16"),
+        validate="error")
+    runner = ad.build(loss_fn, optax.adam(1e-3), params, batch)
+    runner.init(params)
+    prog = hlo.parse_hlo_text(runner.lowered_text(batch))
+    autodist_tpu.reset()
+
+    bf16_stmts = [st for fn in prog.funcs.values()
+                  for st in fn.statements if "bf16" in st.out_dtypes]
+    assert bf16_stmts, "bf16 compute tier lowered no bf16 statements"
+    # the sizer and the dtype column describe the SAME tensors: the
+    # per-replica forward matmul (2x64 @ 64x32 -> 2x32 on the 8-way
+    # mesh) lowers in bf16 and must be priced at 2*32*2 = 128 bytes,
+    # not the 256 of an f32 width
+    dots = [st for st in bf16_stmts if st.op == "dot_general"
+            and st.out_dtypes == ["bf16"] and 128 in st.out_bytes]
+    assert dots, ("no bf16 dot_general priced at half width: %s"
+                  % [(st.op, st.out_dtypes, st.out_bytes)
+                     for st in bf16_stmts])
+    # the width table itself: half floats at 2, f8 family at 1
+    assert hlo.tensor_type_bytes("8x4xbf16") == 64
+    assert hlo.tensor_type_bytes("8x4xf16") == 64
+    assert hlo.tensor_type_bytes("8x4xf8e4m3fn") == 32
+    assert hlo.tensor_type_bytes("8x4xf32") == 128
+
+    # wire pricing: a bf16 collective ships half the bytes of its f32
+    # twin through StaticCollectiveProfile (same kind, same group)
+    def sched(dtype, bytes_):
+        c = hlo.CollectiveOp(kind="reduce", op="all_reduce",
+                             payload_bytes=bytes_, result_bytes=bytes_,
+                             replica_groups=((0, 1, 2, 3),), channel=0,
+                             lineno=1, loop_depth=0, elem_dtype=dtype,
+                             payload_elems=bytes_ // (2 if dtype in
+                                                      hlo.HALF_DTYPES
+                                                      else 4))
+        s = hlo.CollectiveSchedule([c])
+        return s
+
+    f32_wire = StaticCollectiveProfile.from_schedule(
+        sched("f32", 4096), default_group_size=4).total_wire_bytes
+    bf16_wire = StaticCollectiveProfile.from_schedule(
+        sched("bf16", 2048), default_group_size=4).total_wire_bytes
+    assert f32_wire > 0
+    assert bf16_wire == pytest.approx(f32_wire / 2)
