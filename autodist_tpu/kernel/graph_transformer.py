@@ -149,6 +149,13 @@ class DistributedStep:
         saved = float(self.metadata.get("zero_hbm_saved_bytes", 0.0))
         if saved:
             tel.gauge_set("zero.hbm_saved_bytes", saved)
+        # overlapped gradient-sync schedule: credit the stage count once
+        # per program build (the counter is pre-registered at zero, so
+        # scrapers see the key either way); overlap.exposed_wait_ms
+        # accrues in the runner's barrier wait when the program overlaps
+        ostages = int(self.metadata.get("overlap_stages", 0))
+        if self.metadata.get("overlap") and ostages:
+            tel.counter_add("overlap.buckets", ostages)
 
     def _count_wire(self, microsteps: int = 1) -> None:
         if self._wire_q_step:
@@ -1399,6 +1406,68 @@ class GraphTransformer:
         # int8 quantized rings: one ring per reduced mesh axis, in order
         ring_axes = tuple((a, int(self._mesh.shape[a])) for a in all_axes)
 
+        # ----- communication–computation overlap (graph_config.overlap):
+        # gradient sync lowers as a collective SCHEDULE
+        # (collectives.GradSyncSchedule) instead of one epilogue — the
+        # exact same sync units (concat buckets, per-var syncs, ZeRO
+        # reduce-scatters; identical membership and math, so values stay
+        # bit-identical), ordered by reverse layer position (the backward
+        # sweep produces the LAST layer's gradients first) and chained
+        # through optimization_barrier so XLA's all-reduce combiner cannot
+        # re-merge them into one epilogue payload and the latency-hiding
+        # scheduler can run each stage's collective under the remaining
+        # backward compute. The optimizer apply interleaves per-bucket at
+        # the dataflow level: each variable's update ops depend only on
+        # its own synced gradient, so XLA schedules them as stages drain
+        # rather than behind the full gradient. mp/sparse/PS collectives
+        # stay outside the schedule (they are forward-coupled or leave
+        # the device), and the sentinel verdict still judges the COMPLETE
+        # synced gradient — it consumes every stage's output.
+        overlap_req = bool(getattr(self._strategy.graph_config,
+                                   "overlap", False))
+        overlap_armed = overlap_req and N > 1
+        if overlap_armed and ps_store is not None and (
+                ps_store.max_staleness() > 0 or ps_store.any_async()):
+            # stale/async PS pushes already decouple from the step clock;
+            # barrier-ordering device collectives against a wire that
+            # intentionally lags would pin the schedule to the slowest
+            # (host) path. The searcher's canon never emits this combo —
+            # disarm defensively for hand-built strategies.
+            logging.warning(
+                "overlap disarmed: stale/async host-PS plan — the PS wire "
+                "is already decoupled from the step; remove staleness/"
+                "async or drop overlap to silence this")
+            overlap_armed = False
+        grad_schedule = None
+        if overlap_armed:
+            full_names, _, _ = variable_utils.flatten_named(item.params)
+            var_pos = {vn: i for i, vn in enumerate(full_names)}
+            units = []
+            for b in buckets:
+                units.append((
+                    "bucket:" + b.key, "reduce", tuple(b.var_names),
+                    b.total_size,
+                    "int8" if b.compressor_name.startswith("Int8")
+                    else "fp32", all_axes))
+            for n in sorted(syncs):
+                if n in bucketed_names:
+                    continue
+                units.append((
+                    "var:" + n, "reduce", (n,),
+                    int(getattr(var_infos[n], "num_elements", 0) or 0),
+                    "fp32", all_axes))
+            for n in sorted(zero_names):
+                units.append((
+                    "zero:" + n, "reduce_scatter", (n,),
+                    int(getattr(var_infos[n], "num_elements", 0) or 0),
+                    zero_syncs[n].wire_dtype, (axis,)))
+            # a degenerate (<= 1 stage) schedule still lowers as a
+            # schedule: there is nothing to overlap, and the ADT409 lint
+            # flags exactly that condition instead of silently falling
+            # back to the epilogue
+            grad_schedule = collectives.build_grad_sync_schedule(
+                units, var_pos)
+
         def _health_verdict(synced, ps_grads, new_params, global_loss):
             """The in-graph sentinel verdict: global gradient L2 norm,
             nonfinite counts over the synced gradients (incl. the PS
@@ -1576,14 +1645,16 @@ class GraphTransformer:
                     s_ids, s_vals, int(info.shape[0]),
                     tuple(info.shape[1:]))
 
-            # ZeRO-sharded vars: reduce-scatter over the data axis — each
-            # replica holds only the mean gradient of the flat shard it
-            # owns; the sharded optimizer apply happens below, after the
-            # main (holed) optimizer update
-            for n in sorted(zero_names):
-                synced[n] = zero_syncs[n].reduce_scatter(g[n])
+            # the three gradient-sync unit kernels, shared verbatim by the
+            # epilogue and the overlapped schedule — the schedule only
+            # changes WHEN each unit's collective may launch (barrier
+            # chaining), never its math, so the two lowerings are
+            # bit-identical (optimization_barrier is an identity op)
+            def _run_zero(n, gin):
+                synced[n] = zero_syncs[n].reduce_scatter(gin)
+                return synced[n]
 
-            for b in (buckets if N > 1 else []):
+            def _run_bucket(b, gin):
                 bst = new_bucket_state.get(b.key)
                 bst_local = bst[0] if bst is not None else None
                 bucket_psum = psum
@@ -1591,19 +1662,60 @@ class GraphTransformer:
                     bucket_psum = lambda x: collectives.hierarchical_psum(  # noqa: E731
                         x, ici, dcn)
                 out, nst = collectives.bucket_reduce(
-                    b, g, bst_local, bucket_psum, N, ring_axes=ring_axes)
+                    b, gin, bst_local, bucket_psum, N, ring_axes=ring_axes)
                 synced.update(out)
                 if nst is not None:
                     new_bucket_state[b.key] = jnp.expand_dims(nst, 0)
-            for n, s in (syncs.items() if N > 1 else ()):
-                if n in bucketed_names or n in synced:
-                    continue
+                return out
+
+            def _run_var(n, gin):
+                s = syncs[n]
                 vst = new_var_state.get(n)
                 vst_local = jax.tree_util.tree_map(lambda a: a[0], vst) if vst is not None else None
-                synced[n], nst = s.sync(g[n], vst_local)
+                synced[n], nst = s.sync(gin, vst_local)
                 if nst is not None:
                     new_var_state[n] = jax.tree_util.tree_map(
                         lambda a: jnp.expand_dims(a, 0), nst)
+                return synced[n]
+
+            if grad_schedule is not None:
+                # overlapped schedule: stages in reverse layer order, each
+                # stage's gradient inputs barrier-chained on a 1-element
+                # token of the previous stage's reduced output — a real
+                # data dependence that keeps the stages un-merged and
+                # ordered by backward readiness (see build-time comment)
+                bucket_by_key = {b.key: b for b in buckets}
+                token = None
+                for stg in grad_schedule.stages:
+                    op = stg.ops[0]
+                    kind, _, uname = op.unit.partition(":")
+                    if kind == "bucket":
+                        b = bucket_by_key[uname]
+                        gin = {n: g[n] for n in b.var_names}
+                        gin, token = collectives.barrier_chain(gin, token)
+                        out = _run_bucket(b, gin)
+                    elif kind == "zero":
+                        (gin,), token = collectives.barrier_chain(
+                            (g[uname],), token)
+                        out = _run_zero(uname, gin)
+                    else:
+                        (gin,), token = collectives.barrier_chain(
+                            (g[uname],), token)
+                        out = _run_var(uname, gin)
+                    token = collectives.overlap_token(out)
+            else:
+                # epilogue lowering: ZeRO reduce-scatters, then concat
+                # buckets, then per-var syncs — one contiguous block after
+                # the full backward (the pre-overlap baseline, and the
+                # N == 1 / overlap-off path)
+                for n in sorted(zero_names):
+                    _run_zero(n, g[n])
+                for b in (buckets if N > 1 else []):
+                    _run_bucket(b, g)
+                for n in (syncs if N > 1 else ()):
+                    if n in bucketed_names or n in synced:
+                        continue
+                    _run_var(n, g[n])
             # non-trainable vars: zero gradient so optimizer state stays
             # clean and the value never moves; remaining unconfigured vars
             # (shouldn't happen post-compile) get a plain mean-psum
@@ -2057,13 +2169,26 @@ class GraphTransformer:
             # ADT60x numerics lints and step_stats report it)
             "compute_dtype": compute_dtype,
             "grad_fault_plan": grad_plan.describe(),
+            # communication–computation overlap: did gradient sync lower
+            # as a barrier-chained schedule (vs the single epilogue)?
+            # Consumed by the ADT409 lint, the drift report, and the
+            # overlap.* telemetry; ``overlap_stages`` is the schedule's
+            # stage count (the bucket-size knob's observable)
+            "overlap": grad_schedule is not None,
+            "overlap_requested": overlap_req,
+            "overlap_stages": (grad_schedule.num_stages
+                               if grad_schedule is not None else 0),
+            "overlap_schedule": (grad_schedule.describe()
+                                 if grad_schedule is not None else ""),
         }
         logging.info("GraphTransformer: lowered %d vars (%d partitioned, "
-                     "%d host-PS-resident, %d ZeRO-sharded, %d buckets) "
+                     "%d host-PS-resident, %d ZeRO-sharded, %d buckets%s) "
                      "over %d replicas",
                      len(layouts),
                      sum(1 for l in layouts.values() if l.partitioned),
-                     len(ps_names), len(zero_names), len(buckets), N)
+                     len(ps_names), len(zero_names), len(buckets),
+                     (", overlap x%d stages" % grad_schedule.num_stages
+                      if grad_schedule is not None else ""), N)
         return DistributedStep(
             mesh=self._mesh, step_fn=step_fn, step_fn_nodonate=step_fn_nodonate,
             layouts=layouts, layout_tree=layout_tree, strategy=self._strategy,

@@ -96,6 +96,176 @@ def bucket_reduce(bucket: Bucket, grads: Dict[str, jnp.ndarray], state, psum,
     return out, new_state
 
 
+# ----------------------------------------------- collective-schedule IR
+
+
+VALID_OP_KINDS = ("reduce", "reduce_scatter", "all_gather")
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveOp:
+    """One collective in the gradient-sync schedule: ``kind`` over the
+    named mesh ``axes``, reducing/gathering the sync unit ``unit`` (a
+    bucket key, ``var:<name>`` or ``zero:<name>``)."""
+    kind: str                       # reduce | reduce_scatter | all_gather
+    unit: str
+    axes: Tuple[str, ...]
+    var_names: Tuple[str, ...] = ()
+    payload_elems: int = 0
+    wire_dtype: str = "fp32"
+
+
+@dataclasses.dataclass(frozen=True)
+class ScheduleStage:
+    """An ordered stage of the schedule. ``ready_rank`` is the position
+    in the backward pass (max var index of the unit's gradients, in
+    params-flatten order) after which every op in the stage is launchable
+    — stages are emitted in DESCENDING ready_rank, i.e. reverse layer
+    order, because later layers' gradients materialize first in the
+    backward sweep. ``deps`` names earlier stage indices that must
+    complete before this stage launches (the lowering realizes them as an
+    ``optimization_barrier`` chain)."""
+    index: int
+    ops: Tuple[CollectiveOp, ...]
+    ready_rank: int = 0
+    deps: Tuple[int, ...] = ()
+
+    @property
+    def var_names(self) -> Tuple[str, ...]:
+        return tuple(n for op in self.ops for n in op.var_names)
+
+
+@dataclasses.dataclass(frozen=True)
+class GradSyncSchedule:
+    """The gradient-synchronization schedule the overlapped lowering
+    executes: ordered stages of collectives with explicit ready
+    dependencies. ``validate()`` is the IR's one structural contract —
+    the lowering, the lint, and the cost model all consume a schedule
+    that passed it."""
+    stages: Tuple[ScheduleStage, ...]
+
+    @property
+    def num_stages(self) -> int:
+        return len(self.stages)
+
+    @property
+    def num_collectives(self) -> int:
+        return sum(len(st.ops) for st in self.stages)
+
+    def validate(self) -> None:
+        seen_units = set()
+        for pos, st in enumerate(self.stages):
+            if st.index != pos:
+                raise ValueError(
+                    "schedule stage %d carries index %d — stages must be "
+                    "densely numbered in emission order" % (pos, st.index))
+            if not st.ops:
+                raise ValueError("schedule stage %d has no ops" % pos)
+            for dep in st.deps:
+                if not 0 <= dep < pos:
+                    raise ValueError(
+                        "stage %d depends on stage %d which does not "
+                        "precede it" % (pos, dep))
+            for op in st.ops:
+                if op.kind not in VALID_OP_KINDS:
+                    raise ValueError("unknown collective kind %r (stage %d)"
+                                     % (op.kind, pos))
+                if not op.axes:
+                    raise ValueError("op %r reduces over no mesh axes"
+                                     % (op.unit,))
+                if (op.kind, op.unit) in seen_units:
+                    raise ValueError("unit %r scheduled twice for %s"
+                                     % (op.unit, op.kind))
+                seen_units.add((op.kind, op.unit))
+        ranks = [st.ready_rank for st in self.stages]
+        if ranks != sorted(ranks, reverse=True):
+            raise ValueError(
+                "stages are not in reverse-readiness order (ready_rank "
+                "must be non-increasing): %r" % (ranks,))
+
+    def describe(self) -> str:
+        lines = []
+        for st in self.stages:
+            ops = ", ".join("%s(%s%s)" % (
+                op.kind, op.unit,
+                ", int8" if op.wire_dtype == "int8" else "")
+                for op in st.ops)
+            dep = (" after %s" % (",".join(map(str, st.deps)))
+                   if st.deps else "")
+            lines.append("stage %d [ready@%d]%s: %s"
+                         % (st.index, st.ready_rank, dep, ops))
+        return "\n".join(lines)
+
+
+def build_grad_sync_schedule(units, var_positions) -> GradSyncSchedule:
+    """Order gradient-sync units into a :class:`GradSyncSchedule`.
+
+    ``units`` — iterable of ``(unit_id, kind, var_names, payload_elems,
+    wire_dtype, axes)`` — one entry per sync unit the lowering would
+    execute (a concat bucket, a per-var sync, a ZeRO reduce-scatter).
+    ``var_positions`` maps var_name -> index in params-flatten order.
+
+    Stages are emitted one unit each, sorted by DESCENDING max var
+    position (reverse layer order): in the backward sweep the LAST
+    layer's gradients are produced first, so its stage launches first and
+    overlaps with the remaining backward compute. Each stage depends on
+    its predecessor — the serialized launch chain keeps XLA's all-reduce
+    combiner from re-merging the collectives into one epilogue payload
+    while leaving each free to overlap with compute."""
+    entries = []
+    for unit_id, kind, var_names, payload, wire_dtype, axes in units:
+        if kind not in VALID_OP_KINDS:
+            raise ValueError("unknown unit kind %r" % (kind,))
+        rank = max((int(var_positions.get(n, 0)) for n in var_names),
+                   default=0)
+        entries.append((rank, unit_id, kind, tuple(var_names),
+                        int(payload), wire_dtype, tuple(axes)))
+    # descending readiness rank; unit_id tie-break keeps emission stable
+    entries.sort(key=lambda e: (-e[0], e[1]))
+    stages = []
+    for i, (rank, unit_id, kind, names, payload, wire, axes) in enumerate(
+            entries):
+        op = CollectiveOp(kind=kind, unit=unit_id, axes=axes,
+                          var_names=names, payload_elems=payload,
+                          wire_dtype=wire)
+        stages.append(ScheduleStage(index=i, ops=(op,), ready_rank=rank,
+                                    deps=(i - 1,) if i else ()))
+    sched = GradSyncSchedule(stages=tuple(stages))
+    sched.validate()
+    return sched
+
+
+def overlap_token(tree):
+    """Chain token for the overlapped lowering: a 1-element data-dependent
+    view of a unit's reduced output. Deliberately NOT an arithmetic zero —
+    XLA folds ``x * 0`` and would sever the dependency the barrier chain
+    exists to create."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return None
+    return jnp.ravel(leaves[0])[:1].astype(jnp.float32)
+
+
+def barrier_chain(tree, token):
+    """Identity on ``tree`` that XLA cannot reorder before ``token``'s
+    producers: ``optimization_barrier`` over (leaves..., token). This is
+    the sequencing primitive the overlapped lowering threads between sync
+    units — values are bit-identical to the unchained program (the
+    barrier is an identity op), but the schedule's stage order becomes a
+    real data dependence, so the all-reduce combiner cannot merge the
+    per-stage collectives back into one epilogue reduce and the
+    latency-hiding scheduler can hide each under remaining backward
+    compute. Returns ``(tree, token)`` unchanged when ``token`` is None
+    (first stage — nothing to order after)."""
+    if token is None:
+        return tree, token
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree, token
+    out = jax.lax.optimization_barrier(tuple(leaves) + (token,))
+    return jax.tree_util.tree_unflatten(treedef, out[:-1]), out[-1]
+
+
 # --------------------------------------------------- quantized wire codec
 
 

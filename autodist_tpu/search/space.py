@@ -101,6 +101,10 @@ class PlanSpec:
     staleness: int = 0
     remat: Optional[str] = None
     compute_dtype: str = "f32"
+    # lower the gradient sync as a bucketed overlap schedule (reverse
+    # layer order, barrier-chained) instead of one epilogue; chunk_size
+    # doubles as the bucket-size knob for how many stages it splits into
+    overlap: bool = False
 
     def choice_map(self) -> Dict[str, VarChoice]:
         return dict(self.choices)
@@ -134,6 +138,8 @@ class PlanSpec:
             bits.append("remat=%s" % self.remat)
         if self.compute_dtype != "f32":
             bits.append("compute=%s" % self.compute_dtype)
+        if self.overlap:
+            bits.append("overlap")
         return "plan[%s]" % ",".join(bits)
 
 
@@ -247,7 +253,8 @@ class PlanSpace:
 
     def make_plan(self, choices: Dict[str, VarChoice], chunk_size: int = 128,
                   staleness: int = 0, remat: Optional[str] = None,
-                  compute_dtype: str = "f32") -> PlanSpec:
+                  compute_dtype: str = "f32",
+                  overlap: bool = False) -> PlanSpec:
         canon = tuple((n, self.canon(choices.get(n, VarChoice()), n))
                       for n in self.var_names)
         if any(c.zero for _, c in canon):
@@ -261,9 +268,17 @@ class PlanSpace:
             # f32-master guarantee — clamp rather than emit an invalid
             # plan (only the managed tiers exist in this space)
             compute_dtype = "f32"
+        # overlap by construction: the schedule sequences SYNC gradient
+        # collectives behind the backward pass — a staleness window (the
+        # lowering would disarm it with a warning) or fewer than two
+        # AllReduce-family sync units (nothing to overlap: one stage is
+        # the epilogue) drop the bit in the SPEC so describe()/dedup and
+        # the built strategy agree
+        ar_units = sum(1 for _, c in canon if c.sync == "AllReduce")
+        overlap = bool(overlap) and staleness == 0 and ar_units >= 2
         return PlanSpec(choices=canon, chunk_size=chunk_size,
                         staleness=staleness, remat=remat,
-                        compute_dtype=compute_dtype)
+                        compute_dtype=compute_dtype, overlap=overlap)
 
     # ---------------------------------------------------------------- seeds
 
@@ -342,6 +357,13 @@ class PlanSpace:
             ("seed:ar-bf16c", self.make_plan(ar, compute_dtype="bf16")),
             ("seed:zero-bf16c", self.make_plan(zero,
                                                compute_dtype="bf16")),
+            # the overlapped bucketed schedule: small chunks split the
+            # backward into more stages (earlier launches, more hiding);
+            # make_plan drops the bit on single-sync-unit models
+            ("seed:ar-overlap", self.make_plan(ar, chunk_size=8,
+                                               overlap=True)),
+            ("seed:zero-overlap", self.make_plan(zero, chunk_size=8,
+                                                 overlap=True)),
         ]
         return out
 
@@ -406,7 +428,8 @@ class PlanSpace:
         if cd not in COMPUTE_DTYPES:
             return None  # an unmanaged compute tier: outside the space
         return self.make_plan(choices, staleness=staleness, remat=gc.remat,
-                              compute_dtype=cd)
+                              compute_dtype=cd,
+                              overlap=bool(getattr(gc, "overlap", False)))
 
     # ------------------------------------------------------------ mutations
 
@@ -529,8 +552,23 @@ class PlanSpace:
             def set_staleness():
                 opts = [s for s in STALENESS_CHOICES if s != plan.staleness]
                 s = opts[rng.randrange(len(opts))]
-                return dataclasses.replace(plan, staleness=s), "stale=%d" % s
+                # arming a staleness window disarms the overlap schedule
+                # (the lowering would only warn and fall back — the spec
+                # states the truth so dedup/describe agree)
+                return (dataclasses.replace(
+                    plan, staleness=s,
+                    overlap=plan.overlap and s == 0), "stale=%d" % s)
             ops.append(set_staleness)
+
+        # the overlap schedule needs >= 2 AllReduce-family sync units
+        # (else one stage IS the epilogue) and no staleness window
+        ar_units = sum(1 for n in names if cm[n].sync == "AllReduce")
+        if ar_units >= 2 and (plan.overlap or plan.staleness == 0):
+            def toggle_overlap():
+                target = not plan.overlap
+                return (dataclasses.replace(plan, overlap=target),
+                        "overlap=%s" % target)
+            ops.append(toggle_overlap)
 
         def set_remat():
             opts = [r for r in REMAT_CHOICES if r != plan.remat]
@@ -551,6 +589,15 @@ class PlanSpace:
             return None
         op = ops[rng.randrange(len(ops))]
         new_plan, desc = op()
+        if new_plan.overlap:
+            # a var-level mutation (flip_sync) may have dropped the plan
+            # below two AllReduce-family units — re-apply the plan-level
+            # canon so overlap never survives on a spec make_plan would
+            # refuse to mint
+            new_ar = sum(1 for _, c in new_plan.choices
+                         if c.sync == "AllReduce")
+            if new_ar < 2 or new_plan.staleness:
+                new_plan = dataclasses.replace(new_plan, overlap=False)
         if new_plan == plan:
             return None
         return new_plan, desc
@@ -637,4 +684,5 @@ class PlanSpace:
         return Strategy(node_config=nodes,
                         graph_config=GraphConfig(
                             replicas=list(self.replicas), remat=plan.remat,
-                            compute_dtype=plan.compute_dtype))
+                            compute_dtype=plan.compute_dtype,
+                            overlap=plan.overlap))

@@ -58,13 +58,18 @@ class Calibration:
 def _predict(breakdown, scales: Sequence[float]) -> float:
     """Step time under scaled terms — delegates to
     ``CostBreakdown.step_time_s`` on a scaled copy so the fit objective
-    can never diverge from the overlap formula simulate()/rank() use."""
+    can never diverge from the formula simulate()/rank() use (the serial
+    epilogue sum, or the exposed-tail form when the plan lowers as an
+    overlapped schedule)."""
     c, a, p, l = scales
     return dataclasses.replace(
         breakdown, compute_s=breakdown.compute_s * c,
         allreduce_s=breakdown.allreduce_s * a,
         ps_s=breakdown.ps_s * p,
         mp_s=breakdown.mp_s * a,  # rides the same wire as gradient AR
+        # the exposed overlap tail is wire time too — same link, same
+        # bandwidth error, so the same scale corrects it
+        overlap_exposed_s=breakdown.overlap_exposed_s * a,
         latency_s=breakdown.latency_s * l).step_time_s
 
 
@@ -74,9 +79,10 @@ _REGULARIZER = 1e-3
 def _loss(breakdowns, measured, scales) -> float:
     # relative squared error: a 10ms model and a 200ms model weigh equally.
     # The log-space ridge term keeps UNIDENTIFIABLE scales at 1.0: a term
-    # hidden inside the max() for every measurement (e.g. compute that
-    # never dominates) gets no signal from the data, and without the
-    # penalty the line search would walk it to an arbitrary bound.
+    # that is negligible in every measurement (e.g. launch latency under
+    # millisecond steps, or an overlap tail that hides almost all wire)
+    # gets no signal from the data, and without the penalty the line
+    # search would walk it to an arbitrary bound.
     data = sum(((_predict(b, scales) - t) / t) ** 2
                for b, t in zip(breakdowns, measured))
     reg = _REGULARIZER * sum(math.log(s) ** 2 for s in scales)
@@ -99,8 +105,9 @@ def fit(breakdowns: Sequence, measured_s: Sequence[float],
         # golden-section comparison downstream
         raise ValueError("measured times must be positive finite seconds")
     scales = [1.0, 1.0, 1.0, 1.0]
-    # ar_scale covers everything on the collective wire (allreduce_s AND
-    # mp_s — _predict applies it to both), so an mp-only measurement set
+    # ar_scale covers everything on the collective wire (allreduce_s,
+    # mp_s AND the overlapped schedule's exposed tail — _predict applies
+    # it to all three), so an mp-only or overlap-only measurement set
     # still exercises it
     terms = [lambda b: b.compute_s, lambda b: b.allreduce_s + b.mp_s,
              lambda b: b.ps_s, lambda b: b.latency_s]

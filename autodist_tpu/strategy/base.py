@@ -282,6 +282,13 @@ class GraphConfig:
     # verdict stay f32 — the f32-master discipline the ADT60x numerics
     # rules certify (analysis/numerics.py, rules.verify_numerics)
     compute_dtype: str = "f32"
+    # communication–computation overlap: lower gradient sync as an ordered
+    # schedule of per-unit collectives chained through optimization_barrier
+    # (reverse layer order) instead of one epilogue, so XLA's latency-
+    # hiding scheduler can run each collective under the remaining
+    # backward compute. Values are bit-identical to the epilogue lowering
+    # (the barrier is an identity op); ignored at 1 replica.
+    overlap: bool = False
 
     def to_dict(self):
         return {"replicas": list(self.replicas), "mesh_shape": self.mesh_shape,
@@ -291,7 +298,8 @@ class GraphConfig:
                 "pp_schedule": self.pp_schedule,
                 "pp_virtual": self.pp_virtual,
                 "require_sparse": self.require_sparse,
-                "compute_dtype": self.compute_dtype}
+                "compute_dtype": self.compute_dtype,
+                "overlap": self.overlap}
 
     @classmethod
     def from_dict(cls, d):
@@ -305,7 +313,8 @@ class GraphConfig:
                    pp_schedule=d.get("pp_schedule"),
                    pp_virtual=d.get("pp_virtual"),
                    require_sparse=bool(d.get("require_sparse", False)),
-                   compute_dtype=d.get("compute_dtype", "f32") or "f32")
+                   compute_dtype=d.get("compute_dtype", "f32") or "f32",
+                   overlap=bool(d.get("overlap", False)))
 
 
 # ----------------------------------------------------------------- strategy

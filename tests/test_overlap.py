@@ -1,0 +1,559 @@
+"""Communication–computation overlap: the bucketed gradient-sync schedule.
+
+The perf tentpole's correctness contract: ``overlap=True`` lowers the
+gradient sync as a :class:`~autodist_tpu.parallel.collectives.
+GradSyncSchedule` — the exact same sync units (concat buckets, per-var
+syncs, ZeRO reduce-scatters) in reverse layer order, chained through
+``optimization_barrier`` so XLA can launch each unit's collective while
+the remaining backward still runs — and must match the epilogue lowering
+exactly (params, optimizer state, metrics, sentinel verdicts): the
+schedule reorders WHEN collectives launch, never what they compute. The
+cost model prices the schedule by its exposed wire tail, and the
+searcher's overlap knob must rank it above the epilogue exactly when the
+wire dominates.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import autodist_tpu
+from autodist_tpu import strategy as S
+from autodist_tpu.model_item import ModelItem
+from autodist_tpu.parallel import collectives
+from autodist_tpu.resource_spec import ResourceSpec
+from autodist_tpu.search.space import PlanSpace, VarChoice
+from autodist_tpu.simulator.simulator import Simulator
+from autodist_tpu.strategy.base import GraphConfig
+from autodist_tpu.telemetry import spans as tel
+
+
+def _problem(seed=0, n_batches=8):
+    rng = np.random.RandomState(seed)
+    params = {"w1": jnp.asarray(rng.randn(8, 16).astype(np.float32) * 0.1),
+              "b1": jnp.zeros((16,), jnp.float32),
+              "w2": jnp.asarray(rng.randn(16, 4).astype(np.float32) * 0.1),
+              "b2": jnp.zeros((4,), jnp.float32)}
+
+    def loss_fn(p, b):
+        h = jnp.tanh(b["x"] @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] + p["b2"] - b["y"]) ** 2)
+
+    batches = [{"x": rng.randn(16, 8).astype(np.float32),
+                "y": rng.randn(16, 4).astype(np.float32)}
+               for _ in range(n_batches)]
+    return params, loss_fn, batches
+
+
+def _build(make_builder, params, loss_fn, batch, opt=None, sentinel=None):
+    autodist_tpu.reset()
+    ad = autodist_tpu.AutoDist(strategy_builder=make_builder())
+    runner = ad.build(loss_fn, opt or optax.adam(0.1), params, batch,
+                      sentinel=sentinel)
+    runner.init(params)
+    return runner
+
+
+def _train_pair(base_builder, overlap_builder, steps=6, fuse=0,
+                sentinel=None, seed=0):
+    """Train the SAME problem under both lowerings; each leg returns
+    (losses, gathered params, gathered opt state, runner) so a parity
+    assertion has everything it needs."""
+    params, loss_fn, batches = _problem(seed=seed, n_batches=steps)
+
+    def leg(make_builder):
+        runner = _build(make_builder, params, loss_fn, batches[0],
+                        sentinel=sentinel)
+        if fuse:
+            hist = runner.fit(iter(batches), fuse_steps=fuse,
+                              metrics_every=2)
+        else:
+            hist = runner.fit(iter(batches))
+        losses = [float(m["loss"]) for m in hist]
+        gp = runner.gather_params()
+        go = runner.distributed_step.gather_opt_state(runner.state)
+        return losses, gp, go, runner
+
+    base = leg(base_builder)
+    over = leg(overlap_builder)
+    return base, over
+
+
+def _assert_parity(base, over, rtol=1e-6, atol=1e-7):
+    b_losses, b_params, b_opt, _ = base
+    o_losses, o_params, o_opt, _ = over
+    np.testing.assert_allclose(o_losses, b_losses, rtol=rtol, atol=atol)
+    for key in b_params:
+        np.testing.assert_allclose(
+            np.asarray(o_params[key]), np.asarray(b_params[key]),
+            rtol=rtol, atol=atol, err_msg="var %s" % key)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=rtol, atol=atol),
+        o_opt, b_opt)
+
+
+# ------------------------------------------------------- schedule IR
+
+
+def test_schedule_build_reverse_layer_order():
+    """Units launch in descending max-var-position (the backward sweep
+    produces the LAST layer's gradients first), each stage depending on
+    the previous one."""
+    units = [("var:a", "reduce", ("a",), 10, "fp32", ("data",)),
+             ("var:c", "reduce", ("c",), 30, "fp32", ("data",)),
+             ("bucket:g0", "reduce", ("b", "d"), 20, "fp32", ("data",))]
+    pos = {"a": 0, "b": 1, "c": 2, "d": 3}
+    sched = collectives.build_grad_sync_schedule(units, pos)
+    sched.validate()
+    assert sched.num_stages == 3 and sched.num_collectives == 3
+    # bucket g0 holds d (pos 3) -> first; then c (2); then a (0)
+    assert [st.ops[0].unit for st in sched.stages] == [
+        "bucket:g0", "var:c", "var:a"]
+    assert [st.deps for st in sched.stages] == [(), (0,), (1,)]
+    assert [st.ready_rank for st in sched.stages] == [3, 2, 0]
+    text = sched.describe()
+    assert "stage 0 [ready@3]" in text and "bucket:g0" in text
+
+
+def test_schedule_build_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="kind"):
+        collectives.build_grad_sync_schedule(
+            [("var:a", "gossip", ("a",), 1, "fp32", ("data",))], {"a": 0})
+
+
+def test_schedule_validate_rejects_malformed():
+    def stage(idx, kind="reduce", unit="var:a", deps=(), axes=("data",),
+              rank=0):
+        op = collectives.CollectiveOp(kind=kind, unit=unit, axes=axes,
+                                      var_names=("a",), payload_elems=1)
+        return collectives.ScheduleStage(index=idx, ops=(op,), deps=deps,
+                                         ready_rank=rank)
+
+    collectives.GradSyncSchedule(stages=(stage(0),)).validate()
+    with pytest.raises(ValueError, match="dense"):
+        collectives.GradSyncSchedule(stages=(stage(1),)).validate()
+    with pytest.raises(ValueError, match="kind"):
+        collectives.GradSyncSchedule(
+            stages=(stage(0, kind="gossip"),)).validate()
+    with pytest.raises(ValueError, match="axes"):
+        collectives.GradSyncSchedule(stages=(stage(0, axes=()),)).validate()
+    with pytest.raises(ValueError, match="precede"):
+        collectives.GradSyncSchedule(stages=(stage(0, deps=(0,)),)).validate()
+    with pytest.raises(ValueError, match="twice"):
+        collectives.GradSyncSchedule(
+            stages=(stage(0), stage(1, deps=(0,)))).validate()
+    with pytest.raises(ValueError, match="no ops"):
+        collectives.GradSyncSchedule(stages=(
+            collectives.ScheduleStage(index=0, ops=(), deps=()),)).validate()
+    with pytest.raises(ValueError, match="reverse"):
+        collectives.GradSyncSchedule(stages=(
+            stage(0, rank=1),
+            stage(1, unit="var:b", deps=(0,), rank=2))).validate()
+
+
+def test_barrier_chain_is_identity():
+    """barrier_chain must never change values — only add ordering."""
+    tree = {"a": jnp.arange(4.0), "b": jnp.ones((2, 2))}
+    out, token = collectives.barrier_chain(tree, None)
+    assert out is tree and token is None  # no token: nothing to chain
+    token0 = collectives.overlap_token(tree)
+    assert token0 is not None and token0.shape == (1,)
+    out, token1 = collectives.barrier_chain(tree, token0)
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.arange(4.0))
+    np.testing.assert_array_equal(np.asarray(out["b"]), np.ones((2, 2)))
+    np.testing.assert_array_equal(np.asarray(token1), np.asarray(token0))
+    assert collectives.overlap_token({}) is None
+
+
+# ----------------------------------------------------- lowering parity
+
+
+OVERLAP_BUILDERS = [
+    # one sync unit per var: the deepest schedule
+    ("AllReduce/chunk1", lambda ov: S.AllReduce(chunk_size=1, overlap=ov)),
+    # two vars per concat bucket (compressed wire, bucket state rides)
+    ("AllReduce/compressed", lambda ov: S.AllReduce(
+        compressor="HorovodCompressor", chunk_size=2, overlap=ov)),
+    # ZeRO rs+ag: reduce_scatter stages + sharded applies
+    ("ZeroSharded", lambda ov: S.ZeroSharded(overlap=ov)),
+]
+
+
+@pytest.mark.parametrize("name,mk", OVERLAP_BUILDERS,
+                         ids=[b[0] for b in OVERLAP_BUILDERS])
+def test_overlap_parity(name, mk):
+    """The schedule lowering must match the epilogue exactly: losses,
+    params, and optimizer state, with the schedule really armed and the
+    barrier chain really in the program."""
+    base, over = _train_pair(lambda: mk(False), lambda: mk(True))
+    _assert_parity(base, over)
+    meta = over[3].distributed_step.metadata
+    assert meta["overlap"] and meta["overlap_stages"] >= 2, meta
+    assert meta["overlap_schedule"]
+    assert not base[3].distributed_step.metadata["overlap"]
+    _, _, batches = _problem()
+    text = over[3].lowered_text(batches[0])
+    assert (text.count("optimization_barrier")
+            + text.count("opt-barrier")) >= meta["overlap_stages"] - 1
+    autodist_tpu.reset()
+
+
+def test_overlap_parity_with_host_ps_mix():
+    """A mixed plan (host-PS store + AllReduce vars) keeps the PS wire
+    outside the schedule; parity must hold and the schedule covers only
+    the device-resident sync units."""
+    from autodist_tpu.strategy.base import (AllReduceSynchronizer,
+                                            PSSynchronizer, Strategy,
+                                            VarConfig)
+    from autodist_tpu.strategy.ps_strategy import (reduction_devices,
+                                                   replica_devices)
+
+    class Mixed:
+        def __init__(self, overlap):
+            self.overlap = overlap
+
+        def build(self, item, spec):
+            dest = reduction_devices(spec)[0]
+            nodes = []
+            for i, n in enumerate(item.trainable_var_names):
+                if i % 2 == 0:
+                    sync = AllReduceSynchronizer(group=i)
+                else:
+                    sync = PSSynchronizer(reduction_destination=dest,
+                                          sync=True)
+                nodes.append(VarConfig(var_name=n, synchronizer=sync))
+            return Strategy(node_config=nodes, graph_config=GraphConfig(
+                replicas=list(replica_devices(spec)),
+                overlap=self.overlap))
+
+    base, over = _train_pair(lambda: Mixed(False), lambda: Mixed(True))
+    _assert_parity(base, over)
+    meta = over[3].distributed_step.metadata
+    # two AllReduce vars in distinct groups -> a 2-stage schedule; the
+    # two host-PS vars sync through the store, outside the schedule
+    assert meta["overlap"] and meta["overlap_stages"] == 2, meta
+    autodist_tpu.reset()
+
+
+def test_overlap_parity_fused_k4():
+    """The schedule must ride the fused lax.scan engine unchanged:
+    fit(fuse_steps=4) under overlap == fit(fuse_steps=4) under the
+    epilogue, with the k-fold dispatch saving intact."""
+    base, over = _train_pair(lambda: S.AllReduce(chunk_size=1),
+                             lambda: S.AllReduce(chunk_size=1,
+                                                 overlap=True),
+                             steps=8, fuse=4)
+    _assert_parity(base, over)
+    assert over[3].distributed_step.metadata["overlap"]
+    assert (over[3].distributed_step.dispatches
+            == base[3].distributed_step.dispatches == 8 // 4)
+    autodist_tpu.reset()
+
+
+def test_overlap_int8_wire_bf16_compute_composition():
+    """int8 quantized wire + managed bf16 compute tier + overlap must
+    compose: the schedule is the only difference between the legs, so
+    even the lossy paths line up."""
+    def mk(ov):
+        return S.AllReduce(wire_dtype="int8", chunk_size=1,
+                           compute_dtype="bf16", overlap=ov)
+
+    base, over = _train_pair(lambda: mk(False), lambda: mk(True))
+    _assert_parity(base, over, rtol=1e-5, atol=1e-6)
+    meta = over[3].distributed_step.metadata
+    assert meta["overlap"] and meta["compute_dtype"] == "bf16"
+    autodist_tpu.reset()
+
+
+def test_overlap_sentinel_verdict_identity(monkeypatch):
+    """The sentinel judges the COMPLETE synced gradient: an injected NaN
+    step must produce the identical skip verdict (and final state) under
+    the schedule as under the epilogue."""
+    monkeypatch.setenv("ADT_GRAD_FAULT_PLAN", json.dumps(
+        {"faults": [{"var": "w1", "mode": "nan", "step": 3}]}))
+    base, over = _train_pair(
+        lambda: S.AllReduce(chunk_size=1),
+        lambda: S.AllReduce(chunk_size=1, overlap=True),
+        steps=8, sentinel=True)
+    _assert_parity(base, over)
+    assert all(np.isfinite(over[0]))
+    b_stats = base[3].step_stats()["sentinel"]
+    o_stats = over[3].step_stats()["sentinel"]
+    assert b_stats["skips"] == o_stats["skips"] == 1
+    autodist_tpu.reset()
+
+
+def test_overlap_disarms_for_stale_host_ps():
+    """A stale host-PS plan cannot overlap (the schedule sequences SYNC
+    collectives): the lowering disarms with a warning instead of lowering
+    a wrong schedule, and the metadata records request vs reality."""
+    class StalePSOverlap(S.PS):
+        def build(self, item, spec):
+            strat = super().build(item, spec)
+            strat.graph_config.overlap = True
+            return strat
+
+    params, loss_fn, batches = _problem()
+    autodist_tpu.reset()
+    ad = autodist_tpu.AutoDist(strategy_builder=StalePSOverlap(staleness=2))
+    runner = ad.build(loss_fn, optax.adam(0.1), params, batches[0])
+    runner.init(params)
+    meta = runner.distributed_step.metadata
+    assert meta["overlap_requested"] and not meta["overlap"]
+    assert meta["overlap_stages"] == 0
+    autodist_tpu.reset()
+
+
+# -------------------------------------------------- telemetry counters
+
+
+def test_overlap_counters_preregistered_and_credited():
+    params, loss_fn, batches = _problem()
+    runner = _build(lambda: S.AllReduce(chunk_size=1, overlap=True),
+                    params, loss_fn, batches[0])
+    counters = tel.counters()
+    assert "overlap.exposed_wait_ms" in counters  # pre-registered at 0
+    stages = runner.distributed_step.metadata["overlap_stages"]
+    assert counters["overlap.buckets"] == stages > 0
+    autodist_tpu.reset()
+    # epilogue build: keys still present (scrapers see a stable schema)
+    runner = _build(lambda: S.AllReduce(), params, loss_fn, batches[0])
+    counters = tel.counters()
+    assert counters["overlap.buckets"] == 0.0
+    assert "overlap.exposed_wait_ms" in counters
+    autodist_tpu.reset()
+
+
+# ------------------------------------------------------ ADT409 lint
+
+
+def test_adt409_fires_on_barrier_free_armed_program():
+    from autodist_tpu.analysis.lowered import lint_lowered_text
+    serialized = """
+    %0 = "stablehlo.all_reduce"(%g0) : tensor<64xf32>
+    %1 = "stablehlo.all_reduce"(%g1) : tensor<64xf32>
+    """
+    codes = {d.code for d in lint_lowered_text(serialized,
+                                               overlap_armed=True)}
+    assert "ADT409" in codes
+    # same text, overlap not armed: silent
+    codes = {d.code for d in lint_lowered_text(serialized)}
+    assert "ADT409" not in codes
+    # armed AND chained: the schedule reached the program — silent
+    chained = serialized + '\n%2 = stablehlo.optimization_barrier %t\n'
+    codes = {d.code for d in lint_lowered_text(chained, overlap_armed=True)}
+    assert "ADT409" not in codes
+
+
+def test_adt409_through_lint_runner():
+    """End to end: a multi-stage overlap program lints clean; a one-var
+    model (degenerate 1-stage schedule — nothing to overlap, nothing to
+    chain) fires ADT409 through Runner.lint_lowered."""
+    params, loss_fn, batches = _problem()
+    runner = _build(lambda: S.AllReduce(chunk_size=1, overlap=True),
+                    params, loss_fn, batches[0])
+    codes = [d.code for d in runner.lint_lowered(batches[0])]
+    assert "ADT409" not in codes
+    autodist_tpu.reset()
+
+    rng = np.random.RandomState(0)
+    one_params = {"w": jnp.asarray(rng.randn(4, 2).astype(np.float32))}
+
+    def one_loss(p, b):
+        return jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2)
+
+    batch = {"x": rng.randn(8, 4).astype(np.float32),
+             "y": rng.randn(8, 2).astype(np.float32)}
+    runner = _build(lambda: S.AllReduce(chunk_size=1, overlap=True),
+                    one_params, one_loss, batch)
+    meta = runner.distributed_step.metadata
+    assert meta["overlap"] and meta["overlap_stages"] == 1
+    codes = [d.code for d in runner.lint_lowered(batch)]
+    assert "ADT409" in codes
+    autodist_tpu.reset()
+
+
+# ------------------------------------------------------- cost model
+
+
+def _cm_item(dense, layers, batch):
+    params = {"w%d" % i: jnp.zeros((dense, dense)) for i in range(layers)}
+
+    def loss_fn(p, b):
+        h = b["x"]
+        for i in range(layers):
+            h = jnp.tanh(h @ p["w%d" % i])
+        return jnp.mean(h ** 2)
+
+    return ModelItem(
+        loss_fn=loss_fn, optimizer=optax.sgd(0.1), params=params,
+        example_batch={"x": np.zeros((batch, dense), np.float32)}).prepare()
+
+
+def _cm_spec(ici):
+    nodes = [{"address": "10.0.0.%d" % (i + 1), "tpus": 4, "chief": i == 0,
+              "network_bandwidth": 25} for i in range(2)]
+    return ResourceSpec.from_dict(
+        {"nodes": nodes, "slice": {"type": "v5e", "ici_bandwidth": ici}})
+
+
+def test_cost_model_ranks_overlap_by_boundedness():
+    """The overlap estimate must rank ABOVE the epilogue when the wire
+    dominates (backward compute hides most of it) and BELOW when the
+    collectives are already cheap (per-stage launch latency outweighs
+    the hiding) — the two directions the searcher's knob turns on."""
+    # wire-dominated: 4 x 2048^2 fp32 grads over a 10 GB/s interconnect
+    it, sp = _cm_item(2048, 4, 2048), _cm_spec(10)
+    sim = Simulator(it, sp)
+    ep = sim.simulate(S.AllReduce(chunk_size=1).build(it, sp),
+                      "ep").breakdown
+    ov = sim.simulate(S.AllReduce(chunk_size=1, overlap=True).build(it, sp),
+                      "ov").breakdown
+    assert ov.overlap and ov.overlap_stages == 4
+    assert not ep.overlap
+    assert 0.0 < ov.overlap_exposed_s < ov.allreduce_s
+    assert ov.step_time_s < ep.step_time_s
+    # compute-dominated, fast wire: tiny collectives, the k extra
+    # launches cost more than the hiding saves
+    it, sp = _cm_item(256, 4, 65536), _cm_spec(800)
+    sim = Simulator(it, sp)
+    ep = sim.simulate(S.AllReduce(chunk_size=1).build(it, sp),
+                      "ep").breakdown
+    ov = sim.simulate(S.AllReduce(chunk_size=1, overlap=True).build(it, sp),
+                      "ov").breakdown
+    assert ep.compute_s > ep.allreduce_s
+    assert ov.step_time_s >= ep.step_time_s
+
+
+def test_cost_model_overlap_disarms_for_stale_ps():
+    """estimate() must mirror the lowering: a stale host-PS plan never
+    prices as overlapped (the lowering would disarm it)."""
+    class StalePSOverlap(S.PS):
+        def build(self, item, spec):
+            strat = super().build(item, spec)
+            strat.graph_config.overlap = True
+            return strat
+
+    it, sp = _cm_item(256, 4, 32), _cm_spec(400)
+    bd = Simulator(it, sp).simulate(
+        StalePSOverlap(staleness=2).build(it, sp), "stale").breakdown
+    assert not bd.overlap and bd.overlap_exposed_s == 0.0
+
+
+def test_calibration_scales_overlap_tail():
+    """The exposed overlap tail is wire time: a measured set whose only
+    error is the collective bandwidth must land on ar_scale and correct
+    the overlapped prediction too."""
+    import dataclasses
+    from autodist_tpu.simulator import calibration as cal_lib
+    from autodist_tpu.simulator.cost_model import CostBreakdown
+    compute_only = CostBreakdown(compute_s=1e-3, allreduce_s=0.0,
+                                 ps_s=0.0, latency_s=1e-5)
+    overlapped = CostBreakdown(compute_s=1e-3, allreduce_s=4e-3,
+                               ps_s=0.0, latency_s=1e-5, overlap=True,
+                               overlap_stages=4, overlap_exposed_s=2e-3)
+    # the "hardware" runs the wire 2x slower than modeled; compute and
+    # latency are measured dead-on (pinning their scales near 1)
+    truth = dataclasses.replace(overlapped, allreduce_s=8e-3,
+                                overlap_exposed_s=4e-3)
+    cal = cal_lib.fit([compute_only, overlapped],
+                      [compute_only.step_time_s, truth.step_time_s])
+    assert cal.ar_scale > 1.5
+    pred = cal_lib._predict(overlapped,
+                            (cal.compute_scale, cal.ar_scale,
+                             cal.ps_scale, cal.latency_scale))
+    assert abs(pred - truth.step_time_s) / truth.step_time_s < 0.05
+
+
+# -------------------------------------------------------- search space
+
+
+def _space():
+    it = _cm_item(64, 4, 32)
+    sp = _cm_spec(400)
+    return PlanSpace(it, sp), it, sp
+
+
+def test_planspec_overlap_axis_canon():
+    space, _, _ = _space()
+    plan = space.make_plan({n: VarChoice() for n in space.var_names},
+                           chunk_size=8, overlap=True)
+    assert plan.overlap and "overlap" in plan.describe()
+    # staleness window: the bit is dropped in the SPEC
+    host = {n: VarChoice(sync="PS") for n in space.var_names}
+    plan = space.make_plan(host, staleness=2, overlap=True)
+    assert not plan.overlap
+    # < 2 AllReduce-family sync units: nothing to overlap
+    one_ar = dict(host)
+    one_ar[space.var_names[0]] = VarChoice()
+    plan = space.make_plan(one_ar, overlap=True)
+    assert not plan.overlap
+
+
+def test_planspec_overlap_round_trips_and_builds():
+    space, it, sp = _space()
+    plan = space.make_plan({n: VarChoice() for n in space.var_names},
+                           chunk_size=8, overlap=True)
+    strat = space.build(plan)
+    assert strat.graph_config.overlap
+    back = space.from_strategy(strat)
+    assert back is not None and back.overlap
+    # GraphConfig dict round-trip carries the bit
+    d = strat.graph_config.to_dict()
+    assert d["overlap"] is True
+    assert GraphConfig.from_dict(d).overlap
+    assert not GraphConfig.from_dict({"replicas": []}).overlap
+    # zoo builder round-trip: AllReduce(overlap=True) -> spec -> build
+    back2 = space.from_strategy(
+        S.AllReduce(chunk_size=8, overlap=True).build(it, sp))
+    assert back2 is not None and back2.overlap
+    assert space.build(back2).graph_config.overlap
+
+
+def test_planspec_toggle_overlap_mutation():
+    import random
+    space, _, _ = _space()
+    plan = space.make_plan({n: VarChoice() for n in space.var_names})
+    assert not plan.overlap
+    rng = random.Random(7)
+    toggled = False
+    for _ in range(300):
+        out = space.mutate(plan, rng)
+        if out is None:
+            continue
+        new_plan, desc = out
+        if desc.startswith("overlap="):
+            toggled = True
+            assert new_plan.overlap != plan.overlap
+    assert toggled, "toggle_overlap never offered on an all-AR plan"
+    # a host-PS-mixed overlapped plan that mutates a staleness window on
+    # must drop the overlap bit in the same move
+    host = {n: VarChoice(sync="PS") for n in space.var_names}
+    host[space.var_names[0]] = VarChoice()
+    host[space.var_names[1]] = VarChoice()
+    plan = space.make_plan(host, overlap=True)
+    assert plan.overlap
+    hit = False
+    for _ in range(300):
+        out = space.mutate(plan, rng)
+        if out is None:
+            continue
+        new_plan, desc = out
+        if desc.startswith("stale=") and new_plan.staleness:
+            assert not new_plan.overlap
+            hit = True
+    assert hit, "staleness mutation never offered on the host-PS plan"
+
+
+def test_planspec_overlap_seeds_present():
+    space, _, _ = _space()
+    by_name = dict(space.seeds())
+    assert by_name["seed:ar-overlap"].overlap
+    assert by_name["seed:ar-overlap"].chunk_size == 8
+    # the zero seed keeps its zero vars AND the overlap bit
+    zp = by_name["seed:zero-overlap"]
+    assert zp.overlap and any(c.zero for _, c in zp.choices)

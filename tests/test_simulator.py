@@ -240,11 +240,17 @@ def test_auto_strategy_remat_fallback_candidate():
     built = auto.build(item, spec)
     assert auto.last_ranking[0].label == "AllReduce/remat"
     assert built.graph_config.remat == "dots"
-    # the searched space reaches the same conclusion: under the squeeze
-    # the default (search on) picks a remat'd plan too
-    searched = AutoStrategy(
-        hbm_capacity_bytes=(remat_hbm + others_min) / 2).build(item, spec)
-    assert searched.graph_config.remat == "dots"
+    # the searched space satisfies the same squeeze, but is NOT required
+    # to satisfy it with remat: with the bf16 compute tier and per-var
+    # sharding in the space the search can project even less HBM than
+    # the remat zoo candidate — assert the budget is respected and that
+    # the winning plan relieves HBM through one of the managed axes
+    cap = (remat_hbm + others_min) / 2
+    auto2 = AutoStrategy(hbm_capacity_bytes=cap)
+    searched = auto2.build(item, spec)
+    assert auto2.last_ranking[0].breakdown.hbm_bytes <= cap
+    assert (searched.graph_config.remat == "dots"
+            or searched.graph_config.compute_dtype == "bf16")
 
 
 def test_scan_activations_scale_with_trip_count():
