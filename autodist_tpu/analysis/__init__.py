@@ -12,6 +12,9 @@ Public surface:
 - :func:`estimate_from_text` / :func:`plan_memory_report` /
   :func:`budget_diagnostics` — the static peak-HBM analyzers, ADT501-503
   (``memory.py``);
+- :func:`verify_topology` / :func:`lint_schedule` /
+  :func:`schedule_level_bytes` — the topology-aware communication
+  analyzer, ADT520-525 (``topology.py``);
 - :class:`Diagnostic` / :class:`Severity` / :class:`DiagnosticError` /
   :class:`StrategyVerificationError` — the typed diagnostics framework
   (``diagnostics.py``);
@@ -29,7 +32,9 @@ __all__ = ["verify", "lint_lowered_text", "lint_runner", "Diagnostic",
            "parse_hlo_text", "collective_schedule", "compare_schedules",
            "CollectiveSchedule", "estimate_from_text", "MemoryEstimate",
            "plan_memory_report", "budget_diagnostics",
-           "donation_diagnostics"]
+           "donation_diagnostics", "verify_topology", "lint_schedule",
+           "schedule_level_bytes", "plan_level_bytes", "resolve_schedule",
+           "Topology", "TopologyConfigError"]
 
 _DIAG_NAMES = {"Diagnostic", "Severity", "DiagnosticError",
                "StrategyVerificationError", "format_table",
@@ -39,6 +44,9 @@ _HLO_NAMES = {"parse_hlo_text", "collective_schedule", "compare_schedules",
 _MEMORY_NAMES = {"estimate_from_text", "MemoryEstimate",
                  "plan_memory_report", "budget_diagnostics",
                  "donation_diagnostics"}
+_TOPOLOGY_NAMES = {"verify_topology", "lint_schedule",
+                   "schedule_level_bytes", "plan_level_bytes",
+                   "resolve_schedule", "Topology", "TopologyConfigError"}
 
 
 def __getattr__(name):
@@ -54,6 +62,9 @@ def __getattr__(name):
     if name in _MEMORY_NAMES:
         from autodist_tpu.analysis import memory
         return getattr(memory, name)
+    if name in _TOPOLOGY_NAMES:
+        from autodist_tpu.analysis import topology
+        return getattr(topology, name)
     if name in _DIAG_NAMES:
         from autodist_tpu.analysis import diagnostics
         return getattr(diagnostics, name)

@@ -180,6 +180,32 @@ def default_spec(num_devices: int = 4):
                     "tpus": num_devices}]})
 
 
+def topology_spec(topology):
+    """Synthesize the multi-node dryrun spec a topology describes — one
+    node per host, ``chips_per_host`` chips each — with the topology
+    attached, so a 64-chip pod plan lints (ADT52x, per-level pricing)
+    with zero hardware."""
+    from autodist_tpu.resource_spec import ResourceSpec
+    nodes = [{"address": "10.0.0.%d" % (h + 1), "chief": h == 0,
+              "tpus": topology.chips_per_host}
+             for h in range(topology.hosts)]
+    return ResourceSpec.from_dict({"nodes": nodes}).set_topology(topology)
+
+
+def _load_topology(args):
+    """Resolve ``--topology``: ``(topology, error_diagnostic)`` — a
+    malformed file becomes an ADT524 finding, never a traceback."""
+    if not args.topology:
+        return None, None
+    from autodist_tpu.analysis.topology import (TopologyConfigError,
+                                                diagnostic_for_config_error)
+    from autodist_tpu.resource_spec import Topology
+    try:
+        return Topology.from_yaml(args.topology), None
+    except TopologyConfigError as e:
+        return None, diagnostic_for_config_error(e)
+
+
 def _report(args, label, diags, spec, memory: Optional[dict] = None) -> int:
     """Print the diagnostics (table or JSON); returns the error count."""
     from autodist_tpu.analysis.diagnostics import (Severity, format_table,
@@ -224,6 +250,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--spec", default=None, metavar="YAML",
                    help="resource spec yaml (default: synthetic 4-chip "
                         "single node)")
+    p.add_argument("--topology", default=None, metavar="YAML",
+                   help="multi-level topology yaml (hosts x chips with "
+                        "per-level link bandwidth): arms the ADT52x "
+                        "topology-aware communication lints and, without "
+                        "--spec, synthesizes a matching hosts x "
+                        "chips_per_host dryrun spec — how CI lints "
+                        "pod-scale plans with zero hardware. A malformed "
+                        "file is reported as ADT524 (exit 1)")
     p.add_argument("--devices", type=int, default=4,
                    help="device count of the synthetic spec (default 4)")
     p.add_argument("--format", choices=("table", "json"), default="table",
@@ -265,8 +299,10 @@ def build_parser() -> argparse.ArgumentParser:
 def _programs_mode(args) -> int:
     """Lint lowered-program text dumps: memory/donation/communication per
     program, cross-program schedule consistency vs the first (reference)
-    program. Exit 1 on any ADT error."""
-    import os
+    program, and — with ``--topology`` — the per-link-level ADT52x pass
+    over every program's collective schedule. Exit 1 on any ADT error."""
+    import dataclasses as _dc
+
     from autodist_tpu.analysis import hlo as hlo_lib
     from autodist_tpu.analysis import memory as memory_lib
     from autodist_tpu.analysis import numerics as numerics_lib
@@ -275,6 +311,16 @@ def _programs_mode(args) -> int:
     from autodist_tpu.analysis.lowered import lint_lowered_text
     budget = (args.hbm_budget * memory_lib.GIB
               if args.hbm_budget is not None else None)
+    topology, topo_diag = _load_topology(args)
+    if topo_diag is not None:
+        print(format_table([topo_diag]))
+        return 1
+
+    def _attribute(diags, path):
+        # every finding names its file: CI output over N programs is
+        # unactionable when all findings read as the reference's
+        return [d if d.var else _dc.replace(d, var=path) for d in diags]
+
     per_program = []
     for path in args.programs:
         try:
@@ -283,7 +329,9 @@ def _programs_mode(args) -> int:
         except OSError as e:
             print("error: cannot read %s: %s" % (path, e), file=sys.stderr)
             return 2
-        label = os.path.basename(path)
+        # the full invocation path, not the basename: two dumps named
+        # train.hlo in different directories must stay distinguishable
+        label = path
         prog = hlo_lib.parse_hlo_text(text)
         est = memory_lib.estimate_from_text(prog)
         sched = hlo_lib.collective_schedule(prog)
@@ -294,14 +342,20 @@ def _programs_mode(args) -> int:
         if budget is not None:
             diags += memory_lib.budget_diagnostics(
                 est.peak_hbm_bytes, budget, source="lowered-program")
-        per_program.append((label, est, sched, diags))
+        if topology is not None:
+            from autodist_tpu.analysis.topology import lint_schedule
+            diags += lint_schedule(sched, topology, label=label)
+        per_program.append((label, est, sched, _attribute(diags, path)))
     ref_label, _, ref_sched, _ = per_program[0]
     cross = []
     for label, _, sched, _ in per_program[1:]:
-        cross += hlo_lib.compare_schedules(ref_sched, sched,
-                                           ref_label, label)
-        cross += numerics_lib.compare_schedule_dtypes(ref_sched, sched,
+        # cross-program findings anchor to the OFFENDING (non-reference)
+        # file's path via ``var`` so multi-file CI output is actionable
+        batch = hlo_lib.compare_schedules(ref_sched, sched,
+                                          ref_label, label)
+        batch += numerics_lib.compare_schedule_dtypes(ref_sched, sched,
                                                       ref_label, label)
+        cross += _attribute(batch, label)
     all_diags = [d for (_, _, _, ds) in per_program for d in ds] + cross
     n_errors = sum(1 for d in all_diags if d.severity >= Severity.ERROR)
     if args.format == "json":
@@ -362,8 +416,18 @@ def main(argv=None) -> int:
               % (args.example, type(e).__name__, e), file=sys.stderr)
         return 2
 
-    spec = (ResourceSpec(args.spec) if args.spec
-            else default_spec(args.devices))
+    topology, topo_diag = _load_topology(args)
+    if topo_diag is not None:
+        _report(args, args.strategy, [topo_diag], default_spec(1))
+        return 1
+    if args.spec:
+        spec = ResourceSpec(args.spec)
+        if topology is not None:
+            spec.set_topology(topology)
+    elif topology is not None:
+        spec = topology_spec(topology)
+    else:
+        spec = default_spec(args.devices)
 
     if args.strategy_json:
         from autodist_tpu.analysis.diagnostics import DiagnosticError

@@ -207,6 +207,15 @@ CODES = {
     "ADT503": "un-donated superstep carry doubles state residency",
     "ADT510": "same-mesh programs issue incompatible collective orders",
     "ADT511": "cross-program replica-group mismatch on a collective",
+    "ADT520": "flat collective spans the inter-host level where the "
+              "hierarchical schedule crosses provably fewer bytes",
+    "ADT521": "replica group straddles hosts non-contiguously",
+    "ADT522": "synthesized schedule is not reduction-equivalent to the "
+              "op it replaces",
+    "ADT523": "per-level byte estimate exceeds the level's "
+              "bandwidth-delay budget",
+    "ADT524": "malformed topology spec",
+    "ADT525": "topology cannot price this collective/plan",
     # ADT6xx — numerics safety (analysis/numerics.py, rules.verify_numerics):
     # the static gate that makes the bf16 compute tier shippable — low-
     # precision compute is allowed, low-precision ACCUMULATION and low-

@@ -1105,3 +1105,17 @@ def _r_staleness_topology(ctx: Context) -> Iterable[Diagnostic]:
             "ADT404",
             "staleness window configured on a single-node spec — "
             "cross-process pacing is a no-op here (%d vars)" % len(stale))
+
+
+@rule
+def _r_topology_collectives(ctx: Context) -> Iterable[Diagnostic]:
+    """ADT52x plan-level pass: delegated to analysis/topology.py and
+    gated on the spec declaring a multi-level topology, so flat specs
+    (the default — ``topology()`` is None) lint exactly as before."""
+    if ctx.spec is None or not hasattr(ctx.spec, "topology"):
+        return
+    if ctx.spec.topology() is None:
+        return
+    from autodist_tpu.analysis.topology import verify_topology
+    for d in verify_topology(ctx.strategy, ctx.var_infos, ctx.spec):
+        yield d

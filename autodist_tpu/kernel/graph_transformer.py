@@ -1658,9 +1658,13 @@ class GraphTransformer:
                 bst = new_bucket_state.get(b.key)
                 bst_local = bst[0] if bst is not None else None
                 bucket_psum = psum
-                if b.spec == "DCN" and dcn:
+                sched = getattr(b, "schedule", "auto")
+                if (b.spec == "DCN" or sched == "hier") and dcn:
                     bucket_psum = lambda x: collectives.hierarchical_psum(  # noqa: E731
                         x, ici, dcn)
+                elif sched == "rhd":
+                    bucket_psum = lambda x: collectives.rhd_psum(  # noqa: E731
+                        x, all_axes)
                 out, nst = collectives.bucket_reduce(
                     b, gin, bst_local, bucket_psum, N, ring_axes=ring_axes)
                 synced.update(out)

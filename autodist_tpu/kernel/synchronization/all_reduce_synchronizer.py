@@ -46,6 +46,11 @@ class AllReduceSynchronizer(Synchronizer):
         # this per-var compressor only serves the psum fallback paths
         self.group = getattr(config, "group", 0)
         self.spec = getattr(config, "spec", "AUTO")
+        # collective algorithm: auto | ring | rhd | hier (strategy/base.py
+        # docs; resolution semantics in analysis/topology.py). Consumed in
+        # psum() and by the bucketing layer via graph_transformer.
+        self.schedule = (getattr(config, "schedule", "auto")
+                         or "auto").lower()
         if (layout is not None and layout.partitioned
                 and self.compressor.name != "NoneCompressor"):
             logging.warning("var %s: compressor %s is ignored on the "
@@ -58,17 +63,26 @@ class AllReduceSynchronizer(Synchronizer):
                             var_name)
 
     def psum(self, x):
-        """The ``spec`` hint is consumed here: ``DCN`` lowers the reduction
-        to the bandwidth-hierarchical form (reduce-scatter over ICI,
-        all-reduce the shard over DCN, all-gather over ICI) so the slow
-        cross-host links carry 1/N_ici of the payload. AUTO/ICI take the
-        single fused psum and let XLA schedule it."""
+        """The ``spec`` hint and the ``schedule`` knob are consumed here:
+        ``DCN`` (or ``schedule=hier`` when the mesh has cross-host axes)
+        lowers the reduction to the bandwidth-hierarchical form
+        (reduce-scatter over ICI, all-reduce the shard over DCN,
+        all-gather over ICI) so the slow cross-host links carry 1/N_ici
+        of the payload; ``schedule=rhd`` lowers to the explicit
+        reduce-scatter + all-gather composition (recursive
+        halving/doubling shape). AUTO/ICI ring takes the single fused
+        psum and lets XLA schedule it; ``hier`` on a mesh with no
+        cross-host axes falls back to that ring (resolver refusal —
+        there is nothing to hierarchize)."""
         axes = (self.mesh_axis,) + self.extra_axes
         dcn = tuple(a for a in axes if a in self.dcn_axes)
-        if self.spec == "DCN" and dcn:
+        if (self.spec == "DCN" or self.schedule == "hier") and dcn:
             from autodist_tpu.parallel.collectives import hierarchical_psum
             ici = tuple(a for a in axes if a not in self.dcn_axes)
             return hierarchical_psum(x, ici, dcn)
+        if self.schedule == "rhd":
+            from autodist_tpu.parallel.collectives import rhd_psum
+            return rhd_psum(x, axes)
         return super().psum(x)
 
     def state_init(self, grad_shape, dtype):

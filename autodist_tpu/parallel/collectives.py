@@ -35,6 +35,7 @@ class Bucket:
     dtype: str
     compressor_name: str
     spec: str = "AUTO"              # AUTO | ICI | DCN communication hint
+    schedule: str = "auto"          # auto | ring | rhd | hier algorithm knob
 
     @property
     def total_size(self) -> int:
@@ -60,18 +61,25 @@ def make_buckets(ar_vars: Dict[str, object], var_infos) -> Tuple[List[Bucket], D
             continue
         dtype = var_infos[name].dtype
         spec = getattr(sync, "spec", "AUTO")
-        groups.setdefault((sync.group, comp, dtype, spec), []).append(name)
+        sched = (getattr(sync, "schedule", "auto") or "auto").lower()
+        groups.setdefault((sync.group, comp, dtype, spec, sched),
+                          []).append(name)
     buckets = []
-    for (gid, comp, dtype, spec), names in sorted(groups.items(),
-                                                  key=lambda kv: kv[0][:2] + kv[0][3:]):
+    for (gid, comp, dtype, spec, sched), names in sorted(
+            groups.items(), key=lambda kv: kv[0][:2] + kv[0][3:]):
         # deterministic in-bucket order by md5 instance key (reference parity)
         names = sorted(names, key=CollectiveKey.instance_key)
         shapes = [tuple(var_infos[n].shape) for n in names]
         sizes = [int(np.prod(s or (1,))) for s in shapes]
+        key = "g%d_%s_%s_%s" % (gid, comp, dtype, spec)
+        if sched != "auto":
+            # schedule-pinned buckets key separately — the bucket psum
+            # lowers per algorithm, so mixing schedules in one bucket
+            # would silently drop the pin for all but one member
+            key += "_%s" % sched
         buckets.append(Bucket(
-            key="g%d_%s_%s_%s" % (gid, comp, dtype, spec), var_names=names,
-            shapes=shapes, sizes=sizes, dtype=dtype, compressor_name=comp,
-            spec=spec))
+            key=key, var_names=names, shapes=shapes, sizes=sizes,
+            dtype=dtype, compressor_name=comp, spec=spec, schedule=sched))
     return buckets, per_var
 
 
@@ -600,3 +608,115 @@ def hierarchical_psum(x, ici_axes, dcn_axes):
     shard = jax.lax.psum(shard, dcn_axes)
     full = jax.lax.all_gather(shard, ici_axes, axis=0, tiled=True)
     return full[:L].reshape(shape)
+
+
+# ------------------------------------------ synthesized collective schedules
+
+
+# The per-sync-op schedule algorithms the searcher may pick and the cost
+# model prices per topology level:
+#   ring — one fused all-reduce (XLA's default ring): 2(n-1)/n of the
+#          payload per link, 2(n-1) hops;
+#   rhd  — recursive halving/doubling, realized as reduce-scatter +
+#          all-gather over the same axes: identical per-link bytes, but
+#          ~2*log2(n) latency hops instead of 2(n-1);
+#   hier — hierarchical two-level: reduce-scatter over the intra-host
+#          axes at fast bandwidth, all-reduce the 1/c shard over the
+#          per-host leaders, all-gather back over intra-host — the slow
+#          inter-host links carry 1/c of the payload.
+SCHEDULE_ALGORITHMS = ("ring", "rhd", "hier")
+
+
+def rhd_psum(x, axes):
+    """Recursive-halving/doubling all-reduce over ``axes``, realized as
+    the reduce-scatter + all-gather composition (halving = psum_scatter,
+    doubling = all_gather). Exactly the same summation as ``psum`` —
+    every element is reduced once by the scatter phase and broadcast
+    bit-identically by the gather — so replicated param copies cannot
+    drift. Must run inside shard_map with ``axes`` bound."""
+    axes = tuple(axes)
+    if not axes:
+        return x
+    n = 1
+    for a in axes:
+        n *= (jax.lax.axis_size(a) if hasattr(jax.lax, "axis_size")
+              else int(jax.lax.psum(1, a)))
+    if n <= 1:
+        return x
+    shape = x.shape
+    flat = x.reshape(-1)
+    L = flat.shape[0]
+    pad = (-L) % n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    shard = jax.lax.psum_scatter(flat, axes, scatter_dimension=0,
+                                 tiled=True)
+    full = jax.lax.all_gather(shard, axes, axis=0, tiled=True)
+    return full[:L].reshape(shape)
+
+
+def synthesize_collective_candidates(unit: str, axes, intra_axes=(),
+                                     inter_axes=(), payload_elems: int = 0,
+                                     wire_dtype: str = "fp32",
+                                     var_names=()):
+    """Synthesize the candidate stage compositions for one ``reduce``
+    sync unit over named mesh ``axes`` — the TACCL-style sketch
+    expansion (arXiv 2111.04867) restricted to the three algorithms the
+    lowering can execute. Returns ``{algorithm: (CollectiveOp, ...)}``;
+    the ``hier`` candidate exists only when both an intra- and an
+    inter-host axis are named (the multi-level reduction of arXiv
+    2110.10548 needs two levels to place onto). Every candidate is
+    reduction-equivalent to the flat reduce it replaces — asserted by
+    :func:`reduction_equivalent`, which the ADT522 lint re-checks."""
+    axes = tuple(axes)
+    intra = tuple(a for a in (intra_axes or ()) if a in axes)
+    inter = tuple(a for a in (inter_axes or ()) if a in axes)
+    names = tuple(var_names)
+
+    def op(kind, over, elems=payload_elems):
+        return CollectiveOp(kind=kind, unit=unit, axes=tuple(over),
+                            var_names=names, payload_elems=int(elems),
+                            wire_dtype=wire_dtype)
+
+    out = {
+        "ring": (op("reduce", axes),),
+        "rhd": (op("reduce_scatter", axes),
+                op("all_gather", axes)),
+    }
+    if intra and inter:
+        out["hier"] = (op("reduce_scatter", intra),
+                       op("reduce", inter),
+                       op("all_gather", intra))
+    return out
+
+
+def reduction_equivalent(stages, target) -> bool:
+    """True when a synthesized stage composition computes exactly the
+    reduction ``target`` does — the ADT522 contract. A composition is
+    equivalent iff (a) it reduces over exactly the target's axes, each
+    axis exactly once, (b) every reduce_scatter is matched by a later
+    all_gather over the SAME axes (the shard comes back), and (c)
+    nothing else is interleaved. ``target`` is a ``reduce``
+    :class:`CollectiveOp` (or anything with ``.axes``)."""
+    want = tuple(target.axes)
+    ops = tuple(stages)
+    if not ops:
+        return False
+    reduced = []           # axes whose reduction has been applied
+    open_scatters = []     # reduce_scatter axes awaiting their all_gather
+    for op in ops:
+        if op.kind == "reduce":
+            reduced.extend(op.axes)
+        elif op.kind == "reduce_scatter":
+            reduced.extend(op.axes)
+            open_scatters.append(tuple(op.axes))
+        elif op.kind == "all_gather":
+            if not open_scatters or open_scatters[-1] != tuple(op.axes):
+                return False  # gathers a shard nothing scattered
+            open_scatters.pop()
+        else:
+            return False
+    if open_scatters:
+        return False  # a shard never came back: not an all-reduce
+    return sorted(reduced) == sorted(want) and len(set(reduced)) == len(
+        reduced)

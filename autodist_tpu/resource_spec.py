@@ -47,6 +47,234 @@ class DeviceType(Enum):
     GPU = "GPU"
 
 
+# ----------------------------------------------------- multi-level topology
+
+
+class TopologyConfigError(ValueError):
+    """A ``topology:`` entry holds a value that cannot mean anything.
+
+    Raised at spec-parse time instead of tracebacking mid-build: a typo'd
+    ``chips_per_host: 0`` (or a bandwidth of ``-25``) that survived into
+    the cost model would surface as a ZeroDivisionError three layers deep
+    with no mention of the yaml knob that caused it. Mirrors
+    :class:`~autodist_tpu.runtime.elastic.ElasticConfigError`'s named-knob
+    message shape so operators grep one pattern."""
+
+    def __init__(self, knob: str, raw, why: str):
+        self.knob = knob
+        self.raw = raw
+        super().__init__(
+            "invalid %s=%r: %s (unset it, or set a valid value)"
+            % (knob, raw, why))
+
+
+class TopologyLevel:
+    """One link level of the physical hierarchy, innermost (fastest)
+    first: ``name`` ("ici", "dcn", ...), ``bandwidth_gbps`` per link and
+    direction, and an optional per-step ``budget_ms`` the ADT523 lint
+    checks per-level byte estimates against."""
+
+    def __init__(self, name: str, bandwidth_gbps: float,
+                 budget_ms: Optional[float] = None):
+        self.name = str(name)
+        self.bandwidth_gbps = float(bandwidth_gbps)
+        self.budget_ms = float(budget_ms) if budget_ms is not None else None
+
+    @property
+    def bandwidth_bytes_s(self) -> float:
+        return self.bandwidth_gbps * 1e9 / 8.0
+
+    def to_dict(self) -> dict:
+        d = {"name": self.name, "bandwidth_gbps": self.bandwidth_gbps}
+        if self.budget_ms is not None:
+            d["budget_ms"] = self.budget_ms
+        return d
+
+    def __repr__(self):
+        return "TopologyLevel(%s, %.3g Gbps)" % (self.name,
+                                                 self.bandwidth_gbps)
+
+
+class Topology:
+    """First-class multi-level device topology: ``hosts`` x
+    ``chips_per_host`` chips with one :class:`TopologyLevel` per link
+    tier, innermost first (level 0 = intra-host ICI, level 1 = the
+    inter-host network). Device index ``i`` lives on host
+    ``i // chips_per_host`` — the contiguous layout every mesh builder
+    here emits, and what :meth:`host_of` encodes for the analyzer.
+
+    Loudly validated (:class:`TopologyConfigError`) at construction: a
+    malformed hierarchy must fail at spec-parse time with the named yaml
+    knob, not traceback mid-build."""
+
+    def __init__(self, hosts: int, chips_per_host: int,
+                 levels: List[TopologyLevel]):
+        if not isinstance(hosts, int) or hosts < 1:
+            raise TopologyConfigError("topology.hosts", hosts,
+                                      "must be a positive integer")
+        if not isinstance(chips_per_host, int) or chips_per_host < 1:
+            raise TopologyConfigError("topology.chips_per_host",
+                                      chips_per_host,
+                                      "must be a positive integer")
+        if not levels:
+            raise TopologyConfigError("topology.levels", levels,
+                                      "at least one link level is required")
+        if hosts > 1 and len(levels) < 2:
+            raise TopologyConfigError(
+                "topology.levels", [lv.name for lv in levels],
+                "a %d-host topology needs an inter-host level (got only "
+                "the intra-host level)" % hosts)
+        seen = set()
+        for i, lv in enumerate(levels):
+            knob = "topology.levels[%d].bandwidth_gbps" % i
+            bw = lv.bandwidth_gbps
+            if not (bw > 0) or bw != bw or bw == float("inf"):
+                raise TopologyConfigError(
+                    knob, bw, "per-level link bandwidth must be a positive "
+                    "finite number")
+            if lv.budget_ms is not None and not lv.budget_ms > 0:
+                raise TopologyConfigError(
+                    "topology.levels[%d].budget_ms" % i, lv.budget_ms,
+                    "per-level budget must be a positive number of "
+                    "milliseconds")
+            if lv.name in seen:
+                raise TopologyConfigError("topology.levels[%d].name" % i,
+                                          lv.name, "duplicate level name")
+            seen.add(lv.name)
+        self.hosts = hosts
+        self.chips_per_host = chips_per_host
+        self.levels = list(levels)
+
+    # ------------------------------------------------------------- geometry
+
+    @property
+    def num_devices(self) -> int:
+        return self.hosts * self.chips_per_host
+
+    def host_of(self, device_index: int) -> int:
+        """Host holding device ``device_index`` (contiguous layout)."""
+        if not 0 <= device_index < self.num_devices:
+            raise TopologyConfigError(
+                "topology", device_index,
+                "device index out of range for a %dx%d topology"
+                % (self.hosts, self.chips_per_host))
+        return device_index // self.chips_per_host
+
+    @property
+    def intra_level(self) -> TopologyLevel:
+        """The innermost (intra-host) link level."""
+        return self.levels[0]
+
+    @property
+    def inter_level(self) -> Optional[TopologyLevel]:
+        """The inter-host link level; ``None`` on a single-level spec."""
+        return self.levels[1] if len(self.levels) > 1 else None
+
+    def level_bandwidth_bytes_s(self, name: str) -> float:
+        for lv in self.levels:
+            if lv.name == name:
+                return lv.bandwidth_bytes_s
+        raise TopologyConfigError("topology.levels", name,
+                                  "no such level (have %s)"
+                                  % [lv.name for lv in self.levels])
+
+    # -------------------------------------------------------- serialization
+
+    def to_dict(self) -> dict:
+        return {"hosts": self.hosts, "chips_per_host": self.chips_per_host,
+                "levels": [lv.to_dict() for lv in self.levels]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Topology":
+        """Parse one ``topology:`` section. Accepts ``chips_per_host`` or
+        a total ``chips`` count (which must divide evenly across
+        ``hosts`` — satellite of ADT524); levels are dicts of
+        ``name``/``bandwidth_gbps``(/``budget_ms``), innermost first."""
+        if not isinstance(d, dict):
+            raise TopologyConfigError("topology", d,
+                                      "must be a mapping of hosts/"
+                                      "chips_per_host/levels")
+        try:
+            hosts = int(d.get("hosts", 1))
+        except (TypeError, ValueError):
+            raise TopologyConfigError("topology.hosts", d.get("hosts"),
+                                      "must be a positive integer")
+        if "chips_per_host" in d:
+            try:
+                cph = int(d["chips_per_host"])
+            except (TypeError, ValueError):
+                raise TopologyConfigError("topology.chips_per_host",
+                                          d["chips_per_host"],
+                                          "must be a positive integer")
+        elif "chips" in d:
+            try:
+                chips = int(d["chips"])
+            except (TypeError, ValueError):
+                raise TopologyConfigError("topology.chips", d["chips"],
+                                          "must be a positive integer")
+            if hosts < 1:
+                raise TopologyConfigError("topology.hosts", hosts,
+                                          "must be a positive integer")
+            if chips < 1 or chips % hosts != 0:
+                raise TopologyConfigError(
+                    "topology.chips", chips,
+                    "total chip count must divide evenly across %d host(s)"
+                    % hosts)
+            cph = chips // hosts
+        else:
+            raise TopologyConfigError(
+                "topology", sorted(d), "one of chips_per_host or chips is "
+                "required")
+        raw_levels = d.get("levels")
+        if not isinstance(raw_levels, (list, tuple)) or not raw_levels:
+            raise TopologyConfigError("topology.levels", raw_levels,
+                                      "must be a non-empty list of link "
+                                      "levels (innermost first)")
+        levels = []
+        for i, entry in enumerate(raw_levels):
+            if not isinstance(entry, dict) or "bandwidth_gbps" not in entry:
+                raise TopologyConfigError(
+                    "topology.levels[%d]" % i, entry,
+                    "each level needs name and bandwidth_gbps")
+            try:
+                bw = float(entry["bandwidth_gbps"])
+            except (TypeError, ValueError):
+                raise TopologyConfigError(
+                    "topology.levels[%d].bandwidth_gbps" % i,
+                    entry["bandwidth_gbps"], "must be a number")
+            budget = entry.get("budget_ms")
+            if budget is not None:
+                try:
+                    budget = float(budget)
+                except (TypeError, ValueError):
+                    raise TopologyConfigError(
+                        "topology.levels[%d].budget_ms" % i,
+                        entry.get("budget_ms"), "must be a number")
+            levels.append(TopologyLevel(
+                entry.get("name", "level%d" % i), bw, budget))
+        return cls(hosts, cph, levels)
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "Topology":
+        """Load a topology from a yaml file — either a bare topology
+        mapping or a full resource spec with a ``topology:`` section (the
+        analysis CLI's ``--topology FILE`` input)."""
+        if not os.path.isfile(path):
+            raise TopologyConfigError("topology", path,
+                                      "topology spec file not found")
+        with open(path, "r") as f:
+            d = yaml.safe_load(f) or {}
+        if not isinstance(d, dict):
+            raise TopologyConfigError("topology", path,
+                                      "topology yaml must be a mapping")
+        return cls.from_dict(d.get("topology", d))
+
+    def __repr__(self):
+        return "Topology(%d hosts x %d chips, levels=%s)" % (
+            self.hosts, self.chips_per_host,
+            [lv.name for lv in self.levels])
+
+
 class DeviceSpec:
     """One device: ``<host>:<TYPE>:<index>``."""
 
@@ -147,6 +375,7 @@ class ResourceSpec:
         self._ssh_config_map = SSHConfigMap({}, {})
         self._chief_address: Optional[str] = None
         self._slice_info: dict = {}
+        self._topology: Optional[Topology] = None
         if resource_file is not None:
             if not os.path.isfile(resource_file):
                 raise FileNotFoundError("resource spec file not found: %s" % resource_file)
@@ -194,6 +423,11 @@ class ResourceSpec:
                 raise ValueError("multi-node resource spec must mark one node chief: true")
         self._ssh_config_map = SSHConfigMap(d.get("ssh", {}), node_groups)
         self._slice_info = dict(d.get("slice", {}))
+        if d.get("topology") is not None:
+            # loud validation at parse time (TopologyConfigError names the
+            # yaml knob) — a malformed hierarchy must never reach the cost
+            # model as a traceback mid-build
+            self._topology = Topology.from_dict(d["topology"])
         logging.debug("ResourceSpec: %d nodes, chief=%s", len(self._nodes), self._chief_address)
 
     # ------------------------------------------------------------------ props
@@ -255,6 +489,19 @@ class ResourceSpec:
     def network_bandwidth_gbps(self, address: str) -> float:
         return self._nodes[address].network_bandwidth_gbps
 
+    def topology(self) -> Optional[Topology]:
+        """The explicit multi-level topology (``topology:`` section), or
+        ``None`` when the spec declares none — per-level collective
+        pricing and the ADT52x analyzer only engage on an explicit
+        hierarchy, so flat single-level specs price exactly as before."""
+        return self._topology
+
+    def set_topology(self, topology: Optional[Topology]) -> "ResourceSpec":
+        """Attach (or clear) the multi-level topology in place — the
+        analysis CLI's ``--topology FILE`` hook. Returns self."""
+        self._topology = topology
+        return self
+
     def ici_bandwidth_gbps(self) -> float:
         return float(self._slice_info.get("ici_bandwidth", DEFAULT_ICI_BANDWIDTH_GBPS))
 
@@ -308,6 +555,7 @@ class ResourceSpec:
         spec._chief_address = self._chief_address
         spec._ssh_config_map = self._ssh_config_map
         spec._slice_info = dict(self._slice_info)
+        spec._topology = self._topology
         logging.warning("resource spec reduced: dropped %s, %d node(s) "
                         "remain", sorted(drop & set(self._nodes)),
                         len(spec._nodes))

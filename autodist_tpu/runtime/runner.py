@@ -1002,17 +1002,20 @@ class Runner:
         return hlo_lib.collective_schedule(text)
 
     def static_profile(self, batch, state: Optional[TrainState] = None,
-                       fuse_steps: int = 1):
+                       fuse_steps: int = 1, topology=None):
         """Measured per-collective wire bytes of the compiled step — a
         ``StaticCollectiveProfile`` to attach to a ``Simulator`` /
         ``CostModel`` (``attach_static_profile``), replacing the jaxpr
-        cost heuristics with what the lowering actually emits."""
+        cost heuristics with what the lowering actually emits. Passing
+        the resource spec's ``topology`` additionally attributes each
+        replica group's ring edges to the link level they cross
+        (``level_wire_bytes`` — the drift report's per-level rows)."""
         from autodist_tpu.simulator.cost_model import StaticCollectiveProfile
         schedule = self.collective_schedule(batch, state,
                                             fuse_steps=fuse_steps)
         n_dev = max(int(getattr(self._dstep.mesh, "size", 1)), 1)
         return StaticCollectiveProfile.from_schedule(
-            schedule, default_group_size=n_dev)
+            schedule, default_group_size=n_dev, topology=topology)
 
     def lint_schedules(self, batch, state: Optional[TrainState] = None,
                        fuse_steps: int = 1):

@@ -78,7 +78,13 @@ class VarChoice:
     ``ps_proxy`` only to PS. ``wire_dtype`` ("fp32" | "int8") selects
     the blockwise-quantized collective/PS/zero wire — dense float
     variables of at least one scale block, mutually exclusive with
-    ``compressor`` (canon resolves conflicts compressor-first)."""
+    ``compressor`` (canon resolves conflicts compressor-first).
+    ``schedule`` ("auto" | "ring" | "rhd" | "hier") picks the collective
+    algorithm for the plain AllReduce wire (strategy/base.py docs):
+    "hier" is only in the sub-space when the resource spec declares a
+    multi-host topology the replica set spans — on a flat mesh canon
+    clamps it back to "auto" (which resolves to the ring), the
+    analyzer's refusal semantics."""
     sync: str = "AllReduce"               # "AllReduce" | "PS"
     compressor: str = "NoneCompressor"
     shards: int = 1
@@ -86,6 +92,7 @@ class VarChoice:
     ps_proxy: bool = False
     wire_dtype: str = "fp32"
     zero: bool = False
+    schedule: str = "auto"                # auto | ring | rhd | hier
 
 
 @dataclasses.dataclass(frozen=True)
@@ -122,9 +129,14 @@ class PlanSpec:
         sharded = sum(1 for _, c in self.choices if c.shards > 1)
         wired = sum(1 for _, c in self.choices if c.wire_dtype == "int8")
         zeroed = sum(1 for _, c in self.choices if c.zero)
+        scheds = sorted({c.schedule for _, c in self.choices
+                         if c.schedule != "auto"})
         bits = ["ar=%d" % ar, "ps=%d" % ps]
         if comp:
             bits.append("comp=%d" % comp)
+        for s in scheds:
+            bits.append("sched:%s=%d" % (
+                s, sum(1 for _, c in self.choices if c.schedule == s)))
         if wired:
             bits.append("int8w=%d" % wired)
         if sharded:
@@ -201,6 +213,21 @@ class PlanSpace:
             self.wire_options[n] = (
                 WIRE_DTYPES if wire_quantizable(info, min_block=True)
                 else ("fp32",))
+        # collective-schedule axis: "hier" only exists when the spec
+        # declares a multi-host topology the replica set actually spans
+        # (with >= 2 chips per host there is a payload to shrink) — on a
+        # flat mesh the space refuses it by construction, so the searcher
+        # can never "pick hierarchical" where the analyzer would lint it
+        topo = (resource_spec.topology()
+                if hasattr(resource_spec, "topology") else None)
+        if (topo is not None and topo.hosts > 1
+                and topo.inter_level is not None
+                and self.n_replicas > topo.chips_per_host
+                and topo.chips_per_host > 1):
+            self.schedule_options: Tuple[str, ...] = ("auto", "ring",
+                                                      "rhd", "hier")
+        else:
+            self.schedule_options = ("auto", "rhd")
 
     # ------------------------------------------------------------- validity
 
@@ -239,6 +266,13 @@ class PlanSpace:
                     or (sync == "AllReduce" and shards > 1)
                     or (sync == "PS" and proxy)):
                 wire = "fp32"
+        # collective schedule: plain AllReduce wire only (the ZeRO and
+        # partitioned paths already ARE scatter/gather compositions), and
+        # only algorithms this spec's topology can realize
+        sched = (choice.schedule or "auto").lower()
+        if (sync != "AllReduce" or zero or shards > 1
+                or sched not in self.schedule_options):
+            sched = "auto"
         if wire == "int8" and zero:
             # the zero kernel rounds each shard to whole scale blocks:
             # below P x block elements the padded int8 wire is WORSE
@@ -249,7 +283,7 @@ class PlanSpace:
                 wire = "fp32"
         return VarChoice(sync=sync, compressor=compressor, shards=shards,
                          axis=axis, ps_proxy=proxy, wire_dtype=wire,
-                         zero=zero)
+                         zero=zero, schedule=sched)
 
     def make_plan(self, choices: Dict[str, VarChoice], chunk_size: int = 128,
                   staleness: int = 0, remat: Optional[str] = None,
@@ -365,6 +399,12 @@ class PlanSpace:
             ("seed:zero-overlap", self.make_plan(zero, chunk_size=8,
                                                  overlap=True)),
         ]
+        if "hier" in self.schedule_options:
+            # the two-level schedule exists in this space (multi-host
+            # topology spanned): start one family there so the searcher
+            # does not have to discover it by mutation alone
+            hier = {n: VarChoice(schedule="hier") for n in self.var_names}
+            out.append(("seed:ar-hier", self.make_plan(hier)))
         return out
 
     def from_strategy(self, strategy: Strategy) -> Optional[PlanSpec]:
@@ -410,7 +450,9 @@ class PlanSpace:
                     # compression the zoo strategy configured
                     comp, wire = "NoneCompressor", "int8"
                 choice = VarChoice(compressor=comp, shards=shards,
-                                   axis=axis, wire_dtype=wire)
+                                   axis=axis, wire_dtype=wire,
+                                   schedule=(getattr(first, "schedule",
+                                                     "auto") or "auto"))
             elif isinstance(first, PSSynchronizer):
                 if not first.sync:
                     return None  # async PS is outside the search space
@@ -521,6 +563,21 @@ class PlanSpace:
                 return (plan.replace_choice(n, new),
                         "proxy[%s]=%s" % (n, target))
             ops.append(toggle_proxy)
+
+        sched_vars = [n for n in names
+                      if cm[n].sync == "AllReduce" and cm[n].shards == 1
+                      and not cm[n].zero]
+        if sched_vars and len(self.schedule_options) > 1:
+            def set_schedule():
+                n = sched_vars[rng.randrange(len(sched_vars))]
+                opts = [s for s in self.schedule_options
+                        if s != cm[n].schedule]
+                s = opts[rng.randrange(len(opts))]
+                new = self.canon(
+                    dataclasses.replace(cm[n], schedule=s), n)
+                return (plan.replace_choice(n, new),
+                        "schedule[%s]=%s" % (n, s))
+            ops.append(set_schedule)
 
         part_vars = [n for n in names if self.partition_options[n]
                      and not (self.infos[n].sparse
@@ -654,7 +711,8 @@ class PlanSpace:
                         var_name=name,
                         synchronizer=AllReduceSynchronizer(
                             compressor=c.compressor, group=group,
-                            wire_dtype=c.wire_dtype)))
+                            wire_dtype=c.wire_dtype,
+                            schedule=c.schedule)))
                 continue
             staleness = 0 if c.ps_proxy else plan_staleness
             if c.shards > 1:

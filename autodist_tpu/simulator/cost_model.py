@@ -13,6 +13,7 @@ Deliberately simple (closed-form, no learned component): its job is to
 exactly.
 """
 import dataclasses
+import math
 from typing import Dict, Optional
 
 from autodist_tpu.strategy.base import (AllReduceSynchronizer, PSSynchronizer,
@@ -56,6 +57,7 @@ COMPRESSED_BYTES = {"HorovodCompressor": 2, "HorovodCompressorEF": 2,
                     "BF16Compressor": 2, "BF16CompressorEF": 2,
                     "Int8Compressor": 1, "Int8CompressorEF": 1}
 PER_COLLECTIVE_LATENCY_S = 5e-6   # launch overhead per collective/bucket
+PER_HOP_LATENCY_S = 1e-6          # per ring/tree hop under topology pricing
 
 # forward wire factors per cost class at axis size k: bytes crossing each
 # link of a ring, relative to the TRACED payload (gather traces one shard,
@@ -119,12 +121,23 @@ class StaticCollectiveProfile:
     class_payload_bytes: Dict[str, float]
     class_wire_bytes: Dict[str, float]
     num_collectives: int = 0
+    # per-link-level wire bytes (level name -> bytes/step), populated
+    # when the profile is built against a multi-level topology: every
+    # replica group's ring edges are attributed to the physical level
+    # they cross (analysis/topology.py). Empty on flat specs.
+    level_wire_bytes: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
 
     @classmethod
-    def from_schedule(cls, schedule,
-                      default_group_size: int = 1) -> "StaticCollectiveProfile":
+    def from_schedule(cls, schedule, default_group_size: int = 1,
+                      topology=None) -> "StaticCollectiveProfile":
         per_step = (schedule.per_step() if hasattr(schedule, "per_step")
                     else schedule)
+        levels: Dict[str, float] = {}
+        if topology is not None:
+            from autodist_tpu.analysis.topology import schedule_level_bytes
+            levels = schedule_level_bytes(
+                per_step, topology, default_group_size=default_group_size)
         payload: Dict[str, float] = {}
         wire: Dict[str, float] = {}
         n = 0
@@ -137,7 +150,7 @@ class StaticCollectiveProfile:
                             + collective_wire_bytes(c.kind,
                                                     c.payload_bytes, k))
             n += 1
-        return cls(payload, wire, n)
+        return cls(payload, wire, n, level_wire_bytes=levels)
 
     @property
     def total_wire_bytes(self) -> float:
@@ -617,6 +630,37 @@ class CostModel:
             factor = WIRE_DTYPE_BYTES
         return info.num_elements * factor
 
+    def _topology_ar_time(self, sched: str, payload: float, topo,
+                          n: int) -> float:
+        """Price one resolved gradient-sync algorithm per link level.
+
+        ring/rhd move the full 2(n-1)/n*P over the bottleneck level (the
+        inter-host link once the group spans hosts) and differ only in
+        hop count — 2(n-1) vs 2*ceil(log2 n) latency hops; hier pays
+        2(c-1)/c*P at intra speed plus 2(H-1)/H*(P/c) at inter speed
+        with 2(c-1)+2(H-1) hops (arXiv 2110.10548's two-level
+        reduction). Hops are charged at PER_HOP_LATENCY_S each, which is
+        what lets recursive halving/doubling win small payloads and the
+        hierarchical schedule win slow inter-host links."""
+        if n <= 1 or payload <= 0:
+            return 0.0
+        intra_bw = topo.intra_level.bandwidth_bytes_s
+        inter = topo.inter_level
+        inter_bw = inter.bandwidth_bytes_s if inter is not None else intra_bw
+        cph = max(topo.chips_per_host, 1)
+        hosts = min(max(1, -(-n // cph)), max(topo.hosts, 1))
+        c = min(n, cph)
+        if sched == "hier" and hosts > 1 and c > 1:
+            t = (2.0 * (c - 1) / c * payload / intra_bw
+                 + 2.0 * (hosts - 1) / hosts * (payload / c) / inter_bw)
+            hops = 2 * (c - 1) + 2 * (hosts - 1)
+        else:
+            bw = inter_bw if hosts > 1 else intra_bw
+            t = 2.0 * (n - 1) / n * payload / bw
+            hops = (2 * int(math.ceil(math.log2(n))) if sched == "rhd"
+                    else 2 * (n - 1))
+        return t + hops * PER_HOP_LATENCY_S
+
     # ------------------------------------------------------------------ main
 
     def estimate(self, strategy: Strategy,
@@ -635,6 +679,13 @@ class CostModel:
                       for a in self._spec.node_addresses)) * 1e9 / 8
 
         ar_bytes = 0.0
+        # gradient-sync payload bytes by RESOLVED collective algorithm
+        # (analysis/topology.py resolve_schedule): only plain AllReduce
+        # syncs carry the schedule knob; ZeRO/proxied-PS contributions
+        # stay on the ring formula. Irrelevant (all "ring") without a
+        # topology on the spec.
+        ar_sched_bytes: Dict[str, float] = {}
+        topo = self._spec.topology()
         ps_load: Dict[str, float] = {}
         groups = set()
         num_ps_transfers = 0
@@ -690,9 +741,17 @@ class CostModel:
                 elif isinstance(sync, AllReduceSynchronizer):
                     if node.mp_axes and complement == 1:
                         continue  # whole mesh is model axes: no grad sync
-                    ar_bytes += mp_share * self._wire_bytes(
+                    contrib = mp_share * self._wire_bytes(
                         info, sync, compressed=not partitioned,
                         wire_ok=not node.mp_axes) / max(len(syncs), 1)
+                    ar_bytes += contrib
+                    if topo is not None:
+                        from autodist_tpu.analysis.topology import \
+                            resolve_schedule
+                        resolved = resolve_schedule(
+                            getattr(sync, "schedule", "auto"), topo, n)
+                        ar_sched_bytes[resolved] = (
+                            ar_sched_bytes.get(resolved, 0.0) + contrib)
                     groups.add(sync.group)
                     if not node.mp_axes:
                         # schedule-unit classification, mirroring the
@@ -732,8 +791,20 @@ class CostModel:
                         / max(len(syncs), 1))
                     num_ps_transfers += 1
 
-        # ring all-reduce: 2*(N-1)/N of the payload crosses each link
-        allreduce_s = (2.0 * (n - 1) / n) * ar_bytes / ici_bw if n > 1 else 0.0
+        # ring all-reduce: 2*(N-1)/N of the payload crosses each link;
+        # with a multi-level topology on the spec each resolved schedule
+        # is priced per level at that level's link speed instead
+        if topo is not None and n > 1 and ar_bytes > 0:
+            other = ar_bytes - sum(ar_sched_bytes.values())
+            if other > 0:
+                ar_sched_bytes["ring"] = (ar_sched_bytes.get("ring", 0.0)
+                                          + other)
+            allreduce_s = sum(
+                self._topology_ar_time(sched, payload, topo, n)
+                for sched, payload in ar_sched_bytes.items())
+        else:
+            allreduce_s = ((2.0 * (n - 1) / n) * ar_bytes / ici_bw
+                           if n > 1 else 0.0)
         mp_s = self.mp_comm_time(strategy, ici_bw)
         profile = (self._static_profile_for(strategy)
                    if use_static_profile else None)

@@ -78,11 +78,23 @@ class AllReduceSynchronizer:
     two-phase quantized shape (quantize -> reduce-scatter int8 -> local
     dequant-accumulate -> quantize -> all-gather; EQuARX, arXiv
     2506.17615) with error feedback. Dense float unpartitioned wires only,
-    and mutually exclusive with ``compressor`` (the linter's ADT310)."""
+    and mutually exclusive with ``compressor`` (the linter's ADT310).
+
+    ``schedule`` picks the collective algorithm the reduce lowers to:
+    "auto" resolves per topology (hierarchical when the replica set
+    spans a declared multi-host topology's slow level, ring otherwise);
+    "ring" pins the flat single-ring all-reduce; "rhd" the recursive
+    halving/doubling shape (reduce-scatter + all-gather, fewer latency
+    hops for small payloads); "hier" the two-level intra-host
+    reduce-scatter / leader all-reduce / intra-host all-gather
+    composition (arXiv 2110.10548). An explicit "hier" on a flat mesh is
+    refused back to ring by the resolver; a pinned "ring" spanning hosts
+    is the analyzer's ADT520."""
     spec: str = "AUTO"        # AUTO | ICI | DCN (NCCL/RING accepted as aliases)
     compressor: str = "NoneCompressor"
     group: int = 0
     wire_dtype: str = "fp32"
+    schedule: str = "auto"    # auto | ring | rhd | hier
 
     kind = "AllReduce"
 
@@ -90,11 +102,12 @@ class AllReduceSynchronizer:
 
     def __post_init__(self):
         self.spec = self._SPEC_ALIASES.get(self.spec, self.spec)
+        self.schedule = (self.schedule or "auto").lower()
 
     def to_dict(self):
         return {"kind": self.kind, "spec": self.spec,
                 "compressor": self.compressor, "group": self.group,
-                "wire_dtype": self.wire_dtype}
+                "wire_dtype": self.wire_dtype, "schedule": self.schedule}
 
 
 @dataclasses.dataclass

@@ -101,6 +101,12 @@ class DriftReport:
     # and the per-step quantized payload — None when no quantized wire
     # crossed during the window
     wire: Optional[dict] = None
+    # per-link-level bytes (topology-aware): plan-level predicted bytes
+    # per level (analysis/topology.plan_level_bytes) joined against the
+    # static profile's measured per-level rows — None when the spec
+    # declares no multi-level topology. Each row: {level, predicted_bytes,
+    # measured_bytes, ratio}
+    levels: Optional[List[dict]] = None
 
     @property
     def step_ratio(self) -> Optional[float]:
@@ -124,6 +130,7 @@ class DriftReport:
             "counters": self.counters,
             "goodput": self.goodput,
             "wire": self.wire,
+            "levels": self.levels,
         }
 
     @classmethod
@@ -145,7 +152,8 @@ class DriftReport:
             breakdown=d.get("breakdown", {}),
             counters=d.get("counters", {}),
             goodput=d.get("goodput"),
-            wire=d.get("wire"))
+            wire=d.get("wire"),
+            levels=d.get("levels"))
 
     def save(self, path: str) -> str:
         import os
@@ -186,6 +194,17 @@ class DriftReport:
                    self.wire.get("bytes_saved", 0),
                    self.wire.get("reduction_x") or 1.0,
                    self.wire.get("per_step_quantized") or 0.0))
+        if self.levels:
+            lines.append("  %-12s %14s %14s %8s"
+                         % ("level", "predicted_B", "measured_B", "ratio"))
+            for row in self.levels:
+                lines.append("  %-12s %14d %14s %8s"
+                             % (row["level"], row["predicted_bytes"],
+                                "%d" % row["measured_bytes"]
+                                if row.get("measured_bytes") is not None
+                                else "-",
+                                row["ratio"] if row.get("ratio") is not None
+                                else "-"))
         return "\n".join(lines)
 
 
@@ -276,6 +295,31 @@ def build_report(cost_model, strategy,
                 "per_step_quantized": (round(wq / num_steps, 1)
                                        if num_steps else None)}
 
+    # per-link-level rows (topology-aware): the plan-level prediction
+    # (analysis/topology.plan_level_bytes, the same formulas the cost
+    # model prices with) joined against the static profile's measured
+    # per-level attribution — the drift row that shows whether the
+    # hierarchical schedule actually moved its bytes off the slow level
+    levels = None
+    topo = (cost_model._spec.topology()
+            if hasattr(cost_model._spec, "topology") else None)
+    if topo is not None:
+        from autodist_tpu.analysis.topology import plan_level_bytes
+        predicted = plan_level_bytes(strategy, cost_model._item, topo)
+        measured_levels = (dict(getattr(static_profile, "level_wire_bytes",
+                                        None) or {})
+                           if static_profile is not None else {})
+        levels = []
+        for lv in topo.levels:
+            p = predicted.get(lv.name, 0.0)
+            m = measured_levels.get(lv.name)
+            ratio = (round(m / p, 4) if m is not None and p > 0 else None)
+            levels.append({"level": lv.name,
+                           "predicted_bytes": round(p),
+                           "measured_bytes": (round(m) if m is not None
+                                              else None),
+                           "ratio": ratio})
+
     report = DriftReport(
         strategy_id=getattr(strategy, "id", "?"),
         num_steps=num_steps,
@@ -287,7 +331,8 @@ def build_report(cost_model, strategy,
                    for f in dataclasses.fields(breakdown)},
         counters=counters,
         goodput=gp.to_dict() if gp is not None else None,
-        wire=wire)
+        wire=wire,
+        levels=levels)
     logging.info("drift report [%s]: predicted=%.6gs measured=%s over %d "
                  "dispatches", report.strategy_id, report.predicted_step_s,
                  "%.6gs" % measured_step if measured_step is not None
@@ -322,7 +367,9 @@ def report_for_runner(runner, resource_spec=None, batch=None,
     spec = resource_spec or ResourceSpec.from_local()
     dstep = runner.distributed_step
     cm = CostModel(dstep.model_item, spec)
-    profile = runner.static_profile(batch) if batch is not None else None
+    topo = spec.topology() if hasattr(spec, "topology") else None
+    profile = (runner.static_profile(batch, topology=topo)
+               if batch is not None else None)
     return build_report(cm, dstep.strategy, recorder=recorder,
                         static_profile=profile)
 

@@ -730,6 +730,7 @@ def smoke_main(fused: bool = False):
     result["overlap"] = overlap_result
     result["bf16_compute"] = bf16_result
     result["search"] = _smoke_search(loss_fn, params, batches[0])
+    result["topology"] = _smoke_topology(loss_fn, params, batches[0])
     # trace export BEFORE the elastic leg: its builds reset the recorder
     # (and its reconfigure clears the XLA backend — rebuilt on demand,
     # but the paired timing legs above must not pay that), so it runs
@@ -1437,6 +1438,48 @@ def _smoke_search(loss_fn, params, batch):
             "est_zoo_ms": round(zoo.step_time_s * 1e3, 4),
             "candidates": res.candidates, "pruned": res.pruned,
             "search_s": round(search_s, 3)}
+
+
+def _smoke_topology(loss_fn, params, batch):
+    """Topology-ranking leg: price the synthesized collective schedules
+    (flat ring / recursive halving-doubling / hierarchical two-level) on
+    a simulated 8-host x 8-chip pod with a slow inter-host level — pure
+    static scoring, zero hardware — and ASSERT the hierarchical route is
+    strictly cheapest AND its plan-level profile crosses strictly fewer
+    inter-host bytes than the flat ring's. The per-PR gate on the ADT52x
+    analyzer's ranking contract (docs/performance.md)."""
+    import optax
+    from autodist_tpu.analysis.cli import topology_spec
+    from autodist_tpu.analysis.topology import plan_level_bytes
+    from autodist_tpu.model_item import ModelItem
+    from autodist_tpu.resource_spec import Topology
+    from autodist_tpu.search.space import PlanSpace, VarChoice
+    from autodist_tpu.simulator.cost_model import CostModel
+
+    topo = Topology.from_dict(
+        {"hosts": 8, "chips_per_host": 8,
+         "levels": [{"name": "ici", "bandwidth_gbps": 400},
+                    {"name": "dcn", "bandwidth_gbps": 25}]})
+    item = ModelItem(loss_fn=loss_fn, optimizer=optax.adam(1e-2),
+                     params=params, example_batch=batch).prepare()
+    spec = topology_spec(topo)
+    space = PlanSpace(item, spec)
+    cm = CostModel(item, spec)
+    ar_s, inter_bytes = {}, {}
+    for sched in ("ring", "rhd", "hier"):
+        plan = space.make_plan(
+            {n: VarChoice(schedule=sched) for n in space.var_names})
+        strat = space.build(plan)
+        ar_s[sched] = cm.estimate(strat).allreduce_s
+        inter_bytes[sched] = plan_level_bytes(
+            strat, item, topo).get("dcn", 0.0)
+    assert ar_s["hier"] < ar_s["ring"], ar_s
+    assert 0 < inter_bytes["hier"] < inter_bytes["ring"], inter_bytes
+    return {"allreduce_ms": {k: round(v * 1e3, 5)
+                             for k, v in ar_s.items()},
+            "inter_host_bytes": {k: round(v) for k, v in inter_bytes.items()},
+            "inter_bytes_ratio": round(
+                inter_bytes["ring"] / inter_bytes["hier"], 2)}
 
 
 def _smoke_telemetry():
