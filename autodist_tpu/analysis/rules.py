@@ -1093,6 +1093,51 @@ def verify_autoscale(policy, strategy=None,
     return out
 
 
+def verify_decode(cache_bytes: float, param_bytes: float = 0.0,
+                  slots: Optional[int] = None,
+                  max_len: Optional[int] = None,
+                  replicas: int = 1,
+                  budget_bytes: Optional[float] = None,
+                  resource_spec=None) -> List[Diagnostic]:
+    """ADT442 — does a continuous-batching decode engine's armed KV
+    cache (``max_len x slots``, both halves, ``serving/decode.py``) plus
+    the gathered full params the decode step holds fit the per-device
+    HBM budget the ADT501 memory pass checks against? Run at engine
+    construction, so an over-provisioned slot pool warns at deploy time
+    instead of OOMing at the first full-occupancy step.
+
+    ``cache_bytes`` is the GLOBAL cache allocation (k + v); the slot dim
+    shards over ``replicas``, so the per-device share is
+    ``cache_bytes / replicas``; params count whole (the step gathers
+    them full). The budget comes from ``budget_bytes`` or
+    ``resource_spec.chip_hbm_bytes()``; with neither there is nothing to
+    project against and no diagnostic is emitted — a made-up default
+    budget would fire on every CPU test."""
+    from autodist_tpu.analysis.memory import GIB
+    out: List[Diagnostic] = []
+    budget = budget_bytes
+    if budget is None and resource_spec is not None:
+        budget = resource_spec.chip_hbm_bytes()
+    if not budget or budget <= 0:
+        return out
+    per_device = cache_bytes / max(int(replicas), 1) + param_bytes
+    if per_device > budget:
+        geometry = ""
+        if slots is not None and max_len is not None:
+            geometry = " (%d slots x %d max_len)" % (slots, max_len)
+        out.append(warning(
+            "ADT442",
+            "decode engine armed with %.2f GiB of KV cache%s + %.2f GiB "
+            "params projects to %.2f GiB per device — past the %.2f GiB "
+            "HBM budget (ADT501's bound): the first fully-occupied "
+            "decode step OOMs, not the lint" % (
+                cache_bytes / GIB, geometry, param_bytes / GIB,
+                per_device / GIB, budget / GIB),
+            fixit="shrink slots or max_len, serve a smaller model, or "
+                  "spread the slot dim over more batch replicas"))
+    return out
+
+
 @rule
 def _r_staleness_topology(ctx: Context) -> Iterable[Diagnostic]:
     if ctx.spec is None or not ctx.spec.is_single_node():

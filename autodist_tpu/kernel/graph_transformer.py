@@ -85,6 +85,7 @@ class DistributedStep:
                  ps_store=None, holed_params_template=None,
                  fused_builder: Optional[Callable] = None,
                  forward_builder: Optional[Callable] = None,
+                 decode_builder: Optional[Callable] = None,
                  zero_syncs: Optional[dict] = None):
         self.mesh = mesh
         self.mesh_axis = mesh_axis
@@ -120,6 +121,12 @@ class DistributedStep:
         # serving re-dispatches, never re-lowers
         self._forward_builder = forward_builder
         self._predict_jits: Dict[tuple, Callable] = {}
+        # decode serving: ``decode_builder(decode_fn, example_dstate)``
+        # lowers ONE donated fixed-shape decode-step program (params + KV
+        # caches + cursors -> next tokens + updated caches) — the
+        # continuous-batching engine's compile target (serving/decode.py)
+        self._decode_builder = decode_builder
+        self._decode_jits: Dict[tuple, Callable] = {}
         # device-resident PS carry for the fused engine: full values +
         # per-var little-tree optimizer states, written back to the host
         # store only at sync points (flush_ps) instead of every step
@@ -476,6 +483,33 @@ class DistributedStep:
             self._predict_jits[key] = self._forward_builder(
                 serve_fn, bool(donate_batch), example_batch)
         return self._predict_jits[key]
+
+    def decode_program(self, decode_fn: Callable,
+                       example_dstate) -> Callable:
+        """The compiled decode-STEP program behind continuous batching
+        (``autodist_tpu/serving/decode.py``): like
+        :meth:`predict_program` it gathers params and fills PS holes, but
+        the second operand is the engine's slot-major decode state (KV
+        caches ``[slots, ...]``, per-slot token/cursor/alive) rather than
+        a request feed, and the state is ALWAYS donated — the returned
+        caches alias the previous step's buffers, so steady-state decode
+        holds one cache allocation regardless of slot churn.
+
+        ``example_dstate`` fixes the state's structure and (fixed!)
+        shapes; the program is cached per ``(decode_fn, structure)`` and
+        XLA sees exactly one shape — the zero-recompile contract the
+        decode engine asserts after warmup."""
+        if self._decode_builder is None:
+            raise NotImplementedError(
+                "this DistributedStep was built without a decode-program "
+                "lowering path (step_fn capture mode hides the forward "
+                "pass) — continuous-batching decode needs loss_fn mode")
+        treedef = jax.tree_util.tree_structure(example_dstate)
+        key = (decode_fn, treedef)
+        if key not in self._decode_jits:
+            self._decode_jits[key] = self._decode_builder(
+                decode_fn, example_dstate)
+        return self._decode_jits[key]
 
     def snapshot_lowered(self, state: TrainState, batch):
         """Dump the transformed program's StableHLO (the reference's
@@ -2001,6 +2035,95 @@ class GraphTransformer:
                         donate_argnums=(2,) if donate_batch else ()),
                 batch_mask)
 
+        def decode_builder(decode_fn: Callable, example_dstate):
+            from autodist_tpu.utils.axis_env import bound_axes
+            # decode state leaves are SLOT-major, not feed-path-shaped:
+            # every array leaf leads with the slot dim and shards over the
+            # batch axes (the per-path rules the train feed uses — seq
+            # sharding for seq_feed_keys etc. — must not apply to KV
+            # caches whose second dim is the sequence)
+            n_batch = int(np.prod([self._mesh.shape[a]
+                                   for a in serve_batch_axes] or [1]))
+            state_leaves, dstate_treedef = jax.tree_util.tree_flatten(
+                example_dstate)
+            for leaf in state_leaves:
+                if np.ndim(leaf) >= 1 and np.shape(leaf)[0] % n_batch:
+                    raise ValueError(
+                        "decode slot count %d is not divisible by the "
+                        "batch-axes mesh extent %d — pick slots as a "
+                        "multiple of the data-parallel degree"
+                        % (np.shape(leaf)[0], n_batch))
+            dstate_specs = jax.tree_util.tree_unflatten(
+                dstate_treedef,
+                [P(serve_batch_axes) if np.ndim(l) >= 1 else P()
+                 for l in state_leaves])
+            local_dstate = jax.tree_util.tree_unflatten(
+                dstate_treedef,
+                [jax.ShapeDtypeStruct(
+                    ((np.shape(l)[0] // n_batch,) + tuple(np.shape(l)[1:])
+                     if np.ndim(l) >= 1 else ()),
+                    l.dtype if hasattr(l, "dtype")
+                    else np.asarray(l).dtype)
+                 for l in state_leaves])
+            local_slots = ([np.shape(l)[0] // n_batch for l in state_leaves
+                            if np.ndim(l) >= 1] or [0])[0]
+            param_avals = jax.tree_util.tree_map(
+                lambda l: jax.ShapeDtypeStruct(
+                    np.shape(l), l.dtype if hasattr(l, "dtype")
+                    else np.asarray(l).dtype), item.params)
+            with bound_axes():
+                out_aval = jax.eval_shape(decode_fn, param_avals,
+                                          local_dstate)
+            out_leaves, out_treedef = jax.tree_util.tree_flatten(out_aval)
+            flat_specs = [
+                P(serve_batch_axes)
+                if (np.ndim(a) >= 1 and local_slots
+                    and np.shape(a)[0] == local_slots) else P()
+                for a in out_leaves]
+            out_specs = jax.tree_util.tree_unflatten(out_treedef,
+                                                     flat_specs)
+
+            def local_decode(state: TrainState, ps_vals, dstate):
+                ps_vals = _ps_dewire(ps_vals)
+                gathered = _tree_map_layouts(
+                    lambda leaf, lay: lay.gather_full(leaf), state.params,
+                    layout_tree)
+                full_params = (ps_lib.fill_holes(gathered, ps_vals)
+                               if ps_names else gathered)
+                out = decode_fn(full_params, dstate)
+                if N > 1:
+                    leaves = out_treedef.flatten_up_to(out)
+                    leaves = [
+                        v if len(s) else
+                        (jax.lax.pmean(v, all_axes)
+                         if jnp.issubdtype(jnp.asarray(v).dtype,
+                                           jnp.inexact)
+                         else jax.lax.pmax(v, all_axes))
+                        for v, s in zip(leaves, flat_specs)]
+                    out = jax.tree_util.tree_unflatten(out_treedef, leaves)
+                return out
+
+            sharded_decode = jax.shard_map(
+                local_decode, mesh=self._mesh,
+                in_specs=(state_specs, ps_specs, dstate_specs),
+                out_specs=out_specs, check_vma=False)
+            batch_mask = jax.tree_util.tree_unflatten(
+                out_treedef, [len(s) > 0 for s in flat_specs])
+            # the decode state is ALWAYS donated: the step's whole point
+            # is mutating the KV cache in place, and the engine feeds the
+            # previous step's output straight back in. Output shardings
+            # are pinned to the slot specs: jit would otherwise
+            # canonicalize them (e.g. to replicated on a 1-extent mesh),
+            # and the fed-back caches would re-specialize the program —
+            # one recompile per step, the exact failure this path exists
+            # to rule out
+            out_shardings = jax.tree_util.tree_unflatten(
+                out_treedef,
+                [NamedSharding(self._mesh, s) for s in flat_specs])
+            return ForwardProgram(
+                jax.jit(sharded_decode, donate_argnums=(2,),
+                        out_shardings=out_shardings), batch_mask)
+
         # ----- fused multi-step lowering (DistributedStep.multi_step):
         # k microsteps under lax.scan over a stacked [k, ...] batch in ONE
         # jitted dispatch. Host-PS updates are device-emulated inside the
@@ -2200,4 +2323,5 @@ class GraphTransformer:
             metadata=metadata, eval_fn=eval_fn, ps_store=ps_store,
             holed_params_template=holed_params,
             fused_builder=fused_builder, forward_builder=forward_builder,
+            decode_builder=decode_builder,
             zero_syncs=zero_syncs)

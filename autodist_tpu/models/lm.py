@@ -48,6 +48,7 @@ class TransformerLM(nn.Module):
     config: LMConfig
     attn_fn: Optional[Any] = None
     seq_parallel: bool = False  # offset positions by the seq-shard index
+    decode_attn: str = "reference"  # decode inner loop: "reference"|"flash"
 
     @nn.compact
     def hidden(self, input_ids):
@@ -83,6 +84,79 @@ class TransformerLM(nn.Module):
         x = self.hidden(input_ids)
         logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32, name="lm_head")(x)
         return logits
+
+    @nn.compact
+    def prefill(self, input_ids, length):
+        """Prompt pass seeding a decode KV cache (continuous batching,
+        ``serving/decode.py``): ``input_ids`` [B, P] right-padded
+        prompts, ``length`` [B] real prompt lengths. Returns the
+        last-real-position logits [B, vocab] plus per-layer K/V caches
+        [B, layers, max_seq_len, heads, head_dim]. Causality makes the
+        padding harmless: position ``length-1`` attends only real
+        tokens, and the garbage rows past ``length`` sit above the
+        decode cursor, so :func:`ops.attention.cached_attention` never
+        reads them before a decode step overwrites them. Submodules are
+        created in exactly :meth:`hidden`'s order so the training
+        parameters resolve unchanged."""
+        cfg = self.config
+        seq_len = input_ids.shape[-1]
+        x = SparseEmbed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                        name="embed")(input_ids)
+        x = x * np.sqrt(cfg.d_model)
+        positions = jnp.arange(seq_len)
+        pos = SparseEmbed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
+                          name="pos_embed")(positions[None])
+        x = x + pos
+        mask = None if self.attn_fn is not None else causal_mask(seq_len)
+        ks, vs = [], []
+        for i in range(cfg.num_layers):
+            x, (k, v) = TransformerBlock(
+                cfg.num_heads, cfg.d_model // cfg.num_heads, cfg.mlp_dim,
+                dtype=cfg.dtype, attn_fn=self.attn_fn,
+                decode_attn=self.decode_attn,
+                name="layer_%d" % i)(x, mask, return_kv=True)
+            pad = [(0, 0), (0, cfg.max_seq_len - seq_len), (0, 0), (0, 0)]
+            ks.append(jnp.pad(k, pad))
+            vs.append(jnp.pad(v, pad))
+        x = nn.LayerNorm(dtype=cfg.dtype, name="final_ln")(x)
+        idx = jnp.clip(length - 1, 0, seq_len - 1)
+        last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+        logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32,
+                          name="lm_head")(last)
+        return logits, jnp.stack(ks, axis=1), jnp.stack(vs, axis=1)
+
+    @nn.compact
+    def decode_step(self, token_ids, k_cache, v_cache, cursor, alive=None):
+        """One cached decode step: ``token_ids`` [B] current tokens,
+        caches [B, layers, max_seq_len, heads, head_dim], ``cursor`` [B]
+        the row each token writes (== tokens already cached), ``alive``
+        [B] bool gating cache writes for dead slots. Returns next-token
+        logits [B, vocab] and the updated caches. Fixed shapes for any
+        slot occupancy — the zero-recompile decode contract."""
+        cfg = self.config
+        x = SparseEmbed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                        name="embed")(token_ids[:, None])
+        x = x * np.sqrt(cfg.d_model)
+        pos_idx = jnp.clip(cursor, 0, cfg.max_seq_len - 1)
+        pos = SparseEmbed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
+                          name="pos_embed")(pos_idx[:, None])
+        x = x + pos
+        new_ks, new_vs = [], []
+        for i in range(cfg.num_layers):
+            x, (k, v) = TransformerBlock(
+                cfg.num_heads, cfg.d_model // cfg.num_heads, cfg.mlp_dim,
+                dtype=cfg.dtype, attn_fn=None,
+                decode_attn=self.decode_attn,
+                name="layer_%d" % i)(
+                x, cache=(k_cache[:, i], v_cache[:, i]),
+                cursor=cursor, alive=alive)
+            new_ks.append(k)
+            new_vs.append(v)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="final_ln")(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32,
+                          name="lm_head")(x[:, 0])
+        return (logits, jnp.stack(new_ks, axis=1),
+                jnp.stack(new_vs, axis=1))
 
 
 def make_train_setup(config: Optional[LMConfig] = None, seq_len: int = 128,
@@ -151,6 +225,58 @@ def make_train_setup(config: Optional[LMConfig] = None, seq_len: int = 128,
         0, cfg.vocab_size, (batch_size, seq_len + 1)).astype(np.int32)}
     apply_fn = lambda p, ids: model.apply(p, ids)  # noqa: E731
     return loss_fn, dict(variables), example_batch, apply_fn
+
+
+def make_decode_setup(config: Optional[LMConfig] = None,
+                      decode_attn: str = "reference",
+                      return_logits: bool = False):
+    """Continuous-batching decode functions over a trained TransformerLM
+    (``serving/decode.py`` DecodeEngine). Returns a
+    :class:`~autodist_tpu.serving.decode.DecodeSetup` whose parameters
+    resolve against the same variables :func:`make_train_setup` trains.
+
+    ``decode_attn="flash"`` routes the decode inner loop through the
+    pallas flash kernel (``ops.attention.flash_cached_attention``);
+    greedy argmax sampling runs in-graph so the per-step D2H is one
+    int32 per slot. ``return_logits`` adds the full [slots, vocab]
+    logits to the step fetches (parity tests; costs a vocab-sized D2H
+    per step, keep it off in production)."""
+    from autodist_tpu.serving.decode import DecodeSetup
+
+    cfg = config or LMConfig()
+    model = TransformerLM(cfg, decode_attn=decode_attn)
+    head_dim = cfg.d_model // cfg.num_heads
+
+    def prefill_fn(params, batch):
+        logits, k, v = model.apply(params, batch["tokens"], batch["length"],
+                                   method=TransformerLM.prefill)
+        return {"next_token": jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                "k": k, "v": v}
+
+    def decode_fn(params, dstate):
+        logits, k, v = model.apply(
+            params, dstate["token"], dstate["k"], dstate["v"],
+            dstate["cursor"], dstate["alive"],
+            method=TransformerLM.decode_step)
+        out = {"k": k, "v": v,
+               "next_token": jnp.argmax(logits, axis=-1).astype(jnp.int32)}
+        if return_logits:
+            out["logits"] = logits
+        return out
+
+    def init_dstate(slots: int):
+        cache_shape = (slots, cfg.num_layers, cfg.max_seq_len,
+                       cfg.num_heads, head_dim)
+        cache_dtype = np.dtype(jnp.dtype(cfg.dtype).name)
+        return {"k": np.zeros(cache_shape, cache_dtype),
+                "v": np.zeros(cache_shape, cache_dtype),
+                "token": np.zeros((slots,), np.int32),
+                "cursor": np.zeros((slots,), np.int32),
+                "alive": np.zeros((slots,), np.bool_)}
+
+    return DecodeSetup(prefill_fn=prefill_fn, decode_fn=decode_fn,
+                       init_dstate=init_dstate, max_len=cfg.max_seq_len,
+                       vocab_size=cfg.vocab_size)
 
 
 def make_sp_train_setup(config: Optional[LMConfig] = None, seq_len: int = 128,

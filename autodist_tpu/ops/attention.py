@@ -133,6 +133,57 @@ def ulysses_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
     return heads_to_seq(out)
 
 
+def cached_attention(q, k_cache, v_cache, cursor):
+    """Decode-shape attention against a KV cache (continuous batching).
+
+    One query per slot against the slot's cached keys/values:
+    ``q`` is [B, H, D] (the current token's projected query), ``k_cache``
+    and ``v_cache`` are [B, T, H, D] slot caches, ``cursor`` is [B] int32
+    — the row the current token was just written to. Rows ``<= cursor``
+    are live; later rows hold garbage from evicted sequences and are
+    masked out, which is what makes slot reuse safe without zeroing the
+    cache. Numerics match :func:`reference_attention` on the live prefix
+    (same fp32 softmax), so decode is exact-parity with full-sequence
+    recompute (tests/test_decode.py)."""
+    T = k_cache.shape[1]
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhd,bthd->bht", q, k_cache) * scale
+    mask = jnp.arange(T)[None, None, :] <= cursor[:, None, None]
+    logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    weights = weights.astype(q.dtype)
+    return jnp.einsum("bht,bthd->bhd", weights, v_cache)
+
+
+def flash_cached_attention(q, k_cache, v_cache, cursor,
+                           block_k: int = 128):
+    """Decode-shape attention through the pallas flash kernel
+    (``ops/flash_attention.py``) — the optional decode inner loop.
+
+    The kernel tiles query blocks of at least 8 rows, so the single
+    decode query is broadcast to an 8-row block and the cursor mask is
+    expressed as segment ids (q row 0 gets segment 1; cache rows
+    ``<= cursor`` get segment 1, dead rows 0): attention is allowed iff
+    the segments match, which is exactly the live-prefix mask. Rows 1-7
+    of the query block attend only dead rows and are discarded. Off-TPU
+    the kernel runs under ``interpret=True``; when the cache length
+    cannot be tiled the kernel itself falls back to the XLA reference
+    path, so this is always safe to call.
+
+    Parity with :func:`cached_attention` is allclose, not bitwise: the
+    kernel accumulates blockwise in fp32 with a finite ``NEG_INF`` mask
+    stand-in (tolerances documented in tests/test_decode.py)."""
+    from autodist_tpu.ops.flash_attention import flash_attention
+    B, T = k_cache.shape[0], k_cache.shape[1]
+    q_blk = jnp.broadcast_to(q[:, None], (B, 8) + q.shape[1:])
+    q_seg = jnp.zeros((B, 8), jnp.int32).at[:, 0].set(1)
+    kv_seg = (jnp.arange(T)[None, :] <= cursor[:, None]).astype(jnp.int32)
+    out = flash_attention(q_blk, k_cache, v_cache, causal=False,
+                          segment_ids=(q_seg, kv_seg),
+                          block_q=8, block_k=min(block_k, T))
+    return out[:, 0]
+
+
 def make_attn_fn(kind: str = "ring", axis_name: str = "seq",
                  causal: bool = False):
     """Attention implementation injectable into model layers

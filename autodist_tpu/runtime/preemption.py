@@ -868,10 +868,11 @@ class PreemptionGuard:
 
 
 def drain_serving(retry_after_s: Optional[float] = None) -> int:
-    """Drain every live serving micro-batcher in this process: in-flight
-    groups complete, queued requests shed with the typed Retry-After.
-    Returns the number of shed requests."""
+    """Drain every live serving micro-batcher AND decode engine in this
+    process: in-flight groups/sequences complete, queued requests shed
+    with the typed Retry-After. Returns the number of shed requests."""
     from autodist_tpu.serving import batcher as batcher_lib
+    from autodist_tpu.serving import decode as decode_lib
     shed = 0
     for mb in batcher_lib.active_batchers():
         try:
@@ -879,6 +880,12 @@ def drain_serving(retry_after_s: Optional[float] = None) -> int:
         except Exception as e:  # noqa: BLE001 — one wedged batcher must
             # not block the departure of the whole process
             logging.warning("preemption: serving drain failed (%s)", e)
+    for de in decode_lib.active_decoders():
+        try:
+            shed += de.drain(retry_after_s=retry_after_s)
+        except Exception as e:  # noqa: BLE001 — same contract for the
+            # decode tier: a wedged engine must not block departure
+            logging.warning("preemption: decode drain failed (%s)", e)
     return shed
 
 

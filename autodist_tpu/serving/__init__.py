@@ -24,6 +24,12 @@ the resilient control plane — applied to inference traffic
   in-flight groups complete, and sheds queued work with a typed
   ``Retry-After`` (``ADT_DRAIN_RETRY_AFTER_S``) so load balancers
   re-route instead of hammering a leaving replica.
+- :class:`~autodist_tpu.serving.decode.DecodeEngine` — continuous-
+  batching autoregressive decode: ONE donated fixed-shape decode-step
+  program over a KV-cache slot pool, a :class:`SlotScheduler` admitting
+  queued prefills into freed slots between steps (in-flight batching)
+  and evicting finished sequences, zero recompiles at any occupancy
+  (docs/serving.md#continuous-batching).
 - load-adaptive fleet sizing:
   :class:`~autodist_tpu.serving.autoscale.FleetAutoscaler` +
   :class:`~autodist_tpu.serving.autoscale.AutoscalePolicy` close the
@@ -36,9 +42,14 @@ the resilient control plane — applied to inference traffic
 from autodist_tpu.serving.engine import (InferenceEngine, ServingConfig,
                                          ServingUnavailable)
 from autodist_tpu.serving.batcher import MicroBatcher, active_batchers
+from autodist_tpu.serving.decode import (DecodeConfig, DecodeEngine,
+                                         DecodeSetup, SlotScheduler,
+                                         active_decoders)
 from autodist_tpu.serving.autoscale import (AutoscalePolicy, AutoscaleSignals,
                                             FleetAutoscaler)
 
 __all__ = ["InferenceEngine", "MicroBatcher", "ServingConfig",
            "ServingUnavailable", "active_batchers", "AutoscalePolicy",
-           "AutoscaleSignals", "FleetAutoscaler"]
+           "AutoscaleSignals", "FleetAutoscaler", "DecodeConfig",
+           "DecodeEngine", "DecodeSetup", "SlotScheduler",
+           "active_decoders"]

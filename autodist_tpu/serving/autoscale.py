@@ -54,13 +54,17 @@ class AutoscaleSignals:
     """One sampled snapshot of the signals the policy consumes.
     ``queue_depth`` is the ``serve.queue_depth`` gauge; ``p99_ms`` the
     ``serve.latency_ms`` p99 (None before any request); ``batch_fill``
-    the realized fan-out per dispatched batch; ``scrape_ages`` the
-    per-worker telemetry publish age (empty when the fleet scrape is
-    not wired)."""
+    the realized fan-out per dispatched batch; ``tokens_per_s`` /
+    ``slot_occupancy`` the decode tier's smoothed throughput and
+    live-slot fraction (``serving/decode.py``; None with no decode
+    engine running); ``scrape_ages`` the per-worker telemetry publish
+    age (empty when the fleet scrape is not wired)."""
 
     queue_depth: float = 0.0
     p99_ms: Optional[float] = None
     batch_fill: Optional[float] = None
+    tokens_per_s: Optional[float] = None
+    slot_occupancy: Optional[float] = None
     scrape_ages: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
@@ -69,6 +73,11 @@ class AutoscaleSignals:
                            if self.p99_ms is not None else None),
                 "batch_fill": (round(float(self.batch_fill), 2)
                                if self.batch_fill is not None else None),
+                "tokens_per_s": (round(float(self.tokens_per_s), 2)
+                                 if self.tokens_per_s is not None else None),
+                "slot_occupancy": (round(float(self.slot_occupancy), 3)
+                                   if self.slot_occupancy is not None
+                                   else None),
                 "max_scrape_age_s": (round(max(self.scrape_ages.values()), 2)
                                      if self.scrape_ages else None)}
 
@@ -300,10 +309,31 @@ class FleetAutoscaler:
             fill_b += local.get("batches", 0)
         if not batcher_lib.active_batchers():
             depth = tel.gauges().get("serve.queue_depth", 0.0)
+        # decode-tier signals (continuous batching, serving/decode.py):
+        # queued prompts join the shared backlog; throughput/occupancy
+        # aggregate over live engines, falling back to the gauges a
+        # remote scrape would have merged
+        from autodist_tpu.serving import decode as decode_lib
+        decoders = decode_lib.active_decoders()
+        tokens_per_s = None
+        occupancy = None
+        if decoders:
+            rates = [d.tokens_per_s() for d in decoders]
+            rates = [r for r in rates if r is not None]
+            tokens_per_s = sum(rates) if rates else None
+            occupancy = (sum(d.scheduler.occupancy() for d in decoders)
+                         / len(decoders))
+            depth += sum(d.queue_depth() for d in decoders)
+        else:
+            g = tel.gauges()
+            tokens_per_s = g.get("serve.tokens_per_s")
+            occupancy = g.get("serve.slot_occupancy")
         return AutoscaleSignals(
             queue_depth=depth,
             p99_ms=tel.hist_quantile("serve.latency_ms", 0.99),
-            batch_fill=(fill_n / fill_b) if fill_b else None)
+            batch_fill=(fill_n / fill_b) if fill_b else None,
+            tokens_per_s=tokens_per_s,
+            slot_occupancy=occupancy)
 
     def signals(self) -> AutoscaleSignals:
         sig = self._signals_fn()

@@ -169,17 +169,35 @@ class MicroBatcher:
         behind the ``serve.queue_depth`` gauge."""
         return self._queue.qsize()
 
+    def oldest_queue_age_s(self) -> Optional[float]:
+        """Age of the OLDEST still-queued request (None when empty) —
+        the head-of-line wait a newly shed caller is implicitly being
+        quoted on top of the drain-rate backlog estimate."""
+        with self._queue.mutex:
+            head = next((p for p in self._queue.queue
+                         if p is not _SENTINEL), None)
+        if head is None:
+            return None
+        return max(0.0, time.perf_counter() - head.t0)
+
     def _computed_retry_after(self, depth: int) -> float:
         """Retry-After from the MEASURED drain rate: the current backlog
         over the smoothed requests/s the worker is actually clearing,
         clamped to a sane band. Before any group has completed there is
         no measurement — fall back to the operator knob rather than
-        invent a number."""
+        invent a number. The oldest queued request's age FLOORS the
+        estimate: a head-of-line request that has already waited T
+        seconds proves the tier is clearing slower than the EWMA claims
+        (e.g. the worker is parked inside a long dispatch), so the hint
+        must not promise anything sooner."""
         rate = self._drain_rate
         if not rate or rate <= 0:
             base = const.ENV.ADT_DRAIN_RETRY_AFTER_S.val
         else:
             base = depth / rate
+        oldest = self.oldest_queue_age_s()
+        if oldest is not None:
+            base = max(base, oldest)
         return min(max(base, _RETRY_AFTER_MIN_S), _RETRY_AFTER_MAX_S)
 
     def _maybe_brownout(self, depth: int):
@@ -356,6 +374,7 @@ class MicroBatcher:
         from autodist_tpu.serving import autoscale as autoscale_lib
         out.update(
             queue_depth=self._queue.qsize(),
+            oldest_queue_age_s=self.oldest_queue_age_s(),
             drain_rate_rps=self._drain_rate,
             brownout={"active": self._brownout,
                       "entries": self._brownout_entries},

@@ -140,6 +140,7 @@ DEFAULT_COUNTERS = (
     "serve.requests", "serve.batches", "serve.compiles",
     "serve.padded_rows", "serve.degraded", "serve.shed", "serve.drained",
     "serve.deadline_shed", "serve.brownouts",
+    "serve.tokens", "serve.prefill_admits", "serve.evictions",
     "autoscale.grows", "autoscale.shrinks", "autoscale.holds",
     "autoscale.refusals",
     "preempt.notices", "preempt.rescue_saves", "preempt.rescue_skips",

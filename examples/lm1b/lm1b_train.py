@@ -36,6 +36,10 @@ def main():
                    help="'synthetic', or a directory of text files to "
                         "tokenize (byte-level) through the native record "
                         "loader; 'docs' uses the repo's own documentation")
+    p.add_argument("--decode", type=int, default=0, metavar="N",
+                   help="after training, generate N tokens per prompt "
+                        "through the continuous-batching DecodeEngine "
+                        "(serving/decode.py)")
     args = p.parse_args()
 
     cfg = {"tiny": lm.LMConfig.tiny, "default": lm.LMConfig,
@@ -93,6 +97,45 @@ def main():
     wps = run_words / (time.perf_counter() - run_t0) if run_words else 0.0
     print("lm1b done: %d steps, final loss %.4f, %.1f words/sec"
           % (args.steps, m["loss"], wps))
+
+    if args.decode > 0:
+        decode(step.get_runner(), cfg, args.decode, args.batch_size)
+
+
+def decode(runner, cfg, n_tokens: int, batch_size: int):
+    """Autoregressive generation from the trained checkpoint through the
+    continuous-batching decode engine — the runnable entry point behind
+    ``bench.py --serve-decode`` and docs/serving.md."""
+    import numpy as np
+
+    from autodist_tpu.serving.decode import DecodeConfig, DecodeEngine
+
+    replicas = runner.remapper.num_replicas
+    slots = max(8 // max(replicas, 1), 1) * max(replicas, 1)
+    setup = lm.make_decode_setup(cfg)
+    engine = DecodeEngine(runner, setup, DecodeConfig(
+        slots=slots, max_new_tokens=n_tokens,
+        prefill_len=min(16, cfg.max_seq_len // 2)))
+    engine.warmup()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, (1 + i % 8,)).astype(np.int32)
+               for i in range(min(batch_size, 2 * slots))]
+    t0 = time.perf_counter()
+    futures = [engine.submit(p) for p in prompts]
+    results = [f.result(timeout=600) for f in futures]
+    dt = time.perf_counter() - t0
+    stats = engine.stats()
+    total = sum(len(r["tokens"]) for r in results)
+    for p, r in zip(prompts[:4], results[:4]):
+        print("prompt %s -> %s (%s)" % (list(map(int, p)),
+                                        list(map(int, r["tokens"])),
+                                        r["finished"]))
+    print("decode done: %d sequences, %d tokens, %.1f tokens/sec, "
+          "token p50 %.2fms p99 %.2fms, recompiles after warmup: %d"
+          % (len(results), total, total / dt,
+             stats["token_p50_ms"] or 0.0, stats["token_p99_ms"] or 0.0,
+             stats["recompiles_after_warmup"]))
+    engine.close()
 
 
 if __name__ == "__main__":
